@@ -1,0 +1,371 @@
+"""Imperative autograd: tape recording + reverse-mode backward.
+
+Reference counterpart: ``src/imperative/imperative.cc`` (MarkVariables :112,
+RecordOp :182, Backward :357) and ``python/mxnet/autograd.py``. TPU-native
+design: the tape records (op, attrs, input values); backward computes
+per-node cotangents with ``jax.vjp`` of the registered pure function — the
+whole of pass::Gradient plus the backward executor collapses into JAX's VJP
+machinery. Thread-local is_recording/is_training flags mirror
+``Imperative::is_recording_``/``is_train_`` (imperative.cc:25-29).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_rec):
+    prev = _st().recording
+    _st().recording = bool(is_rec)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _st().training
+    _st().training = bool(train_mode)
+    return prev
+
+
+@contextmanager
+def record(train_mode=True):
+    """Scope: record ops for autograd (ref: python/mxnet/autograd.py record)."""
+    prev_rec = set_recording(True)
+    prev_train = set_training(train_mode)
+    try:
+        yield
+    finally:
+        set_recording(prev_rec)
+        set_training(prev_train)
+
+
+@contextmanager
+def pause(train_mode=False):
+    prev_rec = set_recording(False)
+    prev_train = set_training(train_mode)
+    try:
+        yield
+    finally:
+        set_recording(prev_rec)
+        set_training(prev_train)
+
+
+@contextmanager
+def train_mode():
+    prev = set_training(True)
+    try:
+        yield
+    finally:
+        set_training(prev)
+
+
+@contextmanager
+def predict_mode():
+    prev = set_training(False)
+    try:
+        yield
+    finally:
+        set_training(prev)
+
+
+class TapeNode:
+    """One recorded op application (the AGInfo/nnvm-Node analogue)."""
+
+    __slots__ = (
+        "op",
+        "attrs",
+        "inputs",
+        "input_values",
+        "n_outputs",
+        "rng_key",
+        "saved",
+        "custom",
+    )
+
+    def __init__(self, op, attrs, inputs, input_values, n_outputs, rng_key=None, custom=None):
+        self.op = op
+        self.attrs = attrs
+        self.inputs = inputs  # list of NDArray (keeps them alive for backward)
+        self.input_values = input_values  # raw jax arrays (None for missing optionals)
+        self.n_outputs = n_outputs
+        self.rng_key = rng_key
+        self.custom = custom  # optional CustomFunction providing backward
+        self.saved = None
+
+
+class GradEntry:
+    """Autograd metadata stamped on an NDArray (the ``entry_`` analogue,
+    ref include/mxnet/ndarray.h:98)."""
+
+    __slots__ = ("node", "index", "is_variable", "grad", "grad_req")
+
+    def __init__(self, node=None, index=0):
+        self.node = node
+        self.index = index
+        self.is_variable = False
+        self.grad = None  # NDArray buffer for marked variables
+        self.grad_req = "write"
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (ref: Imperative::MarkVariables imperative.cc:112)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        entry = GradEntry()
+        entry.is_variable = True
+        entry.grad = grad
+        entry.grad_req = req
+        var._grad_entry = entry
+
+
+def record_op(op, attrs, inputs, outputs, input_values, rng_key=None, custom=None):
+    """Stamp a TapeNode onto outputs (ref: Imperative::RecordOp imperative.cc:182)."""
+    node = TapeNode(op, attrs, list(inputs), list(input_values), len(outputs), rng_key, custom)
+    for i, out in enumerate(outputs):
+        out._grad_entry = GradEntry(node, i)
+    return node
+
+
+def _topo_order(head_arrays):
+    """Reverse-topological node order from head output arrays."""
+    visited = set()
+    order = []
+
+    def visit(node):
+        if node is None or id(node) in visited:
+            return
+        visited.add(id(node))
+        for inp in node.inputs:
+            e = getattr(inp, "_grad_entry", None)
+            if e is not None and e.node is not None:
+                visit(e.node)
+        order.append(node)
+
+    for arr in head_arrays:
+        e = getattr(arr, "_grad_entry", None)
+        if e is not None and e.node is not None:
+            visit(e.node)
+    return order[::-1]  # heads first
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse-mode on recorded tape (ref: Imperative::Backward
+    imperative.cc:357-470).
+
+    heads: list of NDArray outputs; head_grads: matching cotangents or None
+    (ones for scalars/any shape, matching reference behavior).
+    """
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _wrap_result
+
+    heads = [heads] if not isinstance(heads, (list, tuple)) else list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # cotangent store: id(node) -> [cotangent per output]
+    cotangents = {}
+    var_accum = {}  # id(entry) -> [entry, running sum]
+
+    def acc_var(entry, ct):
+        slot = var_accum.get(id(entry))
+        if slot is None:
+            var_accum[id(entry)] = [entry, ct]
+        else:
+            slot[1] = slot[1] + ct
+
+    for arr, hg in zip(heads, head_grads):
+        e = getattr(arr, "_grad_entry", None)
+        if e is None:
+            raise MXNetError("cannot differentiate: array is not in a recorded graph")
+        g = hg._data() if hasattr(hg, "_data") else (
+            jnp.ones_like(arr._data()) if hg is None else jnp.asarray(hg)
+        )
+        if e.node is None:
+            # head is itself a marked variable
+            acc_var(e, g)
+            continue
+        slot = cotangents.setdefault(id(e.node), [None] * e.node.n_outputs)
+        slot[e.index] = g if slot[e.index] is None else slot[e.index] + g
+
+    order = _topo_order(heads)
+    for node in order:
+        outs_ct = cotangents.pop(id(node), None)
+        if outs_ct is None:
+            continue
+        in_cts = _node_vjp(node, outs_ct, train_mode)
+        for inp, ct in zip(node.inputs, in_cts):
+            if ct is None or inp is None:
+                continue
+            e = getattr(inp, "_grad_entry", None)
+            if e is None:
+                continue
+            if e.node is not None:
+                slot = cotangents.setdefault(id(e.node), [None] * e.node.n_outputs)
+                slot[e.index] = ct if slot[e.index] is None else slot[e.index] + ct
+            if e.is_variable:
+                acc_var(e, ct)
+
+    # apply accumulated grads to variable buffers per grad_req
+    for entry, total in var_accum.values():
+        buf = entry.grad
+        if buf is None or entry.grad_req == "null":
+            continue
+        ct = total.astype(buf.dtype) if total.dtype != buf.dtype else total
+        if entry.grad_req == "add":
+            buf._rebind(buf._data() + ct)
+        else:
+            buf._rebind(ct)
+
+    if not retain_graph:
+        for node in order:
+            node.inputs = []
+            node.input_values = []
+            node.saved = None
+        for arr in heads:
+            e = getattr(arr, "_grad_entry", None)
+            if e is not None and not e.is_variable:
+                arr._grad_entry = None
+
+
+def _node_vjp(node, out_cotangents, train_mode):
+    """Compute input cotangents for one tape node via jax.vjp."""
+    import jax.numpy as jnp
+
+    if node.custom is not None:
+        return node.custom.backward_cotangents(node, out_cotangents)
+    op = node.op
+    if op.nondiff:
+        return [None] * len(node.inputs)
+
+    attrs = dict(node.attrs)
+    if "__is_train__" in op.attr_defaults:
+        attrs["__is_train__"] = train_mode
+
+    vals = node.input_values
+    present = [i for i, v in enumerate(vals) if v is not None]
+
+    def fn(*arrays):
+        full = list(vals)
+        for i, a in zip(present, arrays):
+            full[i] = a
+        if op.needs_rng:
+            return op.fn(node.rng_key, *full, **attrs)
+        return op.fn(*full, **attrs)
+
+    primals = [vals[i] for i in present]
+    outs, vjp_fn = jax.vjp(fn, *primals)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    cts = []
+    for i, o in enumerate(outs):
+        given = out_cotangents[i] if i < len(out_cotangents) else None
+        cts.append(given if given is not None else jnp.zeros_like(o))
+    grads = vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
+    full_grads = [None] * len(vals)
+    for i, g in zip(present, grads):
+        full_grads[i] = g
+    return full_grads
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
+    """Compute and return grads of heads wrt variables without touching .grad
+    (ref: python/mxnet/autograd.py grad())."""
+    from .ndarray import ndarray as _nd
+
+    variables = [variables] if not isinstance(variables, (list, tuple)) else list(variables)
+    saved = [(getattr(v, "_grad_entry", None)) for v in variables]
+    bufs = [_nd.zeros(v.shape, ctx=v.ctx, dtype=v.dtype) for v in variables]
+    # temporarily mark
+    for v, b, old in zip(variables, bufs, saved):
+        entry = GradEntry(old.node if old else None, old.index if old else 0)
+        entry.is_variable = True
+        entry.grad = b
+        entry.grad_req = "add"
+        v._grad_entry = entry
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph or create_graph), train_mode=train_mode)
+    finally:
+        for v, old in zip(variables, saved):
+            v._grad_entry = old
+    return bufs
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported on the TPU runtime")
+
+
+class Function:
+    """User-defined differentiable function (ref: python/mxnet/autograd.py
+    Function). Subclass and implement forward/backward on NDArrays."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved or ()
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *out_grads):
+        raise NotImplementedError
+
+    def backward_cotangents(self, node, out_cotangents):
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import _wrap_raw
+
+        wrapped = []
+        for i, ct in enumerate(out_cotangents):
+            if ct is None:
+                ct = jnp.zeros_like(node.saved[i])
+            wrapped.append(_wrap_raw(ct))
+        with pause():
+            in_grads = self.backward(*wrapped)
+        if not isinstance(in_grads, (list, tuple)):
+            in_grads = [in_grads]
+        return [g._data() if g is not None else None for g in in_grads]
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            node = record_op(
+                None, {}, list(inputs), outs,
+                [i._data() for i in inputs], custom=self,
+            )
+            node.saved = [o._data() for o in outs]
+        return outputs if single else outs

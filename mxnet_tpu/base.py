@@ -1,0 +1,118 @@
+"""Base utilities: error types, name management, type coercion.
+
+TPU-native re-imagination of the reference's ``python/mxnet/base.py`` —
+instead of a ctypes bridge to a C ABI (ref: python/mxnet/base.py:452-584),
+the front end talks directly to the in-process op registry
+(:mod:`mxnet_tpu.ops.registry`); op namespaces (``_contrib_``, ``_linalg_``,
+``_random_``) are materialized into python modules the same way the
+reference's ``_init_op_module`` does.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: python/mxnet/base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+_GLOBAL_NAME_LOCK = threading.Lock()
+
+
+class _NameCounter:
+    """Per-prefix monotonically increasing counters for auto-naming.
+
+    Parity with NameManager (ref: python/mxnet/name.py): symbols and gluon
+    blocks get names like ``convolution0``, ``convolution1``.
+    """
+
+    def __init__(self):
+        self._counts = {}
+
+    def get(self, prefix: str) -> str:
+        with _GLOBAL_NAME_LOCK:
+            idx = self._counts.get(prefix, 0)
+            self._counts[prefix] = idx + 1
+        return "%s%d" % (prefix, idx)
+
+    def reset(self):
+        with _GLOBAL_NAME_LOCK:
+            self._counts.clear()
+
+
+_NAME_COUNTER = _NameCounter()
+
+
+def auto_name(prefix: str) -> str:
+    return _NAME_COUNTER.get(prefix.lower())
+
+
+def reset_naming():
+    _NAME_COUNTER.reset()
+
+
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": None,  # resolved lazily to ml_dtypes bfloat16 via jnp
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def dtype_np(dtype):
+    """Normalize a dtype spec (string/np.dtype/jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+
+            return jnp.bfloat16
+        got = _DTYPE_ALIASES.get(dtype)
+        if got is None:
+            raise MXNetError("unknown dtype %r" % (dtype,))
+        return np.dtype(got)
+    return np.dtype(dtype) if not _is_bfloat16(dtype) else dtype
+
+
+def _is_bfloat16(dtype) -> bool:
+    return getattr(dtype, "__name__", None) == "bfloat16" or str(dtype) == "bfloat16"
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    if isinstance(dtype, str):
+        return dtype
+    return np.dtype(dtype).name if not _is_bfloat16(dtype) else "bfloat16"
+
+
+_PYTHONIC = re.compile(r"[^0-9a-zA-Z_]")
+
+
+def sanitize_name(name: str) -> str:
+    return _PYTHONIC.sub("_", name)
+
+
+def check_call(ret):
+    """Parity shim — there is no C ABI; errors are python exceptions."""
+    return ret
+
+
+def classproperty(func):
+    class _Desc:
+        def __get__(self, _obj, objtype=None):
+            return func(objtype)
+
+    return _Desc()

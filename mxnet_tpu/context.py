@@ -1,0 +1,160 @@
+"""Device contexts.
+
+Parity surface: ``include/mxnet/base.h:85-230`` (``struct Context`` with
+``kCPU/kGPU/kCPUPinned/kCPUShared`` device types) and
+``python/mxnet/context.py``. TPU-native design: a ``Context`` names a JAX
+device (or, for sharded execution, a position in a mesh). ``mx.tpu(0)`` is
+first-class; ``cpu(i)`` maps onto host-platform devices so that unit tests
+can use N virtual CPU devices as distinct "chips"
+(``--xla_force_host_platform_device_count``), mirroring the reference's
+multi-CPU-context test pattern (SURVEY §4).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+_DEVTYPE_IDS = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+_DEVID_TYPES = {v: k for k, v in _DEVTYPE_IDS.items()}
+
+
+class Context:
+    """A device context. Immutable, hashable, usable as a `with` scope."""
+
+    _default_ctx = threading.local()
+    devtype2str = _DEVID_TYPES
+    devstr2type = _DEVTYPE_IDS
+
+    __slots__ = ("device_type", "device_id", "_old_ctx")
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type = device_type.device_type
+            self.device_id = device_type.device_id
+        else:
+            if isinstance(device_type, int):
+                device_type = _DEVID_TYPES[device_type]
+            if device_type not in _DEVTYPE_IDS:
+                raise MXNetError("unknown device type %r" % (device_type,))
+            self.device_type = device_type
+            self.device_id = int(device_id)
+        self._old_ctx = None
+
+    @property
+    def device_typeid(self):
+        return _DEVTYPE_IDS[self.device_type]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+    # -- JAX device resolution ------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        cpu→'cpu' backend devices (virtual multi-device under
+        xla_force_host_platform_device_count); tpu→'tpu' backend if present,
+        else falls back to the default backend (so code written for mx.tpu()
+        runs in CPU-only CI).
+        """
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            # under the axon TPU platform no 'cpu' backend exists — fall back
+            # to the default device (host staging is handled by jax)
+            devs = _backend_devices("cpu") or _backend_devices("__default__")
+        elif self.device_type == "tpu":
+            devs = _backend_devices("tpu") or _backend_devices("__default__")
+        elif self.device_type == "gpu":
+            # parity alias: gpu(i) means "accelerator i" — resolve to whatever
+            # non-cpu backend exists (tpu under axon), else cpu.
+            devs = (_backend_devices("gpu") or _backend_devices("tpu")
+                    or _backend_devices("__default__"))
+        else:
+            devs = _backend_devices("__default__")
+        if not devs:
+            raise MXNetError("no devices for context %r" % (self,))
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):
+        """Parity: mx.context.Context.empty_cache — XLA manages HBM; no-op."""
+
+    @classmethod
+    def default_ctx(cls):
+        ctx = getattr(cls._default_ctx, "value", None)
+        return ctx if ctx is not None else cpu()
+
+
+_DEVICE_CACHE = {}
+_DEVICE_CACHE_LOCK = threading.Lock()
+
+
+def _backend_devices(platform):
+    with _DEVICE_CACHE_LOCK:
+        if platform not in _DEVICE_CACHE:
+            import jax
+
+            if "__default__" not in _DEVICE_CACHE:
+                # initialize the default backend set first — querying a
+                # specific platform before general init breaks plugin
+                # discovery (observed with the axon TPU plugin)
+                _DEVICE_CACHE["__default__"] = tuple(jax.devices())
+            if platform != "__default__":
+                try:
+                    _DEVICE_CACHE[platform] = tuple(jax.devices(platform))
+                except RuntimeError:
+                    _DEVICE_CACHE[platform] = ()
+        return _DEVICE_CACHE[platform]
+
+
+def cpu(device_id=0):
+    """Return a CPU context (ref: python/mxnet/context.py cpu())."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context; on this stack an alias resolving to TPU."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context — the native device type of this framework."""
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    return len(_backend_devices("gpu"))
+
+
+def num_tpus():
+    return len(_backend_devices("tpu"))
+
+
+def current_context():
+    return Context.default_ctx()

@@ -1,0 +1,451 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference counterpart: ``python/mxnet/gluon/block.py`` (Block :121,
+HybridBlock deferred init + _build_cache → CachedOp :381-384, hybridize
+:443, SymbolBlock :542). TPU-native design: ``hybridize()`` compiles the
+block's computation into ONE jitted XLA function (the CachedOp analogue,
+ref src/imperative/cached_op.cc) keyed on input shapes/dtypes; parameters
+are passed as traced arguments so optimizer updates need no re-trace, and a
+fresh PRNG key is threaded per call for dropout parity.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+from .. import autograd
+from ..base import MXNetError, auto_name
+from ..context import current_context
+from ..ndarray import ndarray as nd
+from ..ndarray.ndarray import NDArray, _wrap_raw
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Name scoping for parameter prefixes (ref: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = auto_name(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (ref: block.py:121)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(key=key, block=_indent(repr(block), 2))
+            for key, block in self._children.items()
+        )
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(value, type(existing)):
+                raise TypeError(
+                    "Changing attribute type for %s from %s to %s is not allowed."
+                    % (name, type(existing), type(value))
+                )
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or self._reg_params[name] is value, (
+                "Overriding Parameter attribute %s is not allowed." % name
+            )
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _check_container_with_block(self):
+        pass
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items() if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    save_parameters = save_params
+
+    def load_params(self, filename, ctx=None, allow_missing=False, ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra, self.prefix)
+
+    load_parameters = load_params
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        raise NotImplementedError
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    if len(lines) == 1:
+        return s_
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+class HybridBlock(Block):
+    """Block compilable into one XLA program (ref: block.py HybridBlock)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_fn = None
+        self._cache_key = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_fn = None
+        self._cache_key = None
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s has type %s."
+                % (str(block), str(type(block)))
+            )
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Deferred-shape resolution by abstract evaluation."""
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        # run an eager forward with params replaced by zeros once shapes known
+        pass
+
+    def __call__(self, *args):
+        if self._active:
+            out = self._call_cached(args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args)
+
+    # -- the CachedOp analogue ----------------------------------------------
+    def _call_cached(self, args):
+        import jax
+
+        flat_args = [a for a in args if isinstance(a, NDArray)]
+        try:
+            params = {k: p.data() for k, p in self._collect_all_reg_params().items()}
+        except DeferredInitializationError:
+            # first call with deferred params: run eagerly once to infer
+            out = self.forward(*args)
+            params = {k: p.data() for k, p in self._collect_all_reg_params().items()}
+            return out
+        key = (
+            tuple((tuple(a.shape), str(a.dtype)) for a in flat_args),
+            autograd.is_training(),
+            autograd.is_recording(),
+        )
+        if self._cached_fn is None or self._cache_key != key:
+            self._cached_fn = self._build_cache(args, params)
+            self._cache_key = key
+        return self._cached_fn(args, params)
+
+    def _collect_all_reg_params(self):
+        out = {}
+
+        def walk(block):
+            for name, p in block._reg_params.items():
+                out[p.name] = p
+            for c in block._children.values():
+                walk(c)
+
+        walk(self)
+        return out
+
+    def _build_cache(self, args, params):
+        """Trace self.forward into a jitted function of (inputs, params).
+
+        Training mode with autograd recording uses a custom tape entry so
+        backward flows through the single compiled program.
+        """
+        import jax
+
+        self_ref = self
+        is_train = autograd.is_training()
+        param_names = list(params.keys())
+
+        def pure_fn(key, input_vals, param_vals):
+            from .. import random as _rnd
+
+            # run forward with NDArray views over traced values
+            wrapped_inputs = [_wrap_raw(v) for v in input_vals]
+            holders = {}
+            all_params = self_ref._collect_all_reg_params()
+            for name, p in all_params.items():
+                holders[name] = p._data
+                p._data = _wrap_raw(param_vals[name])
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(is_train)
+            tok = _rnd.push_trace_key(key)
+            try:
+                out = self_ref.forward(*wrapped_inputs)
+            finally:
+                _rnd.pop_trace_key(tok)
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+                for name, p in all_params.items():
+                    p._data = holders[name]
+            if isinstance(out, (list, tuple)):
+                return [o._data() for o in out]
+            return out._data()
+
+        jitted = jax.jit(pure_fn)
+
+        def run(call_args, call_params):
+            from .. import random as _rnd
+
+            input_vals = [a._data() for a in call_args if isinstance(a, NDArray)]
+            param_vals = {k: v._data() for k, v in call_params.items()}
+            key = _rnd.next_key(current_context())
+            if autograd.is_recording():
+                return _recorded_apply(jitted, key, input_vals, param_vals,
+                                       [a for a in call_args if isinstance(a, NDArray)],
+                                       self_ref._collect_all_reg_params())
+            out = jitted(key, input_vals, param_vals)
+            if isinstance(out, list):
+                return [_wrap_raw(o) for o in out]
+            return _wrap_raw(out)
+
+        return run
+
+
+def _recorded_apply(jitted, key, input_vals, param_vals, input_arrays, params_map):
+    """Run the cached fn under autograd: record one tape node whose vjp is
+    the vjp of the whole compiled program (CachedOp::Backward parity)."""
+    param_names = list(param_vals.keys())
+
+    def fn_of_all(inp_list, pv_list):
+        pv = dict(zip(param_names, pv_list))
+        return jitted(key, inp_list, pv)
+
+    out = fn_of_all(input_vals, [param_vals[n] for n in param_names])
+    single = not isinstance(out, list)
+    outs_list = [out] if single else list(out)
+
+    class _CachedCustom:
+        def backward_cotangents(self, node, out_cotangents):
+            import jax
+            import jax.numpy as jnp
+
+            def f(*flat):
+                n_in = len(input_vals)
+                inp = list(flat[:n_in])
+                pv = list(flat[n_in:])
+                res = fn_of_all(inp, pv)
+                return tuple(res) if isinstance(res, list) else (res,)
+
+            primals = list(input_vals) + [param_vals[n] for n in param_names]
+            outs, vjp_fn = jax.vjp(f, *primals)
+            cts = tuple(
+                c if c is not None else jnp.zeros_like(o)
+                for c, o in zip(
+                    list(out_cotangents) + [None] * (len(outs) - len(out_cotangents)), outs
+                )
+            )
+            return list(vjp_fn(cts))
+
+    out_arrays = [_wrap_raw(o) for o in outs_list]
+    param_ndarrays = [params_map[n].data() for n in param_names]
+    autograd.record_op(
+        None, {}, list(input_arrays) + param_ndarrays, out_arrays,
+        list(input_vals) + [param_vals[n] for n in param_names],
+        custom=_CachedCustom(),
+    )
+    return out_arrays[0] if single else out_arrays
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params as a Block (ref: block.py:542)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=None)
+        from .. import symbol as sym_mod
+
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        self._cached_graph = (inputs, outputs)
+        input_names = {i.name for i in inputs}
+        self._input_names = [i.name for i in inputs]
+        arg_params = params or {}
+        for name in outputs.list_inputs():
+            if name not in input_names:
+                p = Parameter(name, allow_deferred_init=True)
+                if name in arg_params:
+                    p.shape = arg_params[name].shape
+                    p.initialize()
+                    p.set_data(arg_params[name])
+                self.params._params[name] = p
+        self._out_symbol = outputs
+        self._exec_cache = {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        from ..ndarray.utils import load as nd_load
+
+        outputs = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        params = {}
+        if param_file is not None:
+            raw = nd_load(param_file)
+            for k, v in raw.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                params[name] = v
+        return SymbolBlock(outputs, inputs, params=params)
+
+    def forward(self, *args):
+        from ..executor import Executor
+
+        values = {}
+        for name, a in zip(self._input_names, args):
+            values[name] = a
+        arg_arrays = {}
+        for name in self._out_symbol.list_inputs():
+            if name in values:
+                arg_arrays[name] = values[name]
+            else:
+                arg_arrays[name] = self.params[name].data()
+        aux_names = set(self._out_symbol.list_auxiliary_states())
+        args_d = {k: v for k, v in arg_arrays.items() if k not in aux_names}
+        aux_d = {k: v for k, v in arg_arrays.items() if k in aux_names}
+        # cache the Executor per input signature so jit compilation is paid
+        # once, not per call (CachedOp parity)
+        cache_key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        ex = self._exec_cache.get(cache_key)
+        if ex is None:
+            ex = Executor(self._out_symbol, args[0].ctx, args_d, None, "null", aux_d)
+            self._exec_cache[cache_key] = ex
+        else:
+            for k, v in args_d.items():
+                ex.arg_dict[k]._rebind(v._data())
+            for k, v in aux_d.items():
+                ex.aux_dict[k]._rebind(v._data())
+        outs = ex.forward(is_train=autograd.is_training())
+        return outs[0] if len(outs) == 1 else outs

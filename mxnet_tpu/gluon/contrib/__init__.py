@@ -1,0 +1,3 @@
+"""Gluon contrib (ref: python/mxnet/gluon/contrib/ — Conv*RNN cells,
+VariationalDropoutCell). Populated as the RNN contrib surface lands."""
+from . import rnn  # noqa: F401

@@ -1,0 +1,3 @@
+"""Contrib RNN cells (ref: python/mxnet/gluon/contrib/rnn/)."""
+from .conv_rnn_cell import Conv2DLSTMCell  # noqa: F401
+from .rnn_cell import VariationalDropoutCell  # noqa: F401

@@ -1,0 +1,62 @@
+"""Convolutional RNN cells (ref: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ....ndarray.ndarray import invoke
+from ...rnn.rnn_cell import RecurrentCell
+
+
+class Conv2DLSTMCell(RecurrentCell):
+    """ConvLSTM (Shi et al. 2015; ref conv_rnn_cell.py Conv2DLSTMCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, H, W)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = (i2h_kernel,) * 2 if isinstance(i2h_kernel, int) else tuple(i2h_kernel)
+        self._h2h_kernel = (h2h_kernel,) * 2 if isinstance(h2h_kernel, int) else tuple(h2h_kernel)
+        self._i2h_pad = (i2h_pad,) * 2 if isinstance(i2h_pad, int) else tuple(i2h_pad)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        ci = self._input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_channels, ci) + self._i2h_kernel,
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_channels, hidden_channels) + self._h2h_kernel,
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_channels,), init="zeros", allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_channels,), init="zeros", allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        c, h, w = self._input_shape
+        oh = h + 2 * self._i2h_pad[0] - self._i2h_kernel[0] + 1
+        ow = w + 2 * self._i2h_pad[1] - self._i2h_kernel[1] + 1
+        shape = (batch_size, self._hidden_channels, oh, ow)
+        return [{"shape": shape, "__layout__": "NCHW"}, {"shape": shape, "__layout__": "NCHW"}]
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def step(self, inputs, states):
+        for p in self._reg_params.values():
+            if p._data is None:
+                p._finish_deferred_init()
+        i2h = invoke("Convolution", [inputs, self.i2h_weight.data(), self.i2h_bias.data()],
+                     {"kernel": self._i2h_kernel, "pad": self._i2h_pad,
+                      "num_filter": 4 * self._hidden_channels})
+        h2h = invoke("Convolution", [states[0], self.h2h_weight.data(), self.h2h_bias.data()],
+                     {"kernel": self._h2h_kernel, "pad": self._h2h_pad,
+                      "num_filter": 4 * self._hidden_channels})
+        gates = i2h + h2h
+        slices = invoke("SliceChannel", [gates], {"num_outputs": 4, "axis": 1})
+        i = slices[0].sigmoid()
+        f = slices[1].sigmoid()
+        g = slices[2].tanh()
+        o = slices[3].sigmoid()
+        next_c = f * states[1] + i * g
+        next_h = o * next_c.tanh()
+        return next_h, [next_h, next_c]

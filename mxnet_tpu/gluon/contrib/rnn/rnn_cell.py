@@ -1,0 +1,48 @@
+"""Contrib recurrent cells (ref: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ....ndarray import ndarray as nd
+from ....ndarray.ndarray import invoke
+from ...rnn.rnn_cell import ModifierCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask across time steps (ref: VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0, drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_mask(self, like, p):
+        return invoke("Dropout", [nd.ones(like.shape, ctx=like.ctx)], {"p": p, "mode": "always"})
+
+    def step(self, inputs, states):
+        cell = self.base_cell
+        if self.drop_states:
+            if self.drop_states_mask is None:
+                self.drop_states_mask = self._initialize_mask(states[0], self.drop_states)
+            states = [states[0] * self.drop_states_mask] + list(states[1:])
+        if self.drop_inputs:
+            if self.drop_inputs_mask is None:
+                self.drop_inputs_mask = self._initialize_mask(inputs, self.drop_inputs)
+            inputs = inputs * self.drop_inputs_mask
+        next_output, next_states = cell(inputs, states)
+        if self.drop_outputs:
+            if self.drop_outputs_mask is None:
+                self.drop_outputs_mask = self._initialize_mask(next_output, self.drop_outputs)
+            next_output = next_output * self.drop_outputs_mask
+        return next_output, next_states

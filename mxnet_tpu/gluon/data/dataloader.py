@@ -1,0 +1,102 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference uses multiprocess workers + shared-memory NDArrays
+(Context::kCPUShared). Here: thread-pool workers (numpy decode releases the
+GIL) feeding a bounded prefetch queue — device_put happens in the consumer,
+so host decode overlaps TPU compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ...ndarray import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into batch arrays."""
+    if isinstance(data[0], NDArray):
+        return nd.array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype if data.dtype != np.float64 else np.float32)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be specified if "
+                "batch_sampler is specified."
+            )
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * max(self._num_workers, 1))
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        batches = list(self._batch_sampler)
+        out_q = [None] * len(batches)
+        done = [False] * len(batches)
+        lock = threading.Lock()
+        next_job = [0]
+        sem = threading.Semaphore(self._prefetch)
+
+        def worker():
+            while True:
+                with lock:
+                    if next_job[0] >= len(batches):
+                        return
+                    job = next_job[0]
+                    next_job[0] += 1
+                sem.acquire()
+                res = self._batchify_fn([self._dataset[idx] for idx in batches[job]])
+                with lock:
+                    out_q[job] = res
+                    done[job] = True
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        for i in range(len(batches)):
+            while True:
+                with lock:
+                    if done[i]:
+                        res = out_q[i]
+                        out_q[i] = None
+                        break
+                threading.Event().wait(0.001)
+            sem.release()
+            yield res
+        for t in threads:
+            t.join(timeout=0.1)
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,3 @@
+"""Vision datasets + transforms (ref: python/mxnet/gluon/data/vision/)."""
+from . import transforms  # noqa: F401
+from .datasets import CIFAR10, CIFAR100, MNIST, FashionMNIST, ImageFolderDataset, ImageRecordDataset  # noqa: F401

@@ -1,0 +1,123 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import ndarray as nd
+from ....ndarray.ndarray import NDArray, invoke
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential, Sequential
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        out = x.astype(np.float32) / 255.0
+        if out.ndim == 3:
+            return out.transpose((2, 0, 1))
+        return out.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        return (x - nd.array(self._mean, ctx=x.ctx)) / nd.array(self._std, ctx=x.ctx)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+
+        arr = x._data().astype("float32")
+        h, w = self._size[1], self._size[0]
+        out = jax.image.resize(arr, (h, w, arr.shape[2]), method="bilinear")
+        return NDArray(out.astype(x._data().dtype), ctx=x.ctx)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return NDArray(x._data()[y0 : y0 + h, x0 : x0 + w], ctx=x.ctx)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0), interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax
+
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x._data()[y0 : y0 + h, x0 : x0 + w].astype("float32")
+                out = jax.image.resize(
+                    crop, (self._size[1], self._size[0], crop.shape[2]), method="bilinear"
+                )
+                return NDArray(out.astype(x._data().dtype), ctx=x.ctx)
+        return CenterCrop(self._size).forward(x)
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return NDArray(x._data()[:, ::-1], ctx=x.ctx)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return NDArray(x._data()[::-1], ctx=x.ctx)
+        return x

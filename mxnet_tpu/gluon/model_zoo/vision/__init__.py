@@ -1,0 +1,36 @@
+"""Model zoo (ref: python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from .alexnet import AlexNet, alexnet
+from .mobilenet import MobileNet, mobilenet0_25, mobilenet0_5, mobilenet0_75, mobilenet1_0
+from .resnet import *  # noqa: F401,F403
+from .resnet import get_resnet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn
+from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201
+
+_models = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
+    "resnet101_v1": resnet101_v1, "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn, "vgg19_bn": vgg19_bn,
+    "alexnet": alexnet,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            "Model %s is not supported. Available options are:\n\t%s"
+            % (name, "\n\t".join(sorted(_models.keys())))
+        )
+    return _models[name](**kwargs)

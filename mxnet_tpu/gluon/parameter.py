@@ -1,0 +1,332 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference counterpart: ``python/mxnet/gluon/parameter.py:43-581`` (deferred
+shape init, per-ctx replicas, grad_req, constant params). TPU-native
+design: one buffer per parameter (sharding across a mesh happens inside
+compiled steps, not by replica lists); ``list_data``/``list_grad`` keep the
+reference surface for multi-ctx call sites.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc, Initializer, create as create_init
+from ..ndarray import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape, self.dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown = any(s == 0 for s in self._shape)
+        if unknown:
+            assert len(self._shape) == len(new_shape)
+            merged = tuple(n if o == 0 else o for o, n in zip(self._shape, new_shape))
+            self._shape = merged
+        elif tuple(self._shape) != tuple(new_shape):
+            raise MXNetError(
+                "Parameter %s shape mismatch: %s vs %s" % (self.name, self._shape, new_shape)
+            )
+
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if default_init is None:
+            from ..initializer import Uniform
+
+            default_init = Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                "Cannot initialize Parameter %s because it has invalid shape %s"
+                % (self.name, self._shape)
+            )
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        ctx0 = self._ctx_list[0]
+        data = nd.zeros(self._shape, ctx=ctx0, dtype=self.dtype)
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = create_init(initializer)
+        initializer(InitDesc(self.name), data)
+        self._data = data
+        if self.grad_req != "null":
+            self._grad = nd.zeros(self._shape, ctx=ctx0, dtype=self.dtype)
+            autograd.mark_variables([self._data], [self._grad], grad_reqs=self.grad_req)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape; run a forward pass first" % self.name
+            )
+        self._finish_init(init, default_init)
+
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter %s was not initialized: deferred init pending first forward"
+                    % self.name
+                )
+            raise MXNetError(
+                "Parameter %s has not been initialized. Call initialize() first" % self.name
+            )
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError("Parameter %s does not have gradients (grad_req=null)" % self.name)
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return self._ctx_list or [self._data.ctx]
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if not self._deferred_init:
+                raise MXNetError("Parameter %s not initialized" % self.name)
+            self._finish_deferred_init()
+        src = data if isinstance(data, NDArray) else nd.array(data)
+        src.copyto(self._data)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        pass  # single-buffer design; sharding handled in compiled steps
+
+    def var(self):
+        from .. import symbol as sym
+
+        if self._var is None:
+            self._var = sym.var(self.name, shape=self._shape, lr_mult=self.lr_mult,
+                                wd_mult=self.wd_mult)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad], grad_reqs=self.grad_req)
+
+
+class Constant(Parameter):
+    """Non-updating parameter (ref: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class _CInit(Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+        super().__init__(name, grad_req="null", shape=value.shape, dtype=value.dtype,
+                         init=_CInit())
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(
+            name=name, content="\n".join(repr(v) for v in self._params.values())
+        )
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if v is None:
+                    continue
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape":
+                        param.shape = v  # merges/validates unknown dims
+                    elif k in ("init", "allow_deferred_init", "differentiable"):
+                        continue
+                    elif k == "dtype":
+                        import numpy as _np
+
+                        if _np.dtype(existing) != _np.dtype(v):
+                            raise MXNetError(
+                                "Parameter %s: inconsistent dtype %s vs existing %s"
+                                % (name, v, existing)
+                            )
+                    elif existing != v:
+                        raise MXNetError(
+                            "Parameter %s: inconsistent attribute %s=%r vs existing %r"
+                            % (name, k, v, existing)
+                        )
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError("No constant named %s" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("Cannot update self with other: duplicate key %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            from ..initializer import Uniform
+
+            init = Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError("Prefix %s is to be striped before saving, but Parameter "
+                                 "%s does not start with %s" % (strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        from ..ndarray.utils import save
+
+        save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False, restore_prefix=""):
+        from ..ndarray.utils import load
+
+        arg_dict = load(filename)
+        arg_dict = {(restore_prefix + k): v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError("Parameter %s is missing in file %s" % (name, filename))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter %s loaded from file %s is not present in this dict" % (name, filename))
+                continue
+            self[name]._load_init(arg_dict[name]) if hasattr(self[name], "_load_init") else self[name].set_data(arg_dict[name])

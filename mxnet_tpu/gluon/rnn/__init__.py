@@ -1,0 +1,14 @@
+"""Gluon recurrent layers and cells (ref: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import (  # noqa: F401
+    BidirectionalCell,
+    DropoutCell,
+    GRUCell,
+    LSTMCell,
+    ModifierCell,
+    RecurrentCell,
+    ResidualCell,
+    RNNCell,
+    SequentialRNNCell,
+    ZoneoutCell,
+)
+from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

@@ -1,0 +1,410 @@
+"""Gluon RNN cells: step-wise recurrent units + unroll.
+
+Reference counterpart: ``python/mxnet/gluon/rnn/rnn_cell.py`` (RecurrentCell
+ABC, RNNCell/LSTMCell/GRUCell, Sequential/Dropout/Zoneout/Residual/
+Bidirectional cells, unroll). On TPU, ``unroll`` over a fixed length traces
+to one XLA program; for long sequences prefer the fused RNN layer (scan).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray import ndarray as nd
+from ...ndarray.ndarray import NDArray, invoke
+from ..block import HybridBlock
+from ..parameter import DeferredInitializationError
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError()
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, dtype=None, **kwargs):
+        assert not self._modified, (
+            "After applying modifier cells the base cell cannot be called directly. "
+            "Call the modifier cell instead."
+        )
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            if func is None:
+                extra = {}
+                if ctx is not None:
+                    extra["ctx"] = ctx
+                if dtype is not None:
+                    extra["dtype"] = dtype
+                state = nd.zeros(shape, **extra)
+            else:
+                state = func(name="%sbegin_state_%d" % (self._prefix, self._init_counter),
+                             shape=shape, **kwargs)
+            states.append(state)
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None,
+               valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=inputs.ctx, dtype=inputs.dtype)
+        states = begin_state
+        outputs = []
+        all_states = []
+        seq = [
+            invoke("squeeze", [invoke("slice_axis", [inputs], {"axis": axis, "begin": i, "end": i + 1})], {"axis": axis})
+            for i in range(length)
+        ]
+        for i in range(length):
+            output, states = self(seq[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [
+                invoke("SequenceLast", [invoke("stack", [s[j] for s in all_states], {"axis": 0}), valid_length],
+                       {"use_sequence_length": True, "axis": 0})
+                for j in range(len(states))
+            ]
+            outputs = _mask_outputs(outputs, valid_length, axis)
+        if merge_outputs is None or merge_outputs:
+            outputs = invoke("stack", outputs, {"axis": axis})
+        return outputs, states
+
+    def _get_params(self):
+        try:
+            return {k: p.data() for k, p in self._reg_params.items()}
+        except (DeferredInitializationError, MXNetError):
+            return None
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return self.step(inputs, states)
+
+    def step(self, inputs, states):
+        raise NotImplementedError
+
+
+def _mask_outputs(outputs, valid_length, axis):
+    stacked = invoke("stack", outputs, {"axis": 0})
+    masked = invoke("SequenceMask", [stacked, valid_length], {"use_sequence_length": True, "axis": 0})
+    return [
+        invoke("squeeze", [invoke("slice_axis", [masked], {"axis": 0, "begin": i, "end": i + 1})], {"axis": 0})
+        for i in range(len(outputs))
+    ]
+
+
+class _BaseFusibleCell(RecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, input_size, ngates,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._ngates = ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ngates * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ngates * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ngates * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ngates * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _ensure_params(self, inputs):
+        p = self._get_params()
+        if p is None:
+            self.i2h_weight.shape = (self._ngates * self._hidden_size, inputs.shape[-1])
+            for param in self._reg_params.values():
+                if param._data is None:
+                    param._finish_deferred_init()
+            p = {k: v.data() for k, v in self._reg_params.items()}
+        return p
+
+    def _fc(self, x, w, b, num_hidden):
+        return invoke("FullyConnected", [x, w, b], {"num_hidden": num_hidden, "flatten": False})
+
+
+class RNNCell(_BaseFusibleCell):
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(hidden_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, input_size, 1,
+                         prefix=prefix, params=params)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def step(self, inputs, states):
+        p = self._ensure_params(inputs)
+        i2h = self._fc(inputs, p["i2h_weight"], p["i2h_bias"], self._hidden_size)
+        h2h = self._fc(states[0], p["h2h_weight"], p["h2h_bias"], self._hidden_size)
+        output = invoke("Activation", [i2h + h2h], {"act_type": self._activation})
+        return output, [output]
+
+
+class LSTMCell(_BaseFusibleCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(hidden_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, input_size, 4,
+                         prefix=prefix, params=params)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    def _alias(self):
+        return "lstm"
+
+    def step(self, inputs, states):
+        p = self._ensure_params(inputs)
+        H = self._hidden_size
+        i2h = self._fc(inputs, p["i2h_weight"], p["i2h_bias"], 4 * H)
+        h2h = self._fc(states[0], p["h2h_weight"], p["h2h_bias"], 4 * H)
+        gates = i2h + h2h
+        slices = invoke("SliceChannel", [gates], {"num_outputs": 4, "axis": 1})
+        in_gate = slices[0].sigmoid()
+        forget_gate = slices[1].sigmoid()
+        in_transform = slices[2].tanh()
+        out_gate = slices[3].sigmoid()
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * next_c.tanh()
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseFusibleCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(hidden_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, input_size, 3,
+                         prefix=prefix, params=params)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def step(self, inputs, states):
+        p = self._ensure_params(inputs)
+        H = self._hidden_size
+        i2h = self._fc(inputs, p["i2h_weight"], p["i2h_bias"], 3 * H)
+        h2h = self._fc(states[0], p["h2h_weight"], p["h2h_bias"], 3 * H)
+        i2h_r, i2h_z, i2h_n = invoke("SliceChannel", [i2h], {"num_outputs": 3, "axis": 1})
+        h2h_r, h2h_z, h2h_n = invoke("SliceChannel", [h2h], {"num_outputs": 3, "axis": 1})
+        reset_gate = (i2h_r + h2h_r).sigmoid()
+        update_gate = (i2h_z + h2h_z).sigmoid()
+        next_h_tmp = (i2h_n + reset_gate * h2h_n).tanh()
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def step(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p : p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def step(self, inputs, states):
+        if self._rate > 0:
+            inputs = invoke("Dropout", [inputs], {"p": self._rate, "axes": self._axes})
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        assert not base_cell._modified, (
+            "Cell %s is already modified. One cell cannot be modified twice" % base_cell.name
+        )
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(), params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), (
+            "BidirectionalCell doesn't support zoneout. Apply zoneout to the cells underneath instead."
+        )
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def step(self, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        p_outputs, p_states = self.zoneout_outputs, self.zoneout_states
+
+        def mask(p, like):
+            return invoke("Dropout", [nd.ones(like.shape, ctx=like.ctx)], {"p": p, "mode": "always"})
+
+        prev_output = self._prev_output if self._prev_output is not None else nd.zeros(next_output.shape, ctx=next_output.ctx)
+        output = (
+            invoke("where", [mask(p_outputs, next_output), next_output, prev_output], {})
+            if p_outputs != 0.0
+            else next_output
+        )
+        new_states = (
+            [invoke("where", [mask(p_states, new_s), new_s, old_s], {})
+             for new_s, old_s in zip(next_states, states)]
+            if p_states != 0.0
+            else next_states
+        )
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    def __init__(self, base_cell):
+        super().__init__(base_cell)
+
+    def _alias(self):
+        return "residual"
+
+    def step(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None,
+               valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=inputs.ctx, dtype=inputs.dtype)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        n_l = len(l_cell.state_info(batch_size))
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[:n_l], layout=layout,
+            merge_outputs=True, valid_length=valid_length,
+        )
+        rev_inputs = invoke("SequenceReverse", [inputs.swapaxes(0, axis) if axis != 0 else inputs, valid_length],
+                            {"use_sequence_length": valid_length is not None})
+        if axis != 0:
+            rev_inputs = rev_inputs.swapaxes(0, axis)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=rev_inputs, begin_state=states[n_l:], layout=layout,
+            merge_outputs=True, valid_length=valid_length,
+        )
+        r_outputs_t = r_outputs.swapaxes(0, axis) if axis != 0 else r_outputs
+        r_outputs_rev = invoke("SequenceReverse", [r_outputs_t, valid_length],
+                               {"use_sequence_length": valid_length is not None})
+        if axis != 0:
+            r_outputs_rev = r_outputs_rev.swapaxes(0, axis)
+        outputs = invoke("Concat", [l_outputs, r_outputs_rev], {"dim": 2})
+        return outputs, l_states + r_states

@@ -1,0 +1,162 @@
+"""Gluon fused RNN layers: RNN / LSTM / GRU.
+
+Reference counterpart: ``python/mxnet/gluon/rnn/rnn_layer.py:31`` wrapping
+the fused ``RNN`` op (cuDNN on GPU; here one lax.scan XLA program, see
+ops/nn.py rnn()).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import ndarray as nd
+from ...ndarray.ndarray import NDArray, invoke
+from ..block import HybridBlock
+from ..parameter import DeferredInitializationError
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        with self.name_scope():
+            self.parameters = self.params.get(
+                "parameters", shape=(self._total_param_size(input_size) if input_size else 0,),
+                init=None, allow_deferred_init=True,
+            )
+
+    def _total_param_size(self, input_size):
+        H = self._hidden_size
+        L = self._num_layers
+        D = self._dir
+        ng = self._gates
+        size = 0
+        for layer in range(L):
+            for _ in range(D):
+                in_size = input_size if layer == 0 else H * D
+                size += ng * H * in_size + ng * H * H
+        size += L * D * 2 * ng * H
+        return size
+
+    def _infer_param_shapes(self, x):
+        input_size = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        self.parameters.shape = (self._total_param_size(input_size),)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(nd.zeros(info["shape"], **kwargs))
+            else:
+                info.update(kwargs)
+                states.append(func(name="%sh0" % self.prefix, **info))
+        return states
+
+    def forward(self, inputs, states=None):
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.ctx, dtype=inputs.dtype)
+        if isinstance(states, NDArray):
+            states = [states]
+        try:
+            params = self.parameters.data()
+        except (DeferredInitializationError, MXNetError):
+            self._infer_param_shapes(inputs)
+            self.parameters._finish_deferred_init()
+            params = self.parameters.data()
+        op_inputs = [inputs, params, states[0]]
+        if self._mode == "lstm":
+            op_inputs.append(states[1])
+        outputs = invoke(
+            "RNN", op_inputs,
+            {
+                "state_size": self._hidden_size, "num_layers": self._num_layers,
+                "bidirectional": self._dir == 2, "mode": self._mode,
+                "p": self._dropout, "state_outputs": True,
+            },
+        )
+        if not isinstance(outputs, list):
+            outputs = [outputs]
+        out = outputs[0]
+        out_states = outputs[1:]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if skip_states:
+            return out
+        return out, out_states
+
+    def __repr__(self):
+        s = "{name}({_hidden_size}, {_layout}, num_layers={_num_layers}"
+        if self._dropout:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        return s.format(name=self.__class__.__name__, **self.__dict__)
+
+
+class RNN(_RNNLayer):
+    """Elman RNN (ref: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"},
+            {"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"},
+        ]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]

@@ -1,0 +1,48 @@
+"""ImageRecordIter implementation backing mx.io.ImageRecordIter.
+
+Reference counterpart: ``src/io/iter_image_recordio_2.cc:724`` (OMP-parallel
+JPEG decode + augment into pinned batches). Here: the python ImageIter
+pipeline wrapped with background-thread prefetch (iter_prefetcher.h parity).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataIter, PrefetchingIter
+from .image import ImageIter
+
+
+class ImageRecordIterImpl(DataIter):
+    def __init__(self, path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
+                 label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
+                 resize=0, dtype="float32", preprocess_threads=4, prefetch_buffer=4,
+                 path_imgidx=None, data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b])
+        std = None
+        if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+            std = np.array([std_r, std_g, std_b])
+        inner = ImageIter(
+            batch_size=batch_size, data_shape=tuple(data_shape), label_width=label_width,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
+            mean=mean, std=std, data_name=data_name, label_name=label_name,
+        )
+        self._iter = PrefetchingIter(inner)
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()

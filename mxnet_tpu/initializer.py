@@ -1,0 +1,348 @@
+"""Weight initializers.
+
+Reference counterpart: ``python/mxnet/initializer.py`` (726 LoC): registry,
+InitDesc pattern matching (bias→zero, gamma→one, …), Uniform/Normal/Xavier/
+MSRAPrelu/Orthogonal/Bilinear/LSTMBias/One/Zero/Constant/Mixed.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import ndarray as nd
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to initializers (ref: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer with the reference's name-pattern dispatch."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # default leaf rules
+    def _init_bias(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_gamma(self, desc, arr):
+        self._set(arr, np.ones(arr.shape))
+
+    def _init_beta(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, np.ones(arr.shape))
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    @staticmethod
+    def _set(arr, value):
+        arr[:] = nd.array(np.asarray(value, dtype=np.float32), ctx=arr.ctx, dtype=arr.dtype)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.ones(arr.shape))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.random.normal(0, self.sigma, arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """ref: initializer.py Xavier — gaussian/uniform over avg/in/out fans."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError("Xavier requires ndim >= 2: %r %s" % (desc, (shape,)))
+        if len(shape) > 2:
+            for s in shape[2:]:
+                hw_scale *= s
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[
+            self.factor_type
+        ]
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, np.random.uniform(-scale, scale, shape))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, np.random.normal(0, scale, shape))
+        else:
+            raise MXNetError("unknown rnd_type %r" % self.rnd_type)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope**2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (ref: initializer.py Bilinear)."""
+
+    def _init_weight(self, desc, arr):
+        weight = np.zeros(arr.shape, dtype=np.float32)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (ref: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden : 2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize flat fused-RNN parameter vectors (ref: initializer.py FusedRNN)."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=0, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = create(klass, **kwargs)
+        super().__init__(init=init.dumps() if init else None, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init or Uniform(0.07)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        # initialize whole flat vector with the inner init, then set LSTM
+        # forget-gate biases; layout matches ops/nn.py rnn() unpacking.
+        flat = np.random.uniform(-0.07, 0.07, arr.shape).astype(np.float32)
+        H = self._num_hidden
+        L = self._num_layers
+        D = 2 if self._bidirectional else 1
+        ngates = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[self._mode]
+        if self._mode == "lstm":
+            total = arr.shape[0]
+            bias_total = L * D * 2 * ngates * H
+            off = total - bias_total
+            for _ in range(L * D):
+                flat[off + H : off + 2 * H] = self._forget_bias  # b_ih forget
+                off += ngates * H
+                off += ngates * H  # skip b_hh
+        self._set(arr, flat)
+
+
+class Mixed:
+    """Pattern→initializer dispatch (ref: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.search(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("no initializer pattern matches %r" % str(name))
+
+
+class Load:
+    """Init from saved dict with fallback (ref: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray.utils import load as nd_load
+
+            param = nd_load(param)
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()
+        }
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            self.param[name].copyto(arr)
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError("cannot init %r: not found and no default" % name)
+
+
+# registry aliases matching the reference's registered names
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+_INIT_REGISTRY["xavier"] = Xavier
+_INIT_REGISTRY["msra_prelu"] = MSRAPrelu
+_INIT_REGISTRY["lstmbias"] = LSTMBias
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    klass = _INIT_REGISTRY.get(name.lower())
+    if klass is None:
+        raise MXNetError("unknown initializer %r" % name)
+    return klass(**kwargs)
+
+
+# `mx.init.*` namespace shim
+class init:
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Orthogonal = Orthogonal
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    FusedRNN = FusedRNN
+    Mixed = Mixed
+    Load = Load
+    Initializer = Initializer
+    InitDesc = InitDesc

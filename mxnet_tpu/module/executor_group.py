@@ -1,0 +1,221 @@
+"""DataParallelExecutorGroup — per-device executors + batch slicing.
+
+Reference counterpart: ``python/mxnet/module/executor_group.py`` (:65
+_split_input_slice/_load_data, :128 class). On TPU, single-device groups
+dominate (mesh sharding happens inside the compiled step); the multi-ctx
+path mirrors the reference so unit tests can treat N cpu contexts as
+distinct devices (SURVEY §4 'fakes').
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..executor import simple_bind
+from ..io import DataDesc
+from ..ndarray import ndarray as nd
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice batch among devices proportionally (ref: executor_group.py:65)."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size smaller than device count")
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = set(state_names or [])
+        self.logger = logger
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.data_names = [d.name if isinstance(d, DataDesc) else d[0] for d in data_shapes]
+        self.label_names = (
+            [l.name if isinstance(l, DataDesc) else l[0] for l in label_shapes]
+            if label_shapes
+            else []
+        )
+
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = (
+                    "null" if (not for_training or name in self.fixed_param_names) else grad_req
+                )
+            elif name in self.data_names:
+                self.grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                self.grad_req[name] = "null"
+
+        self.batch_size = None
+        self.slices = None
+        self.execs = []
+        self._total_data_shapes = None
+        self._total_label_shapes = None
+        self.shared_group = shared_group
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None, reshape=False):
+        self.batch_size = None
+        norm_data = []
+        for d in data_shapes:
+            name, shape = (d.name, d.shape) if isinstance(d, DataDesc) else (d[0], d[1])
+            if self.batch_size is None:
+                self.batch_size = shape[0]
+            norm_data.append((name, tuple(shape)))
+        norm_label = []
+        for l in label_shapes or []:
+            name, shape = (l.name, l.shape) if isinstance(l, DataDesc) else (l[0], l[1])
+            norm_label.append((name, tuple(shape)))
+        self._total_data_shapes = norm_data
+        self._total_label_shapes = norm_label
+
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            n_i = sl.stop - sl.start
+            shapes = {}
+            for name, shape in norm_data + norm_label:
+                shapes[name] = (n_i,) + tuple(shape[1:])
+            shared = shared_group.execs[i] if shared_group is not None else None
+            self.execs.append(
+                simple_bind(self.symbol, ctx, grad_req=self.grad_req, shared_exec=shared, **shapes)
+            )
+        # param arrays: list (per param) of list (per device)
+        self.param_arrays = [
+            [e.arg_dict[name] for e in self.execs] for name in self.param_names
+        ]
+        self.grad_arrays = [
+            [e.grad_dict.get(name) for e in self.execs]
+            for name in self.param_names
+        ]
+        self.data_arrays = [
+            [e.arg_dict[name] for e in self.execs] for name in self.data_names
+        ]
+        self.label_arrays = [
+            [e.arg_dict.get(name) for e in self.execs] for name in self.label_names
+        ]
+        self.aux_arrays = [
+            [e.aux_dict[name] for e in self.execs] for name in self.aux_names
+        ]
+        self.input_grad_arrays = (
+            [[e.grad_dict.get(name) for e in self.execs] for name in self.data_names]
+            if self.inputs_need_grad
+            else []
+        )
+
+    @property
+    def data_shapes(self):
+        return [DataDesc(n, s) for n, s in self._total_data_shapes]
+
+    @property
+    def label_shapes(self):
+        return [DataDesc(n, s) for n, s in self._total_label_shapes]
+
+    def reshape(self, data_shapes, label_shapes):
+        self.bind_exec(data_shapes, label_shapes, self.shared_group)
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params, allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average over devices into the given dicts (ref: executor_group.get_params)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            full = sum(w.asnumpy() for w in block) / len(block)
+            arg_params[name][:] = nd.array(full, dtype=arg_params[name].dtype)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            full = sum(w.asnumpy() for w in block) / len(block)
+            aux_params[name][:] = nd.array(full, dtype=aux_params[name].dtype)
+
+    def _load_slice(self, arrays, targets):
+        """Scatter batch slices to per-device input arrays (ref: _load_data)."""
+        import jax
+
+        for arr, per_dev in zip(arrays, targets):
+            if arr is None:
+                continue
+            for sl, tgt in zip(self.slices, per_dev):
+                if tgt is None:
+                    continue
+                chunk = arr[sl] if (sl.stop - sl.start) != arr.shape[0] else arr
+                if hasattr(chunk, "_data"):
+                    val = chunk._data().astype(tgt._data().dtype)
+                    val = jax.device_put(val, tgt.ctx.jax_device())
+                    tgt._rebind(val)
+                else:
+                    tgt[:] = nd.array(chunk, ctx=tgt.ctx, dtype=tgt.dtype)
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        self._load_slice(data_batch.data, self.data_arrays)
+        if data_batch.label is not None and self.label_names:
+            self._load_slice(data_batch.label, self.label_arrays)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """Fused step — the TPU hot path (one XLA program per device)."""
+        self._load_slice(data_batch.data, self.data_arrays)
+        if data_batch.label is not None and self.label_names:
+            self._load_slice(data_batch.label, self.label_arrays)
+        for e in self.execs:
+            e.forward_backward()
+
+    def backward(self, out_grads=None):
+        for i, e in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                og = [g[self.slices[i]] if g is not None else None for g in out_grads]
+            e.backward(og)
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs] for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [
+                outs[0] if len(outs) == 1 else nd.concatenate(outs, axis=0) for outs in outputs
+            ]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True")
+        grads = [[e.grad_dict.get(n) for e in self.execs] for n in self.data_names]
+        if merge_multi_context:
+            return [g[0] if len(g) == 1 else nd.concatenate(g, axis=0) for g in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        for i, e in enumerate(self.execs):
+            labels_slice = [l[self.slices[i]] if l.shape[0] != (self.slices[i].stop - self.slices[i].start) else l for l in labels]
+            eval_metric.update_dict(
+                dict(zip(self.label_names, labels_slice)),
+                dict(zip(self.symbol.list_outputs(), e.outputs)),
+            )
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            mon.install(e)

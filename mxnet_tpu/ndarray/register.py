@@ -1,0 +1,159 @@
+"""Autogeneration of the ``mx.nd.*`` operator namespace from the registry.
+
+Reference counterpart: ``python/mxnet/ndarray/register.py:29-156`` +
+``base.py:452-584`` (_init_op_module enumerating C-registered ops and
+code-generating python wrappers). Here the registry is in-process, so
+"generation" is building closures; namespaces (``_contrib_``, ``_linalg_``,
+``_random_``/``_sample_``) land in submodule objects exactly like the
+reference's ``mx.nd.contrib``/``linalg``/``random``.
+"""
+from __future__ import annotations
+
+import types
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke
+
+
+def _tensor_like(v):
+    import numpy as _np
+
+    return isinstance(v, NDArray) or isinstance(v, _np.ndarray) or (
+        type(v).__module__.startswith("jax")
+    )
+
+
+def _make_op_func(op):
+    input_names = op.input_names
+    var_inputs = op.var_inputs
+
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        if var_inputs:
+            tensors = [a for a in args if isinstance(a, NDArray)]
+            attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+            attrs.pop("num_args", None)
+        else:
+            # merge positional + named tensors into signature order; scalar
+            # positionals map onto attr slots in signature order (parity with
+            # generated-code signatures like random.uniform(low, high, shape))
+            slots = {}
+            attrs = {}
+            for k, v in kwargs.items():
+                if k in input_names:
+                    slots[k] = v
+                else:
+                    attrs[k] = v
+            pos_tensors = [a for a in args if _tensor_like(a)]
+            pos_scalars = [a for a in args if not _tensor_like(a)]
+            tensors = []
+            qi = 0
+            for nm in input_names:
+                if nm in slots:
+                    tensors.append(slots[nm])
+                elif qi < len(pos_tensors):
+                    tensors.append(pos_tensors[qi])
+                    qi += 1
+                else:
+                    tensors.append(None)
+            if qi < len(pos_tensors):
+                raise MXNetError(
+                    "op %s: too many positional tensor args (%d given, takes %d)"
+                    % (op.name, len(pos_tensors), len(input_names))
+                )
+            if pos_scalars:
+                attr_order = list(op.attr_defaults.keys())
+                si = 0
+                for val in pos_scalars:
+                    while si < len(attr_order) and attr_order[si] in attrs:
+                        si += 1
+                    if si >= len(attr_order):
+                        raise MXNetError("op %s: too many positional args" % op.name)
+                    attrs[attr_order[si]] = val
+                    si += 1
+            # drop trailing missing optionals
+            while tensors and tensors[-1] is None:
+                tensors.pop()
+        return invoke(op, tensors, attrs, out=out)
+
+    generic_op.__name__ = op.name
+    generic_op.__doc__ = op.doc
+    return generic_op
+
+
+def populate_module(mod, symbolic=False):
+    """Install every registered op (and alias) as a function on `mod`.
+
+    Namespace routing mirrors the reference: ops named ``_contrib_X`` go to
+    ``mod.contrib.X``, ``_linalg_X`` → ``mod.linalg.X``, ``_random_X`` and
+    ``_sample_X`` → ``mod.random``; everything else lands on ``mod`` (public
+    if no leading underscore, internal otherwise — internal ops still
+    installed, as ``mx.nd._internal`` does).
+    """
+    from ..symbol.register import make_symbol_func
+
+    maker = make_symbol_func if symbolic else _make_op_func
+    sub = {}
+    for ns in ("contrib", "linalg", "random", "sparse", "image"):
+        m = getattr(mod, ns, None)
+        if m is None:
+            m = types.ModuleType(mod.__name__ + "." + ns)
+            setattr(mod, ns, m)
+        sub[ns] = m
+
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        fn = maker(op)
+        fn.__name__ = name
+        target, public = _route(name)
+        if target is None:
+            setattr(mod, name, fn)
+            if name.startswith("_"):
+                continue
+        else:
+            setattr(sub[target], public, fn)
+            # reference also exposes e.g. mx.nd._sample_uniform
+            setattr(mod, name, fn)
+
+    # mx.nd.random.X dispatches scalar params → _random_X, tensor params →
+    # _sample_X (parity: python/mxnet/ndarray/random.py _random_helper)
+    for dist in ("uniform", "normal", "gamma", "exponential", "poisson",
+                 "negative_binomial", "generalized_negative_binomial"):
+        rand_name = "_random_" + dist
+        samp_name = "_sample_" + dist
+        if not (_reg.exists(rand_name) and _reg.exists(samp_name)):
+            continue
+        rand_fn = maker(_reg.get(rand_name))
+        samp_fn = maker(_reg.get(samp_name))
+
+        def dispatcher(*args, _r=rand_fn, _s=samp_fn, **kwargs):
+            has_tensor = any(isinstance(a, NDArray) for a in args) or any(
+                isinstance(v, NDArray) for v in kwargs.values()
+            )
+            return (_s if has_tensor else _r)(*args, **kwargs)
+
+        dispatcher.__name__ = dist
+        setattr(sub["random"], dist, dispatcher)
+    if hasattr(sub["random"], "multinomial") is False and _reg.exists("_sample_multinomial"):
+        setattr(sub["random"], "multinomial", maker(_reg.get("_sample_multinomial")))
+    setattr(sub["random"], "randint", getattr(sub["random"], "randint", None) or maker(_reg.get("_random_randint")))
+    setattr(sub["random"], "shuffle", maker(_reg.get("shuffle")))
+    return mod
+
+
+def _route(name):
+    if name.startswith("_contrib_"):
+        return "contrib", name[len("_contrib_"):]
+    if name.startswith("_linalg_"):
+        return "linalg", name[len("_linalg_"):]
+    if name.startswith("_random_"):
+        return "random", name[len("_random_"):]
+    if name.startswith("_sample_"):
+        return "random", name[len("_sample_"):]
+    if name.startswith("_sparse_"):
+        return "sparse", name[len("_sparse_"):]
+    if name.startswith("_image_"):
+        return "image", name[len("_image_"):]
+    return None, name

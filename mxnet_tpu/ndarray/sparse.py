@@ -1,0 +1,221 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Reference counterpart: ``python/mxnet/ndarray/sparse.py`` +
+``src/operator/tensor/cast_storage*`` (SURVEY §2.5 sparse ops). TPU-native
+design: XLA has no sparse tensors, so sparse stypes are *structured dense
+pairs* — (indices, values) — with dense fallbacks (the reference's own
+``kFComputeFallback`` dispatch, op_attr_types.h:107-117, made the same
+move). This covers the kvstore row-sparse path and sparse optimizer tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array, invoke, zeros as nd_zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux", "_full_shape")
+
+    @property
+    def stype(self):
+        return self._stype
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) pair: values[i] is row indices[i] of the dense view."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(None, ctx=ctx)
+        self._aux = {"values": data, "indices": indices}
+        self._full_shape = tuple(shape)
+        self._stype = "row_sparse"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def dtype(self):
+        return self._aux["values"].dtype
+
+    @property
+    def data(self):
+        return self._aux["values"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    def _data(self):
+        return self.tostype("default")._data()
+
+    def _rebind_sparse(self, other):
+        self._aux = other._aux
+        self._full_shape = other._full_shape
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype != "default":
+            raise MXNetError("cannot convert row_sparse to %r" % stype)
+        import jax.numpy as jnp
+
+        vals = self._aux["values"]._jax
+        idx = self._aux["indices"]._jax.astype(jnp.int32)
+        dense = jnp.zeros(self._full_shape, dtype=vals.dtype)
+        dense = dense.at[idx].set(vals)
+        return NDArray(dense, ctx=self._ctx)
+
+    def asnumpy(self):
+        return np.asarray(self.tostype("default")._data())
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._rebind_sparse(
+                RowSparseNDArray(self.data.copy(), self.indices.copy(), self._full_shape, ctx=other._ctx)
+            )
+            return other
+        return self.tostype("default").copyto(other)
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(), self._full_shape, ctx=self._ctx)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % ("x".join(map(str, self._full_shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """CSR: (data, indices, indptr)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(None, ctx=ctx)
+        self._aux = {"values": data, "indices": indices, "indptr": indptr}
+        self._full_shape = tuple(shape)
+        self._stype = "csr"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def dtype(self):
+        return self._aux["values"].dtype
+
+    @property
+    def data(self):
+        return self._aux["values"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def indptr(self):
+        return self._aux["indptr"]
+
+    def _data(self):
+        return self.tostype("default")._data()
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype != "default":
+            raise MXNetError("cannot convert csr to %r" % stype)
+        import jax.numpy as jnp
+
+        vals = np.asarray(self._aux["values"]._jax)
+        idx = np.asarray(self._aux["indices"]._jax).astype(np.int64)
+        ptr = np.asarray(self._aux["indptr"]._jax).astype(np.int64)
+        dense = np.zeros(self._full_shape, dtype=vals.dtype)
+        for r in range(self._full_shape[0]):
+            cols = idx[ptr[r] : ptr[r + 1]]
+            dense[r, cols] = vals[ptr[r] : ptr[r + 1]]
+        return array(dense, ctx=self._ctx)
+
+    def asnumpy(self):
+        return np.asarray(self.tostype("default")._data())
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % ("x".join(map(str, self._full_shape)), self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data if isinstance(data, NDArray) else array(data, ctx=ctx, dtype=dtype)
+        indices = indices if isinstance(indices, NDArray) else array(indices, ctx=ctx, dtype=np.int64)
+        if shape is None:
+            raise MXNetError("row_sparse_array: shape required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape, ctx=ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data if isinstance(data, NDArray) else array(data, ctx=ctx, dtype=dtype)
+        indices = indices if isinstance(indices, NDArray) else array(indices, ctx=ctx, dtype=np.int64)
+        indptr = indptr if isinstance(indptr, NDArray) else array(indptr, ctx=ctx, dtype=np.int64)
+        if shape is None:
+            raise MXNetError("csr_matrix: shape required with (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr, stype):
+    """Dense↔sparse conversion (ref: src/operator/tensor/cast_storage-inl.h)."""
+    if stype == "default":
+        return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
+    dense = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        vals = dense[nz_rows]
+        return RowSparseNDArray(
+            array(vals, ctx=arr.ctx), array(nz_rows.astype(np.int64), ctx=arr.ctx),
+            dense.shape, ctx=arr.ctx,
+        )
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices = []
+        vals = []
+        for r in range(dense.shape[0]):
+            cols = np.nonzero(dense[r])[0]
+            indices.extend(cols.tolist())
+            vals.extend(dense[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(
+            array(np.asarray(vals, dtype=dense.dtype), ctx=arr.ctx),
+            array(np.asarray(indices, dtype=np.int64), ctx=arr.ctx),
+            array(np.asarray(indptr, dtype=np.int64), ctx=arr.ctx),
+            dense.shape, ctx=arr.ctx,
+        )
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if stype == "default":
+        return nd_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            array(np.zeros((0,) + tuple(shape[1:]), dtype=dtype or np.float32), ctx=ctx),
+            array(np.zeros((0,), dtype=np.int64), ctx=ctx),
+            shape, ctx=ctx,
+        )
+    if stype == "csr":
+        return CSRNDArray(
+            array(np.zeros((0,), dtype=dtype or np.float32), ctx=ctx),
+            array(np.zeros((0,), dtype=np.int64), ctx=ctx),
+            array(np.zeros((shape[0] + 1,), dtype=np.int64), ctx=ctx),
+            shape, ctx=ctx,
+        )
+    raise MXNetError("unknown stype %r" % stype)

@@ -1,0 +1,44 @@
+"""NDArray serialization.
+
+Reference counterpart: ``NDArray::Save/Load`` (src/ndarray/ndarray.cc binary
+format with magic + per-array Context/TShape/dtype blobs) and
+``python/mxnet/ndarray/utils.py:185-233``. We keep the same *surface*
+(``mx.nd.save``/``mx.nd.load`` of a list or str→NDArray dict, one file) on
+an .npz container — portable, fast, and framework-neutral.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__mx_list_%d"
+
+
+def save(fname, data):
+    """Save a list of NDArrays or a dict of str->NDArray to file."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {}
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise MXNetError("save: values must be NDArrays")
+            payload[k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        payload = {(_LIST_PREFIX % i): v.asnumpy() for i, v in enumerate(data)}
+    else:
+        raise MXNetError("save: data must be NDArray, list, or dict")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`. Returns list or dict."""
+    with _np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith("__mx_list_") for k in keys):
+            n = len(keys)
+            return [array(npz[_LIST_PREFIX % i]) for i in range(n)]
+        return {k: array(npz[k]) for k in keys}

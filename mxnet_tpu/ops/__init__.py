@@ -1,0 +1,8 @@
+"""Operator library package. Importing this registers all built-in ops."""
+from . import registry  # noqa: F401
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import contrib  # noqa: F401
+from .registry import get, list_ops, register  # noqa: F401

@@ -1,0 +1,334 @@
+"""Contrib operators: CTC loss, MultiBox (SSD), proposals, quantization.
+
+Reference surface: ``src/operator/contrib/`` (SURVEY §2.5 — ~15k LoC of
+custom CUDA). These are the genuinely-custom kernels; the first
+implementations here are pure-XLA (scan/vectorized) versions with the same
+semantics; Pallas variants replace the hot ones as optimization rounds
+land (multibox detection NMS, deformable conv).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+@register(name="_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="last"):
+    """CTC negative log-likelihood via log-semiring forward scan.
+
+    data: (T, N, C) unnormalized activations (softmax applied internally,
+    matching ref warp-ctc semantics, src/operator/contrib/ctc_loss.cc);
+    label: (N, L) class indices (padded with -1 or 0 when using lengths).
+    """
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)  # (T,N,C)
+
+    blank = C - 1 if blank_label == "last" else 0
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        # labels are 1-based when blank is first (ref convention)
+        lab = lab - 1
+
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # count non-padding (padding assumed <0 or ==0 per ref; use >=0 & valid)
+        lab_len = jnp.sum((lab >= 0) & (lab < C), axis=1).astype(jnp.int32)
+    if use_data_lengths and data_lengths is not None:
+        seq_len = data_lengths.astype(jnp.int32)
+    else:
+        seq_len = jnp.full((N,), T, dtype=jnp.int32)
+
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.clip(lab, 0, C - 1))
+    pos = jnp.arange(S)[None, :]  # (1,S)
+    valid_ext = pos < (2 * lab_len[:, None] + 1)
+
+    # transition allowed from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((N, 2), -1, dtype=jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # alpha init: alpha[0] = logp[0, blank], alpha[1] = logp[0, l1]
+    batch = jnp.arange(N)
+    init = jnp.full((N, S), _NEG_INF)
+    init = init.at[:, 0].set(logp[0, batch, ext[:, 0]])
+    init = init.at[:, 1].set(jnp.where(lab_len > 0, logp[0, batch, ext[:, 1]], _NEG_INF))
+
+    def step(alpha, t):
+        a_shift1 = jnp.concatenate([jnp.full((N, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((N, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        a_new = jnp.logaddexp(alpha, a_shift1)
+        a_new = jnp.where(can_skip, jnp.logaddexp(a_new, a_shift2), a_new)
+        emit = logp[t, batch[:, None], ext]  # (N,S)
+        a_new = a_new + emit
+        a_new = jnp.where(valid_ext, a_new, _NEG_INF)
+        # freeze past sequence end
+        active = (t < seq_len)[:, None]
+        a_new = jnp.where(active, a_new, alpha)
+        return a_new, None
+
+    alpha, _ = lax.scan(step, init, jnp.arange(1, T))
+    end1 = 2 * lab_len  # last blank
+    end2 = jnp.maximum(2 * lab_len - 1, 0)
+    ll = jnp.logaddexp(
+        alpha[batch, end1],
+        jnp.where(lab_len > 0, alpha[batch, end2], _NEG_INF),
+    )
+    return -ll
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox ops (ref: src/operator/contrib/multibox_*.cc/.cu)
+# ---------------------------------------------------------------------------
+@register(name="_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",), nondiff=True)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor-box generation (ref: multibox_prior.cc). Pure XLA."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (tuple, list)) else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if isinstance(ratios, (tuple, list)) else (ratios,)))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1).reshape(-1, 2)  # (h*w, 2)
+
+    # anchors: sizes[0] with each ratio + other sizes with ratio 1 (ref layout:
+    # n_anchors = len(sizes) + len(ratios) - 1)
+    whs = []
+    for s in sizes:
+        whs.append((s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r)))
+    whs = jnp.asarray(whs)  # (A, 2) — (w, h)
+
+    n_anchor = whs.shape[0]
+    centers = jnp.repeat(cyx, n_anchor, axis=0)  # (h*w*A, 2) [cy, cx]
+    dims = jnp.tile(whs, (h * w, 1))  # (h*w*A, 2) [w, h]
+    xmin = centers[:, 1] - dims[:, 0] / 2
+    ymin = centers[:, 0] - dims[:, 1] / 2
+    xmax = centers[:, 1] + dims[:, 0] / 2
+    ymax = centers[:, 0] + dims[:, 1] / 2
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None]  # (1, h*w*A, 4)
+
+
+@register(name="_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",), num_outputs=3, nondiff=True)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→GT matching + box target encoding (ref: multibox_target.cc).
+
+    anchor: (1, A, 4); label: (N, M, 5) [cls, xmin, ymin, xmax, ymax];
+    cls_pred: (N, C, A). Outputs: box_target (N, A*4), box_mask (N, A*4),
+    cls_target (N, A).
+    """
+    A = anchor.shape[1]
+    N, M, _ = label.shape
+    anc = anchor[0]  # (A,4)
+
+    def iou(boxes_a, boxes_b):
+        # a: (A,4), b: (M,4) → (A,M)
+        ax1, ay1, ax2, ay2 = boxes_a[:, 0:1], boxes_a[:, 1:2], boxes_a[:, 2:3], boxes_a[:, 3:4]
+        bx1, by1, bx2, by2 = boxes_b[:, 0], boxes_b[:, 1], boxes_b[:, 2], boxes_b[:, 3]
+        ix1 = jnp.maximum(ax1, bx1[None, :])
+        iy1 = jnp.maximum(ay1, by1[None, :])
+        ix2 = jnp.minimum(ax2, bx2[None, :])
+        iy2 = jnp.minimum(ay2, by2[None, :])
+        iw = jnp.maximum(ix2 - ix1, 0)
+        ih = jnp.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0)
+        area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0)
+        union = area_a + area_b[None, :] - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    def encode(anc, gt):
+        # center-size encoding with variances
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = gt[:, 2] - gt[:, 0]
+        gh = gt[:, 3] - gt[:, 1]
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-8), 1e-8)) / variances[2]
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-8), 1e-8)) / variances[3]
+        return jnp.stack([tx, ty, tw, th], axis=1)
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        ious = iou(anc, lab[:, 1:5]) * valid[None, :]  # (A,M)
+        best_gt = jnp.argmax(ious, axis=1)  # (A,)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(ious, axis=0)  # (M,)
+        forced = jnp.zeros((A,), dtype=bool)
+        forced = forced.at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((A,), dtype=jnp.int32)
+        forced_gt = forced_gt.at[best_anchor].set(jnp.arange(M, dtype=jnp.int32))
+        use_gt = jnp.where(forced, forced_gt, best_gt)
+        pos = matched | forced
+        gt_boxes = lab[use_gt, 1:5]
+        targets = encode(anc, gt_boxes)
+        cls_t = jnp.where(pos, lab[use_gt, 0] + 1.0, 0.0)
+        box_t = jnp.where(pos[:, None], targets, 0.0).reshape(-1)
+        box_m = jnp.where(pos[:, None], 1.0, 0.0) * jnp.ones((A, 4))
+        return box_t, box_m.reshape(-1), cls_t
+
+    box_target, box_mask, cls_target = jax.vmap(per_sample)(label)
+    return box_target, box_mask, cls_target
+
+
+@register(name="_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",), nondiff=True)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (ref: multibox_detection.cc). Vectorized XLA NMS."""
+    N, C, A = cls_prob.shape
+    anc = anchor[0]
+
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def decode(loc):
+        loc = loc.reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes
+
+    def box_iou(b):
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        union = area[:, None] + area[None, :] - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    def per_sample(probs, loc):
+        boxes = decode(loc)  # (A,4)
+        # best non-background class per anchor
+        fg = jnp.concatenate(
+            [probs[:background_id], probs[background_id + 1 :]], axis=0
+        )  # (C-1, A)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)  # 0-based fg class
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        score_s = score[order]
+        cls_s = cls_id[order]
+        keep_s = keep[order]
+        ious = box_iou(boxes_s)
+        same_cls = (cls_s[:, None] == cls_s[None, :]) | force_suppress
+        # suppressed if any earlier kept box overlaps > nms_threshold
+        sup_matrix = (ious > nms_threshold) & same_cls & (
+            jnp.arange(A)[None, :] < jnp.arange(A)[:, None]
+        )
+
+        def body(i, kept):
+            sup = jnp.any(sup_matrix[i] & kept, where=None) if False else jnp.any(
+                jnp.where(sup_matrix[i], kept, False)
+            )
+            return kept.at[i].set(keep_s[i] & ~sup)
+
+        kept = lax.fori_loop(0, A, body, jnp.zeros((A,), dtype=bool))
+        out_cls = jnp.where(kept, cls_s, -1.0)
+        return jnp.concatenate(
+            [out_cls[:, None], score_s[:, None], boxes_s], axis=1
+        )  # (A, 6)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# quantization experiments (ref: src/operator/contrib/quantize.cc)
+# ---------------------------------------------------------------------------
+@register(name="_contrib_quantize", num_outputs=3, nondiff=True)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    r_min = min_range.reshape(())
+    r_max = max_range.reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(r_max - r_min, 1e-8)
+        q = jnp.clip(jnp.round((data - r_min) * scale), 0, 255).astype(jnp.uint8)
+    else:
+        scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(r_min), jnp.abs(r_max)), 1e-8)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, r_min.reshape(1), r_max.reshape(1)
+
+
+@register(name="_contrib_dequantize", nondiff=True)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    r_min = min_range.reshape(())
+    r_max = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (r_max - r_min) / 255.0
+        return data.astype(jnp.float32) * scale + r_min
+    scale = jnp.maximum(jnp.abs(r_min), jnp.abs(r_max)) / 127.0
+    return data.astype(jnp.float32) * scale
+
+
+@register(name="_contrib_count_sketch", nondiff=True)
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (ref: src/operator/contrib/count_sketch.cc)."""
+    n, in_dim = data.shape
+    od = int(out_dim)
+    hh = h.reshape(-1).astype(jnp.int32) % od
+    ss = s.reshape(-1)
+    out = jnp.zeros((n, od), dtype=data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+@register(name="_contrib_fft", nondiff=True)
+def fft(data, compute_size=128):
+    """ref: src/operator/contrib/fft.cc (cuFFT) → XLA fft. Output packs
+    real/imag interleaved along last dim like the reference."""
+    out = jnp.fft.fft(data, axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(data.shape[:-1] + (-1,)).astype(jnp.float32)
+
+
+@register(name="_contrib_ifft", nondiff=True)
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    cplx = data.reshape(data.shape[:-1] + (n, 2))
+    comp = cplx[..., 0] + 1j * cplx[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+# 2-bit gradient compression kernels (ref: src/kvstore/gradient_compression-inl.h)
+@register(name="_contrib_quantize_2bit", nondiff=True, num_outputs=2)
+def quantize_2bit(grad, residual, threshold=0.5):
+    g = grad + residual
+    q = jnp.where(g >= threshold, threshold, jnp.where(g <= -threshold, -threshold, 0.0))
+    return q.astype(grad.dtype), (g - q).astype(grad.dtype)
+
+
+@register(name="_contrib_dequantize_2bit", nondiff=True)
+def dequantize_2bit(data, threshold=0.5):
+    return data

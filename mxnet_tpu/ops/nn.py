@@ -1,0 +1,834 @@
+"""Neural-net operators: conv, pooling, norm, activation, softmax, dropout, RNN.
+
+Reference surface: ``src/operator/nn/`` + legacy v1 layers (SURVEY §2.5,
+~45k LoC of mshadow/cuDNN kernels). TPU-native design: convolutions lower to
+``lax.conv_general_dilated`` which XLA tiles onto the MXU — the cuDNN
+autotuning registry (cudnn_algoreg-inl.h) has no equivalent because XLA
+selects the schedule. Layouts: MXNet is NCHW-first; we accept NCHW at the
+API and let XLA pick internal layouts. The fused RNN op (ref
+cudnn_rnn-inl.h:41-175) is a ``lax.scan`` over time — one XLA while-loop,
+the moral equivalent of a cuDNN persistent kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+
+def _pair(x, n=2):
+    if isinstance(x, (int, float)):
+        return (int(x),) * n
+    t = tuple(int(v) for v in x)
+    if len(t) == 0:
+        return (1,) * n
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Convolution (ref: src/operator/nn/convolution.cc; im2col never needed — MXU)
+# ---------------------------------------------------------------------------
+@register(name="Convolution", aliases=("convolution", "Convolution_v1"))
+def convolution(
+    data,
+    weight,
+    bias=None,
+    kernel=(),
+    stride=(),
+    dilate=(),
+    pad=(),
+    num_filter=1,
+    num_group=1,
+    workspace=1024,
+    no_bias=False,
+    cudnn_tune=None,
+    cudnn_off=False,
+    layout=None,
+):
+    nd = len(kernel) if kernel else data.ndim - 2
+    stride = _pair(stride, nd)
+    dilate = _pair(dilate, nd)
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    pads = tuple((p, p) for p in pad)
+    if nd == 1:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, ("NCH", "OIH", "NCH"))
+    elif nd == 3:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=pads,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register(name="Deconvolution", aliases=("deconvolution",))
+def deconvolution(
+    data,
+    weight,
+    bias=None,
+    kernel=(),
+    stride=(),
+    dilate=(),
+    pad=(),
+    adj=(),
+    target_shape=(),
+    num_filter=1,
+    num_group=1,
+    workspace=1024,
+    no_bias=True,
+    cudnn_tune=None,
+    cudnn_off=False,
+    layout=None,
+):
+    """Transposed convolution (ref: src/operator/nn/deconvolution.cc).
+
+    Implemented as the gradient of Convolution wrt its input — which is
+    exactly what conv_transpose computes; XLA maps it to the MXU.
+    """
+    nd = len(kernel) if kernel else 2
+    stride = _pair(stride, nd)
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    dilate = _pair(dilate, nd) if dilate else (1,) * nd
+    adj = _pair(adj, nd) if adj else (0,) * nd
+    kernel = _pair(kernel, nd)
+    # lax.conv_transpose with explicit padding chosen to invert Convolution
+    pads = tuple(
+        (k - 1 - p, k - 1 - p + a)
+        for k, p, a in zip(
+            tuple((kk - 1) * dd + 1 for kk, dd in zip(kernel, dilate)), pad, adj
+        )
+    )
+    # weight layout (in_ch, out_ch/group, *kernel) — same as reference
+    ich = data.shape[1]
+    g = int(num_group)
+    if g > 1:
+        data_g = data.reshape((data.shape[0], g, ich // g) + data.shape[2:])
+        outs = []
+        wg = weight.reshape((g, ich // g) + weight.shape[1:])
+        for gi in range(g):
+            outs.append(
+                _deconv_single(data_g[:, gi], wg[gi], stride, pads, dilate)
+            )
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv_single(data, weight, stride, pads, dilate)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_single(data, weight, stride, pads, dilate):
+    nd = len(stride)
+    spec = ("NCH", "IOH", "NCH") if nd == 1 else (
+        ("NCHW", "IOHW", "NCHW") if nd == 2 else ("NCDHW", "IODHW", "NCDHW")
+    )
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
+    return lax.conv_general_dilated(
+        data,
+        jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+@register(name="FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=1, no_bias=False, flatten=True):
+    if flatten:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        x = data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc + pool.h/.cuh)
+# ---------------------------------------------------------------------------
+@register(name="Pooling", aliases=("pooling", "Pooling_v1"))
+def pooling(
+    data,
+    kernel=(),
+    pool_type="max",
+    global_pool=False,
+    cudnn_off=False,
+    pooling_convention="valid",
+    stride=(),
+    pad=(),
+):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode output: pad on the high side so every input elem is covered
+        pads = (0, 0), (0, 0)
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i]
+            out_sz = int(np.ceil((size + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
+            extra.append((pad[i], max(needed, pad[i])))
+        pads = ((0, 0), (0, 0)) + tuple(extra)
+    else:
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        # count_include_pad=True semantics (mxnet default)
+        denom = 1
+        for k in kernel:
+            denom *= k
+        return summed / denom
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+@register(name="Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register(name="LeakyReLU", aliases=("leaky_relu",), needs_rng=True)
+def leaky_relu(key, data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334, __is_train__=False):
+    """ref: src/operator/leaky_relu.cc — leaky/prelu/elu/selu/rrelu/gelu."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        lo, hi = float(lower_bound), float(upper_bound)
+        if __is_train__:
+            s = jax.random.uniform(key, data.shape, minval=lo, maxval=hi).astype(data.dtype)
+        else:
+            # inference uses the deterministic mean slope (reference parity)
+            s = jnp.asarray((lo + hi) / 2.0, data.dtype)
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+# ---------------------------------------------------------------------------
+# softmax family (ref: src/operator/nn/softmax-inl.h, softmax_output.cc)
+# ---------------------------------------------------------------------------
+@register(name="softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    x = data if temperature in (None, "None", 1.0) else data / float(temperature)
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register(name="log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data if temperature in (None, "None", 1.0) else data / float(temperature)
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register(name="softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    return -jnp.sum(jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1))
+
+
+@register(name="SoftmaxActivation", aliases=("softmax_activation",))
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register(name="SoftmaxOutput", aliases=("softmax_output", "Softmax"))
+def softmax_output(
+    data,
+    label,
+    grad_scale=1.0,
+    ignore_label=-1.0,
+    multi_output=False,
+    use_ignore=False,
+    preserve_shape=False,
+    normalization="null",
+    out_grad=False,
+    smooth_alpha=0.0,
+):
+    """Fused softmax + CE-grad head (ref: src/operator/softmax_output.cc).
+
+    Forward emits softmax probabilities; the custom backward produces
+    (p - onehot(label)) * grad_scale, matching the reference's fused loss
+    semantics (label input gets zero grad). Attrs are closed over (not
+    traced) so the custom_vjp only sees arrays.
+    """
+    multi_output = bool(multi_output)
+    use_ignore = bool(use_ignore)
+    preserve_shape = bool(preserve_shape)
+
+    def fwd_only(d):
+        if multi_output:
+            return jax.nn.softmax(d, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(d, axis=-1)
+        return jax.nn.softmax(d.reshape(d.shape[0], -1), axis=-1).reshape(d.shape)
+
+    @jax.custom_vjp
+    def f(d, l):
+        return fwd_only(d)
+
+    def so_fwd(d, l):
+        out = fwd_only(d)
+        return out, (out, l)
+
+    def so_bwd(res, g):
+        out, lab_arr = res
+        if multi_output:
+            lab = lab_arr.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype, axis=1)
+            if smooth_alpha:
+                k = out.shape[1]
+                onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - onehot)
+            grad = out - onehot
+            if use_ignore:
+                mask = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * jnp.expand_dims(mask, 1)
+            denom = 1.0
+            if normalization == "batch":
+                denom = out.shape[0]
+            elif normalization == "valid" and use_ignore:
+                denom = jnp.maximum((lab_arr != ignore_label).sum().astype(out.dtype), 1.0)
+            grad = grad * (grad_scale / denom)
+        else:
+            flat = out.reshape(out.shape[0], -1)
+            lab = lab_arr.reshape(-1).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, flat.shape[1], dtype=out.dtype)
+            if smooth_alpha:
+                k = flat.shape[1]
+                onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - onehot)
+            grad = flat - onehot
+            if use_ignore:
+                mask = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * mask[:, None]
+            denom = 1.0
+            if normalization == "batch":
+                denom = out.shape[0]
+            elif normalization == "valid" and use_ignore:
+                denom = jnp.maximum((lab != int(ignore_label)).sum().astype(out.dtype), 1.0)
+            grad = (grad * (grad_scale / denom)).reshape(out.shape)
+        return (grad, jnp.zeros_like(lab_arr))
+
+    f.defvjp(so_fwd, so_bwd)
+    return f(data, label)
+
+
+@register(name="SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0, use_linear=False):
+    use_linear = bool(use_linear)
+    reg = regularization_coefficient
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def svm_fwd(d, l):
+        return d, (d, l)
+
+    def svm_bwd(res, g):
+        d, l = res
+        lab = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, d.shape[1], dtype=d.dtype)
+        score_correct = jnp.take_along_axis(d, lab[:, None], axis=1)
+        viol = (margin - (score_correct - d)) > 0
+        viol = jnp.logical_and(viol, onehot == 0)
+        if use_linear:
+            gwrong = jnp.where(viol, reg, 0.0).astype(d.dtype)
+        else:
+            gwrong = jnp.where(viol, 2 * reg * (margin - (score_correct - d)), 0.0).astype(d.dtype)
+        gright = -jnp.sum(gwrong, axis=1, keepdims=True) * onehot
+        return (gwrong * (1 - onehot) + gright, jnp.zeros_like(l))
+
+    f.defvjp(svm_fwd, svm_bwd)
+    return f(data, label)
+
+
+@register(name="LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "linear")
+
+
+@register(name="MAERegressionOutput", aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "mae")
+
+
+@register(name="LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "logistic")
+
+
+def _regression_out(data, label, grad_scale, kind):
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.sigmoid(d) if kind == "logistic" else d
+
+    def fwd(d, l):
+        return f(d, l), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        l = l.reshape(d.shape)
+        if kind == "linear":
+            grad = d - l
+        elif kind == "mae":
+            grad = jnp.sign(d - l)
+        else:
+            grad = jax.nn.sigmoid(d) - l
+        return (grad * grad_scale, jnp.zeros_like(l))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register(name="make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def ml_fwd(d):
+        return d, (d.shape, d.dtype)
+
+    def ml_bwd(res, g):
+        shape, dtype = res
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / shape[0]
+        return (jnp.full(shape, scale, dtype=dtype),)
+
+    f.defvjp(ml_fwd, ml_bwd)
+    return f(data)
+
+
+@register(name="BlockGrad", aliases=("block_grad", "stop_gradient"))
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (ref: src/operator/nn/batch_norm.cc, layer_norm, instance_norm,
+# l2_normalization, lrn)
+# ---------------------------------------------------------------------------
+@register(
+    name="BatchNorm",
+    aliases=("batch_norm", "BatchNorm_v1"),
+    num_outputs=3,
+    num_visible_outputs=1,
+    mutate_inputs=(3, 4),
+)
+def batch_norm(
+    data,
+    gamma,
+    beta,
+    moving_mean,
+    moving_var,
+    eps=1e-3,
+    momentum=0.9,
+    fix_gamma=True,
+    use_global_stats=False,
+    output_mean_var=False,
+    axis=1,
+    cudnn_off=False,
+    __is_train__=False,
+):
+    """BatchNorm with running-stat update.
+
+    Outputs (out, batch_mean, batch_var); the imperative/executor layer
+    handles the moving-stat mutation (ref: batch norm mutates aux states
+    src/operator/nn/batch_norm.cc). In training mode uses batch statistics;
+    in inference uses moving stats (use_global_stats forces the latter).
+    """
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if __is_train__ and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean = moving_mean
+        var = moving_var
+    inv = lax.rsqrt(var.reshape(bshape) + eps)
+    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register(name="LayerNorm", aliases=("layer_norm",), num_outputs=3, num_visible_outputs=1)
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+
+
+@register(name="InstanceNorm", aliases=("instance_norm",))
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register(name="L2Normalization", aliases=("l2_normalization",))
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        norm = jnp.sqrt(jnp.sum(jnp.square(data.reshape(data.shape[0], -1)), axis=1) + eps)
+        return data / norm.reshape((-1,) + (1,) * (data.ndim - 1))
+    if mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+        return data / norm
+    if mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+        return data / norm
+    raise ValueError(mode)
+
+
+@register(name="LRN", aliases=("lrn",))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (ref: src/operator/lrn.cc)."""
+    half = int(nsize) // 2
+    sq = jnp.square(data)
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = sum(
+        padded[:, i : i + data.shape[1]] for i in range(int(nsize))
+    )
+    return data * jnp.power(knorm + alpha / nsize * window, -beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: src/operator/nn/dropout.cc) — functional PRNG
+# ---------------------------------------------------------------------------
+@register(name="Dropout", aliases=("dropout",), needs_rng=True, num_outputs=2, num_visible_outputs=1)
+def dropout(key, data, p=0.5, mode="training", axes=(), __is_train__=False):
+    if not __is_train__ and mode != "always":
+        return data, jnp.ones_like(data)
+    if p <= 0.0:
+        return data, jnp.ones_like(data)
+    shape = list(data.shape)
+    for ax in axes or ():
+        shape[int(ax)] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype) / keep
+    return data * mask, jnp.broadcast_to(mask, data.shape)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / crop (ref: src/operator/upsampling.cc, crop.cc)
+# ---------------------------------------------------------------------------
+@register(name="UpSampling", aliases=("up_sampling",))
+def upsampling(*args, scale=1, num_filter=0, sample_type="nearest", multi_input_mode="concat", num_args=1, workspace=512):
+    s = int(scale)
+    if sample_type == "nearest":
+        outs = []
+        h = max(a.shape[2] for a in args) * s // (s if len(args) == 1 else 1)
+        for a in args:
+            factor = s if len(args) == 1 else (h // a.shape[2])
+            o = jnp.repeat(jnp.repeat(a, factor, axis=2), factor, axis=3)
+            outs.append(o)
+        if len(outs) == 1:
+            return outs[0]
+        if multi_input_mode == "sum":
+            return sum(outs)
+        return jnp.concatenate(outs, axis=1)
+    if sample_type == "bilinear":
+        data, weight = args[0], args[1]
+        n, c, h, w = data.shape
+        return jax.image.resize(data, (n, c, h * s, w * s), method="bilinear")
+    raise ValueError(sample_type)
+
+
+@register(name="Crop", aliases=("crop",))
+def crop_op(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    data = args[0]
+    if len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if center_crop:
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy : oy + th, ox : ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# Correlation / grid ops (legacy vision)
+# ---------------------------------------------------------------------------
+@register(name="GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        ys = jnp.linspace(-1, 1, th)
+        xs = jnp.linspace(-1, 1, tw)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)  # (3, H*W)
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, grid)  # (N,2,H*W)
+        return out.reshape(-1, 2, th, tw)
+    # warp: data is flow (N,2,H,W)
+    n, _, h, w = data.shape
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)
+    x = (data[:, 0] + gx) * 2 / max(w - 1, 1) - 1
+    y = (data[:, 1] + gy) * 2 / max(h - 1, 1) - 1
+    return jnp.stack([x, y], axis=1)
+
+
+@register(name="BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """ref: src/operator/bilinear_sampler.cc — sample data at grid coords."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0 = 1 - wx1
+    wy0 = 1 - wy1
+
+    # vectorized gather: build (N, Ho, Wo) index maps, gather per channel
+    batch_idx = jnp.arange(n).reshape(n, 1, 1)
+
+    def gather(xi, yi):
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        valid = ((xi >= -0.0001) & (xi <= w - 0.9999) & (yi >= -0.0001) & (yi <= h - 0.9999))
+        vals = data[batch_idx, :, yi_c, xi_c]  # (N, Ho, Wo, C)
+        return vals * valid[..., None].astype(data.dtype)
+
+    out = (
+        gather(x0, y0) * (wx0 * wy0)[..., None]
+        + gather(x1, y0) * (wx1 * wy0)[..., None]
+        + gather(x0, y1) * (wx0 * wy1)[..., None]
+        + gather(x1, y1) * (wx1 * wy1)[..., None]
+    )
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register(name="SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine", sampler_type="bilinear", cudnn_off=False):
+    grid = grid_generator(loc, transform_type="affine", target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (ref: src/operator/rnn-inl.h + cudnn_rnn-inl.h → lax.scan)
+# ---------------------------------------------------------------------------
+@register(
+    name="RNN",
+    aliases=("rnn",),
+    needs_rng=True,
+    num_outputs=lambda attrs: 3 if attrs.get("mode") == "lstm" else 2,
+    num_visible_outputs=lambda attrs: (
+        (3 if attrs.get("mode") == "lstm" else 2) if attrs.get("state_outputs") else 1
+    ),
+)
+def rnn(
+    key,
+    data,
+    parameters,
+    state,
+    state_cell=None,
+    state_size=0,
+    num_layers=1,
+    bidirectional=False,
+    mode="lstm",
+    p=0.0,
+    state_outputs=False,
+    __is_train__=False,
+):
+    """Fused multi-layer (bi)RNN over the whole sequence.
+
+    data: (T, N, I); parameters: flat vector packed cuDNN-style
+    (per layer/direction: W_ih, W_hh, b_ih, b_hh for each gate);
+    state: (L*D, N, H). One ``lax.scan`` per layer-direction — XLA compiles
+    the whole unroll into a single while-loop program (the TPU equivalent of
+    cudnnRNNForwardTraining, ref cudnn_rnn-inl.h:41-175).
+    """
+    T, N, I = data.shape
+    H = int(state_size)
+    L = int(num_layers)
+    D = 2 if bidirectional else 1
+    ngates = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
+
+    # unpack flat parameter vector
+    offset = 0
+
+    def take_mat(rows, cols):
+        nonlocal offset
+        m = lax.dynamic_slice(parameters, (offset,), (rows * cols,)).reshape(rows, cols)
+        offset += rows * cols
+        return m
+
+    weights = []
+    for layer in range(L):
+        for d in range(D):
+            in_size = I if layer == 0 else H * D
+            w_ih = take_mat(ngates * H, in_size)
+            w_hh = take_mat(ngates * H, H)
+            weights.append((w_ih, w_hh))
+    biases = []
+    for layer in range(L):
+        for d in range(D):
+            nonloc = offset
+            b_ih = lax.dynamic_slice(parameters, (offset,), (ngates * H,))
+            offset += ngates * H
+            b_hh = lax.dynamic_slice(parameters, (offset,), (ngates * H,))
+            offset += ngates * H
+            biases.append((b_ih, b_hh))
+
+    def cell_step(mode, x_proj, h, c, w_hh, b_hh):
+        gates = x_proj + h @ w_hh.T + b_hh
+        if mode == "lstm":
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        if mode == "gru":
+            # cuDNN gate order: r, z, n
+            xr, xz, xn = jnp.split(x_proj + b_hh * 0, 3, axis=-1)
+            hr, hz, hn = jnp.split(h @ w_hh.T + b_hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return h_new, c
+        act = jnp.maximum if mode == "rnn_relu" else None
+        pre = gates
+        h_new = jnp.maximum(pre, 0) if mode == "rnn_relu" else jnp.tanh(pre)
+        return h_new, c
+
+    x = data
+    h0 = state.reshape(L, D, N, H)
+    c0 = state_cell.reshape(L, D, N, H) if mode == "lstm" and state_cell is not None else jnp.zeros((L, D, N, H), data.dtype)
+    h_last, c_last = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            wi = layer * D + d
+            w_ih, w_hh = weights[wi]
+            b_ih, b_hh = biases[wi]
+            xs = x if d == 0 else jnp.flip(x, axis=0)
+            x_proj = xs @ w_ih.T + b_ih  # (T, N, ngates*H)
+
+            def step(carry, xp, _w=w_hh, _b=b_hh, _m=mode):
+                h, c = carry
+                h2, c2 = cell_step(_m, xp, h, c, _w, _b)
+                return (h2, c2), h2
+
+            (hT, cT), ys = lax.scan(step, (h0[layer, d], c0[layer, d]), x_proj)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_last.append(hT)
+            c_last.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and __is_train__ and layer < L - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape).astype(x.dtype) / (1 - p)
+            x = x * mask
+    hN = jnp.stack(h_last).reshape(L * D, N, H)
+    cN = jnp.stack(c_last).reshape(L * D, N, H)
+    if mode == "lstm":
+        return x, hN, cN
+    return x, hN
+
+
+# ---------------------------------------------------------------------------
+# misc heads
+# ---------------------------------------------------------------------------
+@register(name="Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """Cost-volume correlation (ref: src/operator/correlation.cc)."""
+    pad = int(pad_size)
+    d = int(max_displacement)
+    s2 = int(stride2)
+    a = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    b = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    n, c, h, w = data1.shape
+    offs = range(-d, d + 1, s2)
+    maps = []
+    for dy in offs:
+        for dx in offs:
+            shifted = jnp.roll(b, (-dy, -dx), axis=(2, 3))
+            prod = (a * shifted) if is_multiply else jnp.abs(a - shifted)
+            maps.append(prod.mean(axis=1)[:, pad : pad + h, pad : pad + w])
+    return jnp.stack(maps, axis=1)
+
+
+@register(name="IdentityAttachKLSparseReg", aliases=("identity_attach_kl_sparse_reg",))
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001, momentum=0.9):
+    return data

@@ -1,0 +1,148 @@
+"""Random sampling operators.
+
+Reference surface: ``src/operator/random/`` (sample_op.cc — uniform, normal,
+gamma, exponential, poisson, negative binomial, generalized neg. binomial,
+multinomial; multi-sample variants with per-row distribution parameters).
+TPU-native design: all samplers are functionalized on a JAX PRNG key threaded
+by the invoke layer from the per-context RNG resource (parity with
+ResourceRequest::kRandom, include/mxnet/resource.h:37-58) — deterministic,
+reproducible, and shardable (key folding per device).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None or shape == "None":
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+@register(name="_random_uniform", aliases=("uniform", "random_uniform"), needs_rng=True, nondiff=True)
+def _random_uniform(key, low=0.0, high=1.0, shape=(), ctx=None, dtype="float32"):
+    return jax.random.uniform(key, _shape(shape), minval=low, maxval=high, dtype=jnp.float32).astype(dtype)
+
+
+@register(name="_random_normal", aliases=("normal", "random_normal"), needs_rng=True, nondiff=True)
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), ctx=None, dtype="float32"):
+    return (jax.random.normal(key, _shape(shape)) * scale + loc).astype(dtype)
+
+
+@register(name="_random_gamma", aliases=("random_gamma",), needs_rng=True, nondiff=True)
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), ctx=None, dtype="float32"):
+    return (jax.random.gamma(key, alpha, _shape(shape)) * beta).astype(dtype)
+
+
+@register(name="_random_exponential", aliases=("random_exponential",), needs_rng=True, nondiff=True)
+def _random_exponential(key, lam=1.0, shape=(), ctx=None, dtype="float32"):
+    return (jax.random.exponential(key, _shape(shape)) / lam).astype(dtype)
+
+
+@register(name="_random_poisson", aliases=("random_poisson",), needs_rng=True, nondiff=True)
+def _random_poisson(key, lam=1.0, shape=(), ctx=None, dtype="float32"):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(dtype)
+
+
+@register(name="_random_negative_binomial", aliases=("random_negative_binomial",), needs_rng=True, nondiff=True)
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), ctx=None, dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype)
+
+
+@register(
+    name="_random_generalized_negative_binomial",
+    aliases=("random_generalized_negative_binomial",),
+    needs_rng=True,
+    nondiff=True,
+)
+def _random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(), ctx=None, dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype)
+
+
+@register(name="_random_randint", aliases=("random_randint",), needs_rng=True, nondiff=True)
+def _random_randint(key, low=0, high=1, shape=(), ctx=None, dtype="int32"):
+    return jax.random.randint(key, _shape(shape), int(low), int(high)).astype(dtype)
+
+
+# --- sample_* ops: per-element distribution parameters (ref sample_op.cc) ---
+def _multi(key, fn, params, shape):
+    extra = _shape(shape)
+    out_shape = params[0].shape + extra
+    return fn(key, out_shape, *params)
+
+
+@register(name="_sample_uniform", aliases=("sample_uniform",), needs_rng=True, nondiff=True)
+def _sample_uniform(key, low, high, shape=(), dtype="float32"):
+    extra = _shape(shape)
+    tgt = low.shape + extra
+    low_b = low.reshape(low.shape + (1,) * len(extra))
+    high_b = high.reshape(high.shape + (1,) * len(extra))
+    u = jax.random.uniform(key, tgt)
+    return (low_b + u * (high_b - low_b)).astype(dtype)
+
+
+@register(name="_sample_normal", aliases=("sample_normal",), needs_rng=True, nondiff=True)
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    extra = _shape(shape)
+    tgt = mu.shape + extra
+    mu_b = mu.reshape(mu.shape + (1,) * len(extra))
+    sigma_b = sigma.reshape(sigma.shape + (1,) * len(extra))
+    return (mu_b + jax.random.normal(key, tgt) * sigma_b).astype(dtype)
+
+
+@register(name="_sample_gamma", aliases=("sample_gamma",), needs_rng=True, nondiff=True)
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
+    extra = _shape(shape)
+    tgt = alpha.shape + extra
+    a_b = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(extra)), tgt)
+    b_b = beta.reshape(beta.shape + (1,) * len(extra))
+    return (jax.random.gamma(key, a_b) * b_b).astype(dtype)
+
+
+@register(name="_sample_exponential", aliases=("sample_exponential",), needs_rng=True, nondiff=True)
+def _sample_exponential(key, lam, shape=(), dtype="float32"):
+    extra = _shape(shape)
+    tgt = lam.shape + extra
+    lam_b = lam.reshape(lam.shape + (1,) * len(extra))
+    return (jax.random.exponential(key, tgt) / lam_b).astype(dtype)
+
+
+@register(name="_sample_poisson", aliases=("sample_poisson",), needs_rng=True, nondiff=True)
+def _sample_poisson(key, lam, shape=(), dtype="float32"):
+    extra = _shape(shape)
+    tgt = lam.shape + extra
+    lam_b = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(extra)), tgt)
+    return jax.random.poisson(key, lam_b, tgt).astype(dtype)
+
+
+@register(name="_sample_multinomial", aliases=("sample_multinomial",), needs_rng=True, nondiff=True)
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    """Sample from categorical rows (ref: src/operator/random/multisample_op)."""
+    extra = _shape(shape)
+    n = 1
+    for e in extra:
+        n *= e
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    samples = jax.random.categorical(key, logits, axis=-1, shape=(n,) + data.shape[:-1])
+    samples = jnp.moveaxis(samples, 0, -1)  # (..., n)
+    out_shape = data.shape[:-1] + extra if extra else data.shape[:-1]
+    samples = samples.reshape(out_shape)
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            samples.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1,
+        ).reshape(out_shape)
+        return samples.astype(dtype), logp
+    return samples.astype(dtype)

@@ -1,0 +1,329 @@
+"""Operator registry — the TPU-native replacement for the NNVM op registry.
+
+Reference counterpart: ``include/mxnet/op_attr_types.h:185-264`` (FCompute &
+attribute registration) plus the dmlc registry. Here an op is a *pure JAX
+function* plus metadata; the same OpDef backs:
+
+- the imperative path (``mx.nd.*``): eager call → jax async dispatch (the
+  reference's ThreadedEngine, SURVEY §3.5, is subsumed by XLA's async
+  runtime);
+- the symbolic path (``mx.sym.*``): a Symbol node stores ``(op, attrs)`` and
+  the executor traces ``op.fn`` into one XLA HloModule;
+- autograd: backward uses ``jax.vjp`` of ``op.fn`` (pass::Gradient parity).
+
+Randomness is functionalized: ops with ``needs_rng=True`` receive a JAX PRNG
+key as leading argument, threaded by the caller (imperative: from the
+context RNG resource — parity with ResourceRequest::kRandom,
+``include/mxnet/resource.h:37-58``).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+from ..base import MXNetError
+
+_OPS: dict[str, "OpDef"] = {}
+_ALIASES: dict[str, str] = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name: canonical op name (e.g. ``Convolution``, ``dot``, ``_plus_scalar``).
+    fn: pure function ``fn(*arrays, **attrs) -> array | tuple`` (or with a
+        leading PRNG ``key`` argument when ``needs_rng``).
+    num_outputs: static output count, or a callable ``attrs -> int``.
+    needs_rng: op consumes a PRNG key (sampling, dropout).
+    mutate_inputs: indices of inputs mutated in place (optimizer update ops —
+        parity with mutable inputs of sgd_update etc.,
+        ref src/operator/optimizer_op.cc:39-286).
+    attr_defaults: inspected kwarg defaults, used for attr parsing/doc-gen
+        (the dmlc::Parameter equivalent, SURVEY §5.6 tier 3).
+    nondiff: never differentiable (shape ops, samplers).
+    """
+
+    __slots__ = (
+        "name",
+        "fn",
+        "num_outputs",
+        "needs_rng",
+        "mutate_inputs",
+        "attr_defaults",
+        "nondiff",
+        "num_visible_outputs",
+        "doc",
+        "input_names",
+        "var_inputs",
+    )
+
+    def __init__(
+        self,
+        name,
+        fn,
+        num_outputs=1,
+        needs_rng=False,
+        mutate_inputs=(),
+        nondiff=False,
+        num_visible_outputs=None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.needs_rng = needs_rng
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.nondiff = nondiff
+        # ops like BatchNorm emit aux outputs (mean/var) hidden from the user
+        # in the imperative path (ref NumVisibleOutputs in c_api_ndarray.cc)
+        self.num_visible_outputs = num_visible_outputs
+        self.doc = fn.__doc__ or ""
+        self.attr_defaults = _kwarg_defaults(fn, needs_rng)
+        self.input_names, self.var_inputs = _input_names(fn, needs_rng)
+        for n in self.input_names:
+            self.attr_defaults.pop(n, None)
+
+    def n_outputs(self, attrs) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def n_visible_outputs(self, attrs) -> int:
+        if self.num_visible_outputs is None:
+            return self.n_outputs(attrs)
+        if callable(self.num_visible_outputs):
+            return self.num_visible_outputs(attrs)
+        return self.num_visible_outputs
+
+    def parse_attrs(self, kwargs) -> dict:
+        """Coerce string-typed attrs (symbol JSON / C-API parity) to python."""
+        out = {}
+        for k, v in kwargs.items():
+            if k not in self.attr_defaults:
+                raise MXNetError(
+                    "op %s: unknown attribute %r (known: %s)"
+                    % (self.name, k, sorted(self.attr_defaults))
+                )
+            out[k] = _coerce(v, self.attr_defaults[k])
+        return out
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+# None-default params with these names are *optional tensor inputs*; any
+# other defaulted param ends the input list (it's an attribute).
+_OPTIONAL_TENSOR_NAMES = {"bias", "gamma", "state_cell", "sequence_length", "weight", "grid", "loc"}
+
+
+def _input_names(fn, needs_rng):
+    """Tensor-input parameter names: the leading params with no default,
+    plus contiguous None-default params whose name marks an optional tensor
+    (``bias``, ``gamma``, …). A ``*args`` parameter means variable input
+    count (Concat-style)."""
+    sig = inspect.signature(fn)
+    params = list(sig.parameters.values())
+    if needs_rng and params and params[0].name == "key":
+        params = params[1:]
+    names = []
+    var = False
+    for p in params:
+        if p.kind is p.VAR_POSITIONAL:
+            var = True
+            break
+        if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
+            break
+        if p.default is p.empty or (p.default is None and p.name in _OPTIONAL_TENSOR_NAMES):
+            names.append(p.name)
+        else:
+            break
+    return tuple(names), var
+
+
+def _kwarg_defaults(fn, needs_rng):
+    sig = inspect.signature(fn)
+    defaults = {}
+    params = list(sig.parameters.values())
+    if needs_rng and params and params[0].name == "key":
+        params = params[1:]
+    for p in params:
+        if p.kind in (p.KEYWORD_ONLY,) or (
+            p.kind is p.POSITIONAL_OR_KEYWORD and p.default is not p.empty
+        ):
+            defaults[p.name] = None if p.default is p.empty else p.default
+    return defaults
+
+
+_BOOL_STRS = {"true": True, "false": False, "1": True, "0": False, "none": None}
+
+
+def _coerce(value, default):
+    """String→typed coercion mirroring dmlc::Parameter string kwargs."""
+    if isinstance(value, list):
+        return tuple(value)
+    if not isinstance(value, str):
+        return value
+    low = value.strip().lower()
+    if isinstance(default, bool):
+        if low in _BOOL_STRS:
+            return bool(_BOOL_STRS[low])
+        raise MXNetError("cannot parse %r as bool" % (value,))
+    if low == "none":
+        return None
+    if isinstance(default, int) and not isinstance(default, bool):
+        try:
+            return int(value)
+        except ValueError:
+            return int(float(value))
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, (tuple, list)):
+        return _parse_tuple(value)
+    if value.startswith("(") or value.startswith("["):
+        return _parse_tuple(value)
+    return value
+
+
+def _parse_tuple(value):
+    s = value.strip().lstrip("([").rstrip(")]")
+    if not s:
+        return ()
+    items = []
+    depth = 0
+    cur = ""
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        items.append(cur)
+    out = []
+    for it in items:
+        it = it.strip()
+        if it.startswith("(") or it.startswith("["):
+            out.append(_parse_tuple(it))
+            continue
+        try:
+            out.append(int(it))
+        except ValueError:
+            try:
+                out.append(float(it))
+            except ValueError:
+                low = it.lower()
+                out.append(_BOOL_STRS[low] if low in _BOOL_STRS else it)
+    return tuple(out)
+
+
+def register(
+    name=None,
+    aliases=(),
+    num_outputs=1,
+    needs_rng=False,
+    mutate_inputs=(),
+    nondiff=False,
+    num_visible_outputs=None,
+):
+    """Decorator registering a pure JAX function as an operator."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        op = OpDef(
+            opname,
+            fn,
+            num_outputs=num_outputs,
+            needs_rng=needs_rng,
+            mutate_inputs=mutate_inputs,
+            nondiff=nondiff,
+            num_visible_outputs=num_visible_outputs,
+        )
+        if opname in _OPS:
+            raise MXNetError("duplicate op registration: %s" % opname)
+        _OPS[opname] = op
+        for a in aliases:
+            _ALIASES[a] = opname
+        return fn
+
+    return deco
+
+
+def alias(extra_name, canonical):
+    _ALIASES[extra_name] = canonical
+
+
+def get(name) -> OpDef:
+    op = _OPS.get(name)
+    if op is None:
+        canon = _ALIASES.get(name)
+        if canon is not None:
+            op = _OPS.get(canon)
+    if op is None:
+        raise MXNetError("operator %r is not registered" % (name,))
+    return op
+
+
+def exists(name) -> bool:
+    return name in _OPS or name in _ALIASES
+
+
+def list_ops():
+    return sorted(set(_OPS) | set(_ALIASES))
+
+
+def canonical_name(name):
+    return _ALIASES.get(name, name)
+
+
+# ---------------------------------------------------------------------------
+# jitted-apply cache: per (op, frozen attrs) compiled callable for the
+# imperative fast path. XLA compile cache keys on shapes/dtypes below this.
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=8192)
+def _jitted(op_name, attr_items, with_key=False):
+    import jax
+
+    op = get(op_name)
+    attrs = dict(attr_items)
+
+    def call(*arrays):
+        return op.fn(*arrays, **attrs)
+
+    return jax.jit(call)
+
+
+def apply_op(op: OpDef, arrays, attrs, jit=True):
+    """Invoke an op's kernel on raw jax arrays (imperative bottom half).
+
+    This is the analogue of PushFCompute (ref:
+    src/imperative/imperative_utils.h:328-440): instead of pushing a closure
+    to an engine thread, we hand the computation to XLA, whose async
+    dispatch provides the same read-after-write ordering the ThreadedEngine
+    enforced via Var queues.
+    """
+    if jit and _hashable(attrs):
+        fn = _jitted(op.name, tuple(sorted(attrs.items())))
+        return fn(*arrays)
+    return op.fn(*arrays, **attrs)
+
+
+def apply_op_with_key(op: OpDef, arrays_with_key, attrs, jit=True):
+    """Like apply_op for ``needs_rng`` ops: first element is the PRNG key
+    (a traced argument, so repeated sampling reuses the compiled program)."""
+    if jit and _hashable(attrs):
+        fn = _jitted(op.name, tuple(sorted(attrs.items())), with_key=True)
+        return fn(*arrays_with_key)
+    return op.fn(*arrays_with_key, **attrs)
+
+
+def _hashable(attrs):
+    try:
+        hash(tuple(sorted(attrs.items())))
+        return True
+    except TypeError:
+        return False

@@ -1,0 +1,526 @@
+"""Optimizers + Updater.
+
+Reference counterpart: ``python/mxnet/optimizer.py`` (1,210 LoC): Optimizer
+registry, per-parameter lr/wd multipliers, multi-precision fp32 master
+weights, Updater with state checkpointing. Each optimizer's math runs
+through the registered update *ops* (ops/optimizer_ops.py) so the update is
+one fused XLA kernel per parameter — the TPU analogue of the reference's
+``sgd_mom_update`` CUDA kernels.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy
+
+from .base import MXNetError
+from .ndarray import ndarray as nd
+from .ndarray.ndarray import NDArray, invoke
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (ref: optimizer.py Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = ()
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    @staticmethod
+    def register(klass):
+        return register(klass)
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            w32 = weight.astype(numpy.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            inner_state, w32 = state
+            g32 = grad.astype(numpy.float32)
+            self.update(index, w32, g32, inner_state)
+            w32.copyto(weight)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; use lr_scheduler to change lr")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        else:
+            lr *= self.lr_mult.get(name, 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        else:
+            wd *= self.wd_mult.get(name, 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {
+            "lr": self._get_lr(index),
+            "wd": self._get_wd(index),
+            "rescale_grad": self.rescale_grad,
+        }
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, optional multi-precision (ref: optimizer.py SGD)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            invoke("sgd_mom_update", [weight, grad, state], dict(kw, momentum=self.momentum), out=weight)
+        else:
+            invoke("sgd_update", [weight, grad], kw, out=weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            self._update_count(index)
+            kw = self._common_kwargs(index)
+            inner, w32 = state
+            if inner is not None:
+                invoke("mp_sgd_mom_update", [weight, grad, inner, w32], dict(kw, momentum=self.momentum), out=weight)
+            else:
+                invoke("mp_sgd_update", [weight, grad, w32], kw, out=weight)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            invoke("signum_update", [weight, grad, state], dict(kw, momentum=self.momentum, wd_lh=self.wd_lh), out=weight)
+        else:
+            invoke("signsgd_update", [weight, grad], kw, out=weight)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (ref: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        if state is not None:
+            state *= self.momentum
+            state += g
+            g = g + self.momentum * state
+        weight -= lr * g
+
+
+@register
+class SGLD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd.invoke("_random_normal", [], {"loc": 0.0, "scale": float(numpy.sqrt(lr)), "shape": weight.shape}, ctx=weight.ctx)
+        weight -= lr / 2 * (g + wd * weight)
+        weight += noise
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * (g + wd * weight + self.lamda * g * g * (weight - prev))
+        else:
+            mom = -lr * (g + wd * weight + self.lamda * g * g * (weight - prev))
+        prev[:] = weight
+        weight += mom
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        coef1 = 1.0 - self.beta1**t
+        coef2 = 1.0 - self.beta2**t
+        kw["lr"] *= numpy.sqrt(coef2) / coef1
+        mean, var = state
+        invoke(
+            "adam_update",
+            [weight, grad, mean, var],
+            dict(kw, beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon),
+            out=weight,
+        )
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        state += g * g
+        weight -= lr * g / (state.sqrt() + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+            )
+        return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["gamma1"] = self.gamma1
+        kw["epsilon"] = self.epsilon
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g_st, delta = state
+            invoke("rmspropalex_update", [weight, grad, n, g_st, delta], dict(kw, gamma2=self.gamma2), out=weight)
+        else:
+            invoke("rmsprop_update", [weight, grad, state], kw, out=weight)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1 - self.rho) * g * g
+        delta = ((acc_delta + self.epsilon).sqrt() / (acc_g + self.epsilon).sqrt()) * g
+        acc_delta *= self.rho
+        acc_delta += (1 - self.rho) * delta * delta
+        weight -= delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),  # z
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),  # n
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        invoke("ftrl_update", [weight, grad, z, n], dict(kw, lamda1=self.lamda1, beta=self.beta), out=weight)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1**t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t *= self.beta1
+        m_t += (1.0 - self.beta1) * g
+        u_t[:] = nd.invoke("broadcast_maximum", [self.beta2 * u_t, g.abs()], {})
+        weight -= lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t *= self.beta1
+        m_t += (1.0 - self.beta1) * g
+        v_t *= self.beta2
+        v_t += (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2**t)
+        m_t_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_t_prime
+        weight -= lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+# aliases (ref registry names)
+_OPT_REGISTRY["ccsgd"] = SGD
+ccSGD = SGD
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    klass = _OPT_REGISTRY.get(name.lower())
+    if klass is None:
+        raise MXNetError("unknown optimizer %r" % name)
+    return klass(**kwargs)
+
+
+class Updater:
+    """Applies an optimizer, owning per-index state (ref: optimizer.py
+    get_updater / Updater with set_states/get_states)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        def _to_nd(x):
+            if isinstance(x, numpy.ndarray):
+                return nd.array(x)
+            if isinstance(x, (list, tuple)):
+                return type(x)(_to_nd(i) for i in x)
+            return x
+
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], dict):
+            states_map, _opt_state = data
+        else:
+            states_map = data
+        self.states = {k: _to_nd(v) for k, v in states_map.items()}
+        self.states_synced = {k: True for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        def _to_np(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (list, tuple)):
+                return type(x)(_to_np(i) for i in x)
+            return x
+
+        states_map = {k: _to_np(v) for k, v in self.states.items()}
+        return pickle.dumps(states_map)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

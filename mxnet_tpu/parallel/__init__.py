@@ -1,0 +1,7 @@
+"""Parallelism: device meshes, sharded training steps, collectives.
+
+This package is the TPU-native answer to the reference's src/kvstore comm
+stack (SURVEY §2.4): parallelism is expressed as jax.sharding over a Mesh
+and compiled into the training step, not as a runtime service.
+"""
+from .mesh import default_mesh, make_mesh  # noqa: F401

@@ -1,0 +1,53 @@
+"""Device-mesh construction helpers.
+
+Reference counterpart: none directly — this replaces the device-placement
+roles of KVStore/PlaceDevice with ``jax.sharding.Mesh`` axes. Convention:
+
+- ``dp``: data parallel (batch axis)      — gradients psum over it
+- ``tp``: tensor parallel (hidden axis)   — per-layer collectives
+- ``pp``: pipeline stages                 — collective_permute between
+- ``sp``: sequence/context parallel       — ring attention axis
+
+Single-host: all local devices on one mesh. Multi-host: call
+``jax.distributed.initialize`` first (tools/launch.py analogue), then the
+global device list forms the mesh with DCN on the outermost axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from axis spec {name: size}; -1 means 'rest'."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"dp": len(devices)})
+    sizes = list(axes.values())
+    n_known = 1
+    for s in sizes:
+        if s != -1:
+            n_known *= s
+    if -1 in sizes:
+        sizes[sizes.index(-1)] = len(devices) // n_known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError("mesh axes %r need %d devices, have %d" % (axes, total, len(devices)))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+_DEFAULT_MESH = None
+
+
+def default_mesh():
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = make_mesh()
+    return _DEFAULT_MESH
+
+
+def set_default_mesh(mesh):
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh

@@ -1,0 +1,96 @@
+"""Profiler — Chrome trace-event JSON dumps.
+
+Reference counterpart: ``src/engine/profiler.{h,cc}`` +
+``python/mxnet/profiler.py`` (SURVEY §5.1). TPU-native design: wraps the
+JAX/XLA profiler for device truth (XPlane → TensorBoard), while also
+keeping an in-process host-side event recorder that emits the reference's
+Chrome ``trace.json`` format for API parity.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_STATE = {
+    "mode": "symbolic",
+    "filename": "profile.json",
+    "running": False,
+    "events": [],
+    "jax_trace_dir": None,
+}
+_LOCK = threading.Lock()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """ref: MXSetProfilerConfig (modes symbolic|all)."""
+    _STATE["mode"] = mode
+    _STATE["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """ref: MXSetProfilerState — 'run' starts collection, 'stop' ends it."""
+    if state == "run" and not _STATE["running"]:
+        _STATE["running"] = True
+        _STATE["events"] = []
+        tdir = os.environ.get("MXNET_TPU_JAX_TRACE_DIR")
+        if tdir:
+            import jax
+
+            jax.profiler.start_trace(tdir)
+            _STATE["jax_trace_dir"] = tdir
+    elif state == "stop" and _STATE["running"]:
+        _STATE["running"] = False
+        if _STATE["jax_trace_dir"]:
+            import jax
+
+            jax.profiler.stop_trace()
+            _STATE["jax_trace_dir"] = None
+
+
+set_config = profiler_set_config
+set_state = profiler_set_state
+
+
+def record_event(name, category, start_us, dur_us, tid=0):
+    if not _STATE["running"]:
+        return
+    with _LOCK:
+        _STATE["events"].append(
+            {"name": name, "cat": category, "ph": "X", "ts": start_us, "dur": dur_us,
+             "pid": os.getpid(), "tid": tid}
+        )
+
+
+class scope:
+    """Context manager recording one host-side trace event."""
+
+    def __init__(self, name, category="operator"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.start = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter_ns() // 1000
+        record_event(self.name, self.category, self.start, end - self.start)
+        return False
+
+
+def dump_profile():
+    """ref: MXDumpProfile → Chrome trace-event JSON (profiler.h:137-139)."""
+    with _LOCK:
+        payload = {"traceEvents": list(_STATE["events"]), "displayTimeUnit": "ms"}
+    with open(_STATE["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def pause():
+    _STATE["running"] = False
+
+
+def resume():
+    _STATE["running"] = True

@@ -1,0 +1,74 @@
+"""PRNG resource management.
+
+Parity surface: ``src/resource.cc`` (ResourceRequest::kRandom — per-context
+PRNG engines handed to ops) and ``mx.random.seed``. TPU-native design: a
+per-context splittable JAX PRNG key chain; every random op invocation draws
+a fresh subkey, so imperative sampling is reproducible after
+``mx.random.seed(n)`` and device-parallel sampling can fold in device ids.
+"""
+from __future__ import annotations
+
+import threading
+
+_STATE = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _chains():
+    if not hasattr(_STATE, "chains"):
+        _STATE.chains = {}
+    return _STATE.chains
+
+
+def seed(seed_state, ctx=None):
+    """Seed the framework RNG (parity: python/mxnet/random.py seed())."""
+    global _DEFAULT_SEED
+    import jax
+
+    if ctx is None:
+        _DEFAULT_SEED = int(seed_state)
+        _chains().clear()
+    else:
+        _chains()[ctx] = jax.random.PRNGKey(int(seed_state))
+
+
+def push_trace_key(key):
+    """Install a traced PRNG key (used while tracing a hybridized block so
+    random ops consume traced subkeys instead of concrete ones)."""
+    if not hasattr(_STATE, "trace_stack"):
+        _STATE.trace_stack = []
+    _STATE.trace_stack.append(key)
+    return len(_STATE.trace_stack) - 1
+
+
+def pop_trace_key(token):
+    _STATE.trace_stack.pop()
+
+
+def next_key(ctx=None):
+    """Draw a fresh PRNG key from the context's chain (or the active traced
+    key inside a hybridize trace)."""
+    import jax
+
+    from .context import current_context
+
+    stack = getattr(_STATE, "trace_stack", None)
+    if stack:
+        k1, k2 = jax.random.split(stack[-1])
+        stack[-1] = k2
+        return k1
+
+    ctx = ctx or current_context()
+    chains = _chains()
+    if ctx not in chains:
+        base = jax.random.PRNGKey(_DEFAULT_SEED)
+        chains[ctx] = jax.random.fold_in(base, hash(ctx) % (2**31))
+    key, chains[ctx] = jax.random.split(chains[ctx])
+    return key
+
+
+def current_key_state(ctx=None):
+    from .context import current_context
+
+    ctx = ctx or current_context()
+    return _chains().get(ctx)

@@ -1,0 +1,216 @@
+"""RecordIO: binary record container + indexed variant + image record header.
+
+Reference counterpart: ``python/mxnet/recordio.py`` (456 LoC) over dmlc
+recordio. Same on-disk format (magic 0xced7230a, length-framed records with
+32-bit content checksumless header, 4-byte alignment) so record files made
+by the reference's ``tools/im2rec`` are readable here and vice versa.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_LREC_HEADER = struct.Struct("<II")  # magic, lrec(len + cflag<<29)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return (lrec >> 29) & 7, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if self.flag == "w":
+            # reopen for append after unpickle in a worker process
+            self.handle = open(self.uri, "ab")
+        else:
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        if self.writable:
+            raise MXNetError("seek on a writable recordio")
+        self.handle.seek(pos)
+
+    def write(self, buf):
+        assert self.writable
+        if not isinstance(buf, bytes):
+            buf = bytes(buf)
+        self.handle.write(_LREC_HEADER.pack(_MAGIC, _encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = _LREC_HEADER.unpack(header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic %x" % magic)
+        _, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random-access records via .idx file (ref: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# image record header (ref: recordio.py IRHeader — flag, label, id, id2)
+IRHeader = struct.Struct("IfQQ")
+
+
+class _HeaderTuple(tuple):
+    @property
+    def flag(self):
+        return self[0]
+
+    @property
+    def label(self):
+        return self[1]
+
+    @property
+    def id(self):
+        return self[2]
+
+    @property
+    def id2(self):
+        return self[3]
+
+
+def pack(header, s):
+    """Pack a (flag,label,id,id2) header + payload bytes into one record.
+
+    Multi-label: flag holds the label count and the float labels are
+    prepended to the payload (same convention as the reference)."""
+    flag, label, idx, idx2 = header
+    if isinstance(label, numbers.Number):
+        hdr = IRHeader.pack(flag, float(label), int(idx), int(idx2))
+    else:
+        label = np.asarray(label, dtype=np.float32)
+        hdr = IRHeader.pack(len(label), 0.0, int(idx), int(idx2))
+        s = label.tobytes() + s
+    return hdr + s
+
+
+def unpack(s):
+    """Unpack a record into (header, payload)."""
+    hdr = _HeaderTuple(IRHeader.unpack(s[: IRHeader.size]))
+    s = s[IRHeader.size :]
+    if hdr.flag > 0:
+        n = hdr.flag
+        label = np.frombuffer(s[: 4 * n], dtype=np.float32)
+        return _HeaderTuple((hdr.flag, label, hdr.id, hdr.id2)), s[4 * n :]
+    return hdr, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack record into header + decoded image (ref: recordio.py unpack_img)."""
+    hdr, img_bytes = unpack(s)
+    from .image.image import imdecode_bytes
+
+    img = imdecode_bytes(img_bytes, iscolor)
+    return hdr, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    from .image.image import imencode_bytes
+
+    buf = imencode_bytes(img, img_fmt, quality)
+    return pack(header, buf)

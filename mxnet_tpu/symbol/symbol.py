@@ -1,0 +1,435 @@
+"""Symbol — declarative graph composition.
+
+Reference counterpart: ``python/mxnet/symbol/symbol.py`` over nnvm's
+Graph/Node (SURVEY §2.2). TPU-native design: a Symbol is a DAG of python
+nodes; ``bind`` hands the whole graph to the Executor which traces it into
+ONE jitted XLA program (NNVM passes — PlanMemory, PlaceDevice, fusion — are
+all performed by XLA). JSON save/load keeps the reference's file format
+(``nodes``/``arg_nodes``/``heads``) so checkpoints interoperate
+(ref: symbol.py:1187-1195 save, src/nnvm/legacy_json_util.cc).
+"""
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError, auto_name
+from ..ops import registry as _reg
+
+# ops whose trailing inputs are auxiliary states (not arguments):
+# name -> set of input param names that are aux (ref: BatchNorm aux states)
+_AUX_PARAMS = {
+    "BatchNorm": {"moving_mean", "moving_var"},
+    "BatchNorm_v1": {"moving_mean", "moving_var"},
+    "batch_norm": {"moving_mean", "moving_var"},
+}
+
+
+class _Node:
+    """One graph node: a variable (op is None) or an op application."""
+
+    __slots__ = ("op", "attrs", "inputs", "name", "attr_dict", "_arity")
+
+    def __init__(self, op, attrs, inputs, name, attr_dict=None, arity=None):
+        self.op = op  # OpDef or None for variables
+        self.attrs = attrs  # parsed op attrs
+        self.inputs = inputs  # list[(node, out_index)]
+        self.name = name
+        self.attr_dict = attr_dict or {}  # user attrs (ctx_group, lr_mult, …)
+        self._arity = arity  # input param names aligned with inputs
+
+    def n_outputs(self):
+        return 1 if self.op is None else self.op.n_outputs(self.attrs)
+
+    def is_variable(self):
+        return self.op is None
+
+
+class Symbol:
+    """An (ordered) set of output entries of a graph."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, entries):
+        self._entries = list(entries)  # list[(node, out_index)]
+
+    # -- construction --------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group [%d]" % len(self._entries))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for i, nm in enumerate(self.list_outputs()):
+                if nm == index:
+                    return Symbol([self._entries[i]])
+            raise MXNetError("no output named %r" % index)
+        entries = self._entries[index]
+        if isinstance(index, slice):
+            return Symbol(entries)
+        return Symbol([entries])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        for i in range(len(self._entries)):
+            yield self[i]
+
+    @property
+    def outputs(self):
+        return [self[i] for i in range(len(self._entries))]
+
+    def get_internals(self):
+        """Symbol grouping every internal output (ref: symbol.py get_internals)."""
+        entries = []
+        for node in self._topo():
+            for i in range(node.n_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- attributes ----------------------------------------------------------
+    def attr(self, key):
+        return self._entries[0][0].attr_dict.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0].attr_dict.update(kwargs)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = dict(node.attr_dict)
+            if node.op is not None:
+                d.update({k: str(v) for k, v in node.attrs.items()})
+            if d:
+                out[node.name] = d
+        return out
+
+    def list_attr(self):
+        node = self._entries[0][0]
+        d = dict(node.attr_dict)
+        if node.op is not None:
+            d.update({k: str(v) for k, v in node.attrs.items()})
+        return d
+
+    # -- graph traversal -----------------------------------------------------
+    def _topo(self):
+        seen = set()
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def _var_nodes(self):
+        return [n for n in self._topo() if n.is_variable()]
+
+    def _aux_names_set(self):
+        aux = []
+        for node in self._topo():
+            if node.op is None:
+                continue
+            aux_params = _AUX_PARAMS.get(node.op.name, ())
+            if not aux_params or node._arity is None:
+                continue
+            for pname, (inode, _) in zip(node._arity, node.inputs):
+                if pname in aux_params and inode.is_variable():
+                    aux.append(inode.name)
+        return set(aux)
+
+    def list_arguments(self):
+        aux = self._aux_names_set()
+        return [n.name for n in self._var_nodes() if n.name not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_names_set()
+        return [n.name for n in self._var_nodes() if n.name in aux]
+
+    def list_inputs(self):
+        return [n.name for n in self._var_nodes()]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._entries:
+            if node.is_variable():
+                out.append(node.name)
+            else:
+                n_out = node.n_outputs()
+                if n_out == 1:
+                    out.append(node.name + "_output")
+                else:
+                    out.append("%s_output%d" % (node.name, idx))
+        return out
+
+    # -- composition ---------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: substitute the graph's free variables
+        (ref: symbol.py __call__/_compose)."""
+        name = kwargs.pop("name", None)
+        variables = self._var_nodes()
+        mapping = {}
+        if args:
+            if len(args) > len(variables):
+                raise MXNetError("too many positional args to compose")
+            for var, sym in zip(variables, args):
+                mapping[var.name] = sym
+        for k, v in kwargs.items():
+            mapping[k] = v
+        return self._substitute(mapping)
+
+    def _substitute(self, mapping):
+        memo = {}
+
+        def rebuild(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node.is_variable() and node.name in mapping:
+                repl = mapping[node.name]
+                ent = repl._entries[0]
+                memo[id(node)] = ent
+                return ent
+            if node.is_variable():
+                memo[id(node)] = (node, 0)
+                return (node, 0)
+            new_inputs = []
+            for inp, idx in node.inputs:
+                rn, ri = rebuild(inp)
+                new_inputs.append((rn, idx if rn is inp else _remap_idx(idx, ri)))
+            new_node = _Node(node.op, node.attrs, new_inputs, node.name, dict(node.attr_dict), node._arity)
+            memo[id(node)] = (new_node, 0)
+            return (new_node, 0)
+
+        def _remap_idx(orig, repl):
+            return repl if orig == 0 else orig
+
+        entries = []
+        for node, idx in self._entries:
+            rn, ri = rebuild(node)
+            entries.append((rn, idx if rn.n_outputs() > idx else ri))
+        return Symbol(entries)
+
+    # -- inference -----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        from ..executor import infer_graph_shapes
+
+        try:
+            return infer_graph_shapes(self, kwargs, partial=False)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        from ..executor import infer_graph_shapes
+
+        return infer_graph_shapes(self, kwargs, partial=True)
+
+    def infer_type(self, *args, **kwargs):
+        from ..executor import infer_graph_types
+
+        return infer_graph_types(self, kwargs)
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states, group2ctx=group2ctx)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_arg_names=None, shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import simple_bind
+
+        return simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
+                           shared_exec=shared_exec, **kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # -- gradient ------------------------------------------------------------
+    def gradient(self, wrt):
+        raise MXNetError("symbol.gradient: use bind().backward instead")
+
+    # -- serialization (reference JSON format) -------------------------------
+    def tojson(self):
+        nodes = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, node in enumerate(nodes):
+            if node.is_variable():
+                arg_nodes.append(i)
+                jnodes.append({"op": "null", "name": node.name, "inputs": []})
+            else:
+                jnodes.append(
+                    {
+                        "op": node.op.name,
+                        "name": node.name,
+                        "attrs": {k: str(v) for k, v in node.attrs.items()},
+                        "inputs": [[node_ids[id(inp)], idx, 0] for inp, idx in node.inputs],
+                    }
+                )
+            if node.attr_dict:
+                jnodes[-1].setdefault("attrs", {}).update(
+                    {k: str(v) for k, v in node.attr_dict.items()}
+                )
+        heads = [[node_ids[id(n)], idx, 0] for n, idx in self._entries]
+        return json.dumps(
+            {
+                "nodes": jnodes,
+                "arg_nodes": arg_nodes,
+                "node_row_ptr": list(range(len(jnodes) + 1)),
+                "heads": heads,
+                "attrs": {"mxnet_version": ["int", 10000]},
+            },
+            indent=2,
+        )
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # debug
+    def debug_str(self):
+        lines = []
+        for node in self._topo():
+            kind = "Variable" if node.is_variable() else node.op.name
+            lines.append(
+                "%s %s(%s)" % (kind, node.name, ", ".join(i.name for i, _ in node.inputs))
+            )
+        return "\n".join(lines)
+
+    # -- NDArray-ish sugar on symbols ---------------------------------------
+    def _apply(self, opname, other=None, scalar_op=None, reverse=False, **attrs):
+        from .register import create_symbol
+
+        if other is None:
+            return create_symbol(_reg.get(opname), [self], attrs)
+        if isinstance(other, Symbol):
+            args = [other, self] if reverse else [self, other]
+            return create_symbol(_reg.get(opname), args, attrs)
+        args = [self]
+        return create_symbol(_reg.get(scalar_op), args, {"scalar": float(other)})
+
+    def __add__(self, other):
+        return self._apply("broadcast_add", other, "_plus_scalar")
+
+    def __radd__(self, other):
+        return self._apply("broadcast_add", other, "_plus_scalar")
+
+    def __sub__(self, other):
+        return self._apply("broadcast_sub", other, "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._apply("broadcast_sub", other, "_rminus_scalar", reverse=True)
+
+    def __mul__(self, other):
+        return self._apply("broadcast_mul", other, "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self._apply("broadcast_mul", other, "_mul_scalar")
+
+    def __truediv__(self, other):
+        return self._apply("broadcast_div", other, "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._apply("broadcast_div", other, "_rdiv_scalar", reverse=True)
+
+    def __pow__(self, other):
+        return self._apply("broadcast_power", other, "_power_scalar")
+
+    def __neg__(self):
+        return self._apply("negative")
+
+    def reshape(self, shape):
+        return self._apply("Reshape", shape=tuple(shape))
+
+    def sum(self, axis=None, keepdims=False):
+        return self._apply("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._apply("mean", axis=axis, keepdims=keepdims)
+
+    def astype(self, dtype):
+        return self._apply("Cast", dtype=str(dtype))
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a symbolic variable (ref: symbol.py var/Variable)."""
+    attr_dict = dict(attr or {})
+    if shape is not None:
+        attr_dict["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attr_dict["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        attr_dict["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attr_dict["__wd_mult__"] = wd_mult
+    if init is not None:
+        attr_dict["__init__"] = init if isinstance(init, str) else init.dumps()
+    attr_dict.update(kwargs)
+    node = _Node(None, {}, [], name, attr_dict)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    built = []
+    for jn in jnodes:
+        if jn["op"] == "null":
+            node = _Node(None, {}, [], jn["name"], dict(jn.get("attrs", {})))
+        else:
+            op = _reg.get(jn["op"])
+            raw_attrs = dict(jn.get("attrs", jn.get("param", {})))
+            user_attrs = {k: v for k, v in raw_attrs.items() if k.startswith("__")}
+            op_attrs = {k: v for k, v in raw_attrs.items() if not k.startswith("__") and k in op.attr_defaults}
+            attrs = op.parse_attrs(op_attrs)
+            inputs = [(built[i], oi) for i, oi, _ in jn["inputs"]]
+            arity = _infer_arity(op, len(inputs))
+            node = _Node(op, attrs, inputs, jn["name"], user_attrs, arity)
+        built.append(node)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    return Symbol([(built[h[0]], h[1]) for h in heads])
+
+
+def _infer_arity(op, n_inputs):
+    if op.var_inputs:
+        return tuple("arg%d" % i for i in range(n_inputs))
+    return op.input_names[:n_inputs]

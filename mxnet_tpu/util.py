@@ -1,0 +1,21 @@
+"""Misc utilities (parity shims for python/mxnet/util.py)."""
+
+
+def is_np_array():
+    return False
+
+
+def is_np_shape():
+    return False
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+
+    return num_tpus()

@@ -1,0 +1,13 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test pattern (SURVEY §4 'fakes'): N CPU-backed jax
+devices stand in for a TPU mesh; cpu(0)/cpu(1) behave as distinct devices.
+Must set env before jax initializes.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

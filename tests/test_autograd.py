@@ -1,0 +1,160 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2)  # x^2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    grad = nd.zeros((2,))
+    autograd.mark_variables([x], [grad], grad_reqs="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(grad.asnumpy(), 3 * 2 * x.asnumpy())
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    grad = nd.zeros((1,))
+    autograd.mark_variables([x], [grad], grad_reqs="null")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert np.allclose(grad.asnumpy(), 0)
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        y = (parts[0] * 2 + parts[1] * 3).sum()
+    y.backward()
+    expect = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)], axis=1)
+    assert np.allclose(x.grad.asnumpy(), expect)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), g1)  # write req overwrites
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((50, 50))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()
+    with autograd.predict_mode():
+        y = nd.Dropout(x, p=0.5)
+    assert not (y.asnumpy() == 0).any()
+
+
+def test_detach():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * 3
+        w = y * 5
+        total = w + z
+    total.backward()
+    assert np.allclose(x.grad.asnumpy(), [10.0])  # z path blocked
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+    grads = autograd.grad([y], [x])
+    assert np.allclose(grads[0].asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            y = mx.ndarray.ndarray._wrap_raw(y) if not hasattr(y, "_data") else y
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array(np.random.uniform(-2, 2, (3,)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_mutating_optimizer_op_keeps_graph_sane():
+    """Optimizer ops run outside recording; weights update in place."""
+    w = nd.array([1.0])
+    g = nd.array([0.5])
+    nd.sgd_update(w, g, lr=1.0, out=w)
+    assert np.allclose(w.asnumpy(), [0.5])
+
+
+def test_second_use_of_intermediate():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * y  # y used twice via same node
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [2 * 2 * 2 * 3.0])

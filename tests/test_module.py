@@ -1,0 +1,151 @@
+"""Module API tests (model: tests/python/unittest/test_module.py, 811 LoC)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp_sym(num_hidden=16, num_classes=4):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, label=mx.sym.var("softmax_label"), name="softmax")
+
+
+def _toy_data(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype(np.float32) * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_bind_init_forward():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 8))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((8, 8))], label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+
+
+def test_module_fit_learns():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(
+        train, eval_data=val, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, num_epoch=5,
+    )
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.8, "accuracy %s too low" % score
+
+
+def test_module_fit_adam_kvstore_local():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="adam", kvstore="local",
+            optimizer_params={"learning_rate": 0.01}, num_epoch=4)
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=32), "acc")
+    assert score[0][1] > 0.8
+
+
+def test_module_multi_device_data_parallel():
+    """Reference test pattern: multiple cpu contexts act as devices."""
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, optimizer="sgd", kvstore="device",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=8)
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=32), "acc")
+    assert score[0][1] > 0.8
+
+
+def test_module_predict():
+    x, y = _toy_data(64)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    x, y = _toy_data(64)
+    prefix = str(tmp_path / "model")
+    train = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 2)
+    assert "fc1_weight" in arg_params
+    mod2 = mx.mod.Module.load(prefix, 2)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    p1 = mod.predict(mx.io.NDArrayIter(x, y, batch_size=16)).asnumpy()
+    p2 = mod2.predict(mx.io.NDArrayIter(x, y, batch_size=16)).asnumpy()
+    assert np.allclose(p1, p2, atol=1e-5)
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    x, y = _toy_data(64)
+    train = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((4, 8))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 8)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+        out = mx.sym.SoftmaxOutput(data=fc, label=mx.sym.var("softmax_label"), name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8, context=mx.cpu())
+    batch8 = mx.io.DataBatch(
+        data=[nd.ones((4, 8))], label=[nd.zeros((4,))], bucket_key=8,
+        provide_data=[mx.io.DataDesc("data", (4, 8))],
+        provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+    )
+    mod.bind(data_shapes=batch8.provide_data, label_shapes=batch8.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    mod.forward_backward(batch8)
+    mod.update()
+    # switch bucket
+    batch4 = mx.io.DataBatch(
+        data=[nd.ones((4, 4))], label=[nd.zeros((4,))], bucket_key=4,
+        provide_data=[mx.io.DataDesc("data", (4, 4))],
+        provide_label=[mx.io.DataDesc("softmax_label", (4,))],
+    )
+    mod.forward_backward(batch4)
+    mod.update()
+    assert set(mod._buckets.keys()) == {8, 4}

@@ -1,0 +1,190 @@
+"""NDArray tests (model: tests/python/unittest/test_ndarray.py in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert np.array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full_arange():
+    assert np.array_equal(nd.zeros((2, 3)).asnumpy(), np.zeros((2, 3)))
+    assert np.array_equal(nd.ones((2, 3)).asnumpy(), np.ones((2, 3)))
+    assert np.array_equal(nd.full((2,), 7).asnumpy(), np.full((2,), 7.0))
+    assert np.allclose(nd.arange(0, 10, 2).asnumpy(), np.arange(0, 10, 2))
+
+
+def test_arith():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a + 1).asnumpy(), [2, 3, 4])
+    assert np.allclose((1 - a).asnumpy(), [0, -1, -2])
+    assert np.allclose((2 * a).asnumpy(), [2, 4, 6])
+    assert np.allclose((6 / a).asnumpy(), [6, 3, 2])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_arith():
+    a = nd.array([1.0, 2.0])
+    aid = id(a)
+    a += 1
+    a *= 2
+    assert id(a) == aid
+    assert np.allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a <= b).asnumpy(), [1, 1, 0])
+    assert np.allclose((a > 1.5).asnumpy(), [0, 1, 1])
+
+
+def test_setitem_getitem():
+    a = nd.zeros((3, 4))
+    a[1] = 5.0
+    assert np.allclose(a.asnumpy()[1], 5)
+    a[2, 3] = 9.0
+    assert a.asnumpy()[2, 3] == 9
+    view = a[1]
+    assert view.shape == (4,)
+    assert np.allclose(view.asnumpy(), 5)
+    # write-through view
+    view[:] = 7.0
+    assert np.allclose(a.asnumpy()[1], 7)
+    a[:] = 0
+    assert np.allclose(a.asnumpy(), 0)
+
+
+def test_slicing():
+    a = nd.array(np.arange(24).reshape(4, 6))
+    assert np.array_equal(a[1:3].asnumpy(), np.arange(24).reshape(4, 6)[1:3])
+    assert a[0].shape == (6,)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(6))
+    b = a.reshape((2, 3))
+    assert b.shape == (2, 3)
+    assert b.T.shape == (3, 2)
+    c = a.reshape((3, -1))
+    assert c.shape == (3, 2)
+    # mxnet special reshape codes
+    d = nd.zeros((2, 3, 4))
+    assert d.reshape((0, -1)).shape == (2, 12)
+    assert d.reshape((-2,)).shape == (2, 3, 4)
+    assert d.reshape((-3, 0)).shape == (6, 4)
+
+
+def test_reduce():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert a.sum().asscalar() == 66
+    assert np.allclose(a.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    assert np.allclose(a.mean(axis=1, keepdims=True).asnumpy().shape, (3, 1))
+    assert a.max().asscalar() == 11
+    assert a.min().asscalar() == 0
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert np.allclose(nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    # transpose flags
+    assert np.allclose(
+        nd.dot(a, b, transpose_a=False, transpose_b=False).asnumpy(),
+        a.asnumpy() @ b.asnumpy(), atol=1e-5,
+    )
+    c = nd.array(np.random.rand(4, 3).astype(np.float32))
+    assert np.allclose(nd.dot(c, b, transpose_a=True).asnumpy(),
+                       c.asnumpy().T @ b.asnumpy(), atol=1e-5)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[:] = 0
+    assert np.allclose(a.asnumpy(), [1.5, 2.5])
+
+
+def test_copyto_context():
+    a = nd.array([1.0, 2.0])
+    b = a.copyto(mx.cpu(1))
+    assert b.ctx == mx.cpu(1)
+    assert np.allclose(b.asnumpy(), a.asnumpy())
+    c = nd.zeros((2,))
+    a.copyto(c)
+    assert np.allclose(c.asnumpy(), [1, 2])
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.npz")
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0]])
+    nd.save(fname, [a, b])
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list)
+    assert np.allclose(loaded[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"x": a, "y": b})
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {"x", "y"}
+
+
+def test_wait_sync():
+    a = nd.ones((10, 10))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 10
+
+
+def test_take_onehot():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2], dtype=np.int32)
+    t = nd.take(a, idx)
+    assert np.allclose(t.asnumpy(), a.asnumpy()[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 1, 2]), depth=4)
+    assert oh.shape == (3, 4)
+    assert np.allclose(oh.asnumpy().sum(axis=1), 1)
+
+
+def test_topk_sort_argsort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(a, axis=1)
+    assert np.allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+    idx = nd.argsort(a, axis=1)
+    assert np.allclose(idx.asnumpy(), [[1, 2, 0], [0, 2, 1]])
+
+
+def test_broadcast():
+    a = nd.array([[1.0], [2.0]])
+    b = a.broadcast_to((2, 3))
+    assert b.shape == (2, 3)
+    assert np.allclose(b.asnumpy(), [[1, 1, 1], [2, 2, 2]])

@@ -1,0 +1,328 @@
+"""Operator forward/backward vs NumPy reference (model:
+tests/python/unittest/test_operator.py, 4,673 LoC in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+    check_symbolic_backward,
+    check_symbolic_forward,
+)
+
+
+def test_elemwise_unary():
+    x = np.random.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.exp(a), np.exp(x), rtol=1e-5)
+    assert_almost_equal(nd.log(a), np.log(x), rtol=1e-5)
+    assert_almost_equal(nd.sqrt(a), np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.square(a), np.square(x), rtol=1e-5)
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.tanh(a), np.tanh(x), rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(a), 1 / np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.abs(nd.array(-x)), np.abs(x), rtol=1e-5)
+
+
+def test_broadcast_binary():
+    x = np.random.rand(3, 1).astype(np.float32)
+    y = np.random.rand(1, 4).astype(np.float32)
+    a, b = nd.array(x), nd.array(y)
+    assert_almost_equal(nd.broadcast_add(a, b), x + y, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_mul(a, b), x * y, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_maximum(a, b), np.maximum(x, y), rtol=1e-6)
+    assert_almost_equal(nd.broadcast_power(a, b), np.power(x, y), rtol=1e-5)
+
+
+def test_fully_connected_forward_backward():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    x = np.random.rand(2, 3).astype(np.float32)
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.random.rand(4).astype(np.float32)
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b}, [x @ w.T + b], rtol=1e-5)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b})
+
+
+def test_convolution_matches_numpy():
+    # 1x1 conv == per-pixel matmul
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, kernel=(1, 1), num_filter=5, no_bias=True, name="c")
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    w = np.random.rand(5, 3, 1, 1).astype(np.float32)
+    expect = np.einsum("nchw,fc->nfhw", x, w[:, :, 0, 0])
+    check_symbolic_forward(conv, {"data": x, "c_weight": w}, [expect], rtol=1e-4)
+
+
+def test_convolution_grad():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=2, pad=(1, 1), name="c")
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 2, 3, 3).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    check_numeric_gradient(conv, {"data": x, "c_weight": w, "c_bias": b}, numeric_eps=1e-2, rtol=0.05)
+
+
+def test_pooling():
+    data = mx.sym.var("data")
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    p = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expect = np.array([[[[5, 7], [13, 15]]]], dtype=np.float32)
+    check_symbolic_forward(p, {"data": x}, [expect])
+    p_avg = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect_avg = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], dtype=np.float32)
+    check_symbolic_forward(p_avg, {"data": x}, [expect_avg])
+    g = mx.sym.Pooling(data=data, global_pool=True, pool_type="max", kernel=(1, 1))
+    check_symbolic_forward(g, {"data": x}, [np.array([[[[15]]]], dtype=np.float32)])
+
+
+def test_activation_grads():
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        data = mx.sym.var("data")
+        sym = mx.sym.Activation(data=data, act_type=act)
+        x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32) + 0.1
+        check_numeric_gradient(sym, {"data": x}, numeric_eps=1e-3, rtol=0.05)
+
+
+def test_softmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(axis=-1, keepdims=True), rtol=1e-5)
+    lo = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lo, np.log(e / e.sum(axis=-1, keepdims=True)), rtol=1e-4)
+
+
+def test_softmax_output_grad():
+    """The fused loss head: grad should be (p - onehot)/N-ish (ref semantics)."""
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    sym = mx.sym.SoftmaxOutput(data=data, label=label)
+    x = np.random.rand(4, 3).astype(np.float32)
+    y = np.array([0, 1, 2, 1], dtype=np.float32)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    onehot = np.eye(3, dtype=np.float32)[y.astype(int)]
+    check_symbolic_forward(sym, {"data": x, "label": y}, [p], rtol=1e-5)
+    check_symbolic_backward(sym, {"data": x, "label": y}, None,
+                            {"data": p - onehot}, rtol=1e-4)
+
+
+def test_batchnorm_train_stats():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, fix_gamma=False, name="bn")
+    x = np.random.rand(8, 3, 2, 2).astype(np.float32) * 5
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = nd.array(x)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expect = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(var.reshape(1, -1, 1, 1) + 1e-3)
+    assert np.allclose(out, expect, atol=1e-3)
+    # moving stats blended
+    assert np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), 0.1 * mean, atol=1e-4)
+
+
+def test_reshape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(x)
+    assert nd.transpose(a).shape == (4, 3, 2)
+    assert nd.expand_dims(a, axis=1).shape == (2, 1, 3, 4)
+    assert nd.flip(a, axis=0).asnumpy()[0, 0, 0] == 12
+    assert nd.tile(a, reps=(2, 1, 1)).shape == (4, 3, 4)
+    assert nd.repeat(a, repeats=2, axis=0).shape == (4, 3, 4)
+    assert nd.pad(nd.array(x.reshape(1, 2, 3, 4)), mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).shape == (1, 2, 5, 6)
+
+
+def test_slice_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = nd.array(x)
+    s = nd.slice(a, begin=(1, 2), end=(3, 5))
+    assert np.allclose(s.asnumpy(), x[1:3, 2:5])
+    s2 = nd.slice_axis(a, axis=1, begin=0, end=3)
+    assert np.allclose(s2.asnumpy(), x[:, :3])
+
+
+def test_embedding():
+    data = nd.array([0, 2, 1])
+    weight = nd.array(np.random.rand(3, 4).astype(np.float32))
+    out = nd.Embedding(data, weight, input_dim=3, output_dim=4)
+    assert np.allclose(out.asnumpy(), weight.asnumpy()[[0, 2, 1]])
+
+
+def test_where():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose(nd.where(cond, x, y).asnumpy(), [1, 5, 3])
+
+
+def test_gather_scatter():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # mxnet convention: indices row m holds coordinates for dimension m
+    indices = nd.array([[0, 2], [1, 3]])  # → elements (0,1) and (2,3)
+    out = nd.gather_nd(data, indices)
+    assert np.allclose(out.asnumpy(), [1.0, 11.0])
+    sc = nd.scatter_nd(nd.array([9.0, 8.0]), indices, shape=(3, 4))
+    assert sc.asnumpy()[0, 1] == 9.0
+    assert sc.asnumpy()[2, 3] == 8.0
+
+
+def test_linalg_ops():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    assert np.allclose(L.asnumpy() @ L.asnumpy().T, spd, atol=1e-4)
+    g = nd.linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True)
+    assert np.allclose(g.asnumpy(), a @ a.T, atol=1e-5)
+    sld = nd.linalg.sumlogdiag(nd.array(spd))
+    assert np.allclose(sld.asnumpy(), np.log(np.diag(spd)).sum(), atol=1e-5)
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (T, B, C)
+    slen = np.array([2, 4], dtype=np.float32)
+    m = nd.SequenceMask(nd.array(x), nd.array(slen), use_sequence_length=True, value=0.0)
+    mn = m.asnumpy()
+    assert np.allclose(mn[2:, 0], 0)
+    assert np.allclose(mn[:, 1], x[:, 1])
+    last = nd.SequenceLast(nd.array(x), nd.array(slen), use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x[1, 0])
+    assert np.allclose(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(slen), use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], x[1, 0])
+    assert np.allclose(rev.asnumpy()[0, 1], x[3, 1])
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with mx.autograd.record(train_mode=False):
+        out = nd.Dropout(x, p=0.5)
+    assert np.allclose(out.asnumpy(), 1.0)  # inference: identity
+    with mx.autograd.record(train_mode=True):
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    kept = out.asnumpy()[out.asnumpy() != 0]
+    assert np.allclose(kept, 2.0, atol=1e-5)
+
+
+def test_leaky_relu_variants():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], dtype=np.float32)
+    a = nd.array(x)
+    leaky = nd.LeakyReLU(a, act_type="leaky", slope=0.1)
+    assert np.allclose(leaky.asnumpy(), np.where(x > 0, x, 0.1 * x), atol=1e-6)
+    elu = nd.LeakyReLU(a, act_type="elu", slope=1.0)
+    assert np.allclose(elu.asnumpy(), np.where(x > 0, x, np.expm1(x)), atol=1e-5)
+
+
+def test_rnn_op_shapes():
+    T, N, I, H = 5, 2, 3, 4
+    x = nd.array(np.random.rand(T, N, I).astype(np.float32))
+    psize = 4 * H * I + 4 * H * H + 2 * 4 * H
+    params = nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    outs = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1, mode="lstm", state_outputs=True)
+    out, hN, cN = outs
+    assert out.shape == (T, N, H)
+    assert hN.shape == (1, N, H)
+    assert cN.shape == (1, N, H)
+
+
+def test_rnn_lstm_matches_manual():
+    """Single-layer LSTM vs hand-rolled cell math."""
+    T, N, I, H = 3, 2, 4, 5
+    rng = np.random.RandomState(0)
+    w_ih = rng.uniform(-0.5, 0.5, (4 * H, I)).astype(np.float32)
+    w_hh = rng.uniform(-0.5, 0.5, (4 * H, H)).astype(np.float32)
+    b_ih = rng.uniform(-0.5, 0.5, (4 * H,)).astype(np.float32)
+    b_hh = rng.uniform(-0.5, 0.5, (4 * H,)).astype(np.float32)
+    x = rng.uniform(-1, 1, (T, N, I)).astype(np.float32)
+    params = np.concatenate([w_ih.ravel(), w_hh.ravel(), b_ih, b_hh])
+    out = nd.RNN(nd.array(x), nd.array(params), nd.zeros((1, N, H)), nd.zeros((1, N, H)),
+                 state_size=H, num_layers=1, mode="lstm")
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    outs = []
+    for t in range(T):
+        gates = x[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h.copy())
+    assert np.allclose(out.asnumpy(), np.stack(outs), atol=1e-4)
+
+
+def test_random_ops_reproducible():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(3, 3))
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, shape=(3, 3))
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+    c = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(c.asnumpy().mean()) < 0.2
+    p = nd.random.poisson(3.0, shape=(1000,))
+    assert 2.5 < p.asnumpy().mean() < 3.5
+
+
+def test_sample_ops():
+    mu = nd.array([0.0, 10.0])
+    sigma = nd.array([1.0, 2.0])
+    s = nd.sample_normal(mu, sigma, shape=(500,))
+    assert s.shape == (2, 500)
+    m = s.asnumpy().mean(axis=1)
+    assert abs(m[0]) < 0.5 and abs(m[1] - 10) < 0.5
+
+
+def test_optimizer_update_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.0, rescale_grad=1.0, out=w)
+    assert np.allclose(w.asnumpy(), [0.99, 1.99], atol=1e-6)
+    # momentum
+    w = nd.array([1.0, 2.0])
+    mom = nd.zeros((2,))
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    assert np.allclose(w.asnumpy(), [0.99, 1.99], atol=1e-6)
+    assert np.allclose(mom.asnumpy(), [-0.01, -0.01], atol=1e-6)
+
+
+def test_pick():
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    idx = nd.array([0, 2])
+    out = nd.pick(x, idx, axis=1)
+    assert np.allclose(out.asnumpy(), [1, 6])
+
+
+def test_ctc_loss_simple():
+    """CTC of a single-label sequence vs analytic value."""
+    T, N, C = 2, 1, 3  # 2 frames, classes {0,1,blank=2}
+    acts = np.zeros((T, N, C), dtype=np.float32)  # uniform probs
+    label = np.array([[0]], dtype=np.float32)
+    loss = nd.invoke("_contrib_ctc_loss", [nd.array(acts), nd.array(label), None, None], {})
+    # paths for label [0]: (0,blank),(blank,0),(0,0) each prob (1/3)^2 → total 3/9
+    expect = -np.log(3.0 / 9.0)
+    assert np.allclose(loss.asnumpy(), [expect], atol=1e-4)
+
+
+def test_norm_ops():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    ln = nd.LayerNorm(a, nd.ones((4,)), nd.zeros((4,)), axis=-1)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    assert np.allclose(ln.asnumpy(), (x - mean) / np.sqrt(var + 1e-5), atol=1e-4)
+    l2 = nd.L2Normalization(a, mode="instance")
+    flat = x.reshape(2, -1)
+    expect = (flat / np.sqrt((flat**2).sum(axis=1, keepdims=True) + 1e-10)).reshape(x.shape)
+    assert np.allclose(l2.asnumpy(), expect, atol=1e-5)

@@ -1,0 +1,157 @@
+"""Symbol/executor tests (model: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, label=mx.sym.var("softmax_label"), name="softmax")
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label",
+    ]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(5, 10), softmax_label=(5,))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(5, 3)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4)
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_compose():
+    net1 = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4, name="fc_a")
+    net2 = mx.sym.FullyConnected(data=mx.sym.var("other"), num_hidden=2, name="fc_b")
+    composed = net2(other=net1, name="composed")
+    args = composed.list_arguments()
+    assert "data" in args and "fc_a_weight" in args and "fc_b_weight" in args
+    assert "other" not in args
+
+
+def test_group_and_internals():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    d = a * b
+    g = mx.sym.Group([c, d])
+    assert len(g.list_outputs()) == 2
+    internals = c.get_internals()
+    assert len(internals.list_outputs()) >= 3
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp()
+    js = out.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    # save/load via file
+    f = str(tmp_path / "sym.json")
+    out.save(f)
+    loaded2 = mx.sym.load(f)
+    # same graph evaluates identically
+    x = np.random.rand(2, 6).astype(np.float32)
+    shapes = {"data": (2, 6), "softmax_label": (2,)}
+    e1 = out.simple_bind(mx.cpu(), **shapes)
+    e2 = loaded2.simple_bind(mx.cpu(), **shapes)
+    for k in e1.arg_dict:
+        v = np.random.rand(*e1.arg_dict[k].shape).astype(np.float32)
+        e1.arg_dict[k][:] = nd.array(v)
+        e2.arg_dict[k][:] = nd.array(v)
+    o1 = e1.forward()[0].asnumpy()
+    o2 = e2.forward()[0].asnumpy()
+    assert np.allclose(o1, o2, atol=1e-6)
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+    for name in ["fc1_weight", "fc2_weight"]:
+        ex.arg_dict[name][:] = nd.array(
+            np.random.uniform(-0.5, 0.5, ex.arg_dict[name].shape).astype(np.float32)
+        )
+    ex.arg_dict["data"][:] = nd.array(np.random.rand(4, 6).astype(np.float32))
+    ex.arg_dict["softmax_label"][:] = nd.array(np.array([0, 1, 2, 0], np.float32))
+    outs = ex.forward(is_train=True)
+    p = outs[0].asnumpy()
+    assert p.shape == (4, 3)
+    assert np.allclose(p.sum(axis=1), 1, atol=1e-5)
+    ex.backward()
+    assert ex.grad_dict["fc1_weight"].asnumpy().std() > 0
+    # label grad exists but data grad matches fused softmax grad shape
+    assert ex.grad_dict["data"].shape == (4, 6)
+
+
+def test_executor_grad_add():
+    x_sym = mx.sym.var("x")
+    y = x_sym * 2
+    x = nd.array([1.0, 1.0])
+    gx = nd.zeros((2,))
+    ex = y.bind(mx.cpu(), {"x": x}, args_grad={"x": gx}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0, 1.0]))
+    ex.backward(nd.array([1.0, 1.0]))
+    assert np.allclose(gx.asnumpy(), [4, 4])
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 6), softmax_label=(8,))
+    assert ex2.arg_dict["data"].shape == (8, 6)
+    # weights shared
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    out = c.eval(ctx=mx.cpu(), a=nd.ones((2, 2)), b=nd.ones((2, 2)))
+    assert np.allclose(out[0].asnumpy(), 2)
+
+
+def test_symbol_attr():
+    a = mx.sym.var("a", shape=(3, 4), lr_mult=2.0)
+    assert a.attr("__shape__") == (3, 4)
+    d = a.attr_dict()
+    assert d["a"]["__lr_mult__"] == 2.0
+
+
+def test_var_shape_used_in_infer():
+    a = mx.sym.var("a", shape=(2, 3))
+    b = mx.sym.var("b")
+    c = a + b
+    arg_shapes, out_shapes, _ = c.infer_shape(b=(2, 3))
+    assert out_shapes == [(2, 3)]
+
+
+def test_grouped_executor_multi_output():
+    a = mx.sym.var("a")
+    g = mx.sym.Group([a * 2, a + 1])
+    ex = g.bind(mx.cpu(), {"a": nd.array([1.0, 2.0])})
+    outs = ex.forward()
+    assert np.allclose(outs[0].asnumpy(), [2, 4])
+    assert np.allclose(outs[1].asnumpy(), [2, 3])
