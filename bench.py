@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet training throughput (img/s).
+
+Mirrors the reference's benchmark mode (example/image-classification
+train_imagenet.py with synthetic data; baseline 109 img/s on 1x K80,
+example/image-classification/README.md:147-156). Runs the fused SPMD
+training step — forward + backward + SGD-momentum update in ONE XLA
+program, bf16 compute / fp32 master weights — on all available devices
+(one TPU chip under the driver).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+import json
+import sys
+import time
+
+BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md)
+
+
+def main():
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.spmd import TrainStep, functional_optimizer
+
+    n_dev = len(jax.devices())
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224))
+
+    for per_dev_batch in (256, 128, 64, 32):
+        batch = per_dev_batch * n_dev
+        try:
+            ts = TrainStep(
+                sym,
+                functional_optimizer("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4),
+                mesh=make_mesh({"dp": n_dev}),
+                compute_dtype="bfloat16",
+            )
+            params, opt_state, aux = ts.init_params(
+                {"data": (batch, 3, 224, 224), "softmax_label": (batch,)},
+                initializer=mx.initializer.Xavier(),
+            )
+            carry = ts.place(params, opt_state, aux)
+            rng = np.random.RandomState(0)
+            batch_np = {
+                "data": rng.randn(batch, 3, 224, 224).astype(np.float32),
+                "softmax_label": rng.randint(0, 1000, (batch,)).astype(np.float32),
+            }
+            key = jax.random.PRNGKey(0)
+            # place the synthetic batch once (input pipeline is measured by
+            # the IO benches, not this compute bench — parity with the
+            # reference's --benchmark 1 synthetic mode)
+            from mxnet_tpu.parallel.spmd import data_sharding
+
+            sharding = data_sharding(ts.mesh)
+            batch_dev = {k: jax.device_put(v, sharding) for k, v in batch_np.items()}
+
+            carry, loss = ts(carry, batch_dev, key)  # compile + warmup
+            jax.block_until_ready(loss)
+            carry, loss = ts(carry, batch_dev, key)
+            jax.block_until_ready(loss)
+
+            n_steps = 20
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                carry, loss = ts(carry, batch_dev, key)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            img_s = batch * n_steps / dt
+            print(json.dumps({
+                "metric": "resnet50_imagenet_train_throughput",
+                "value": round(img_s, 2),
+                "unit": "img/s",
+                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+            }))
+            return
+        except Exception as e:  # OOM at this batch — try smaller
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                continue
+            raise
+    raise SystemExit("bench: all batch sizes exhausted device memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -264,6 +264,16 @@ def _param_shape_hints(node, in_shapes):
         hints["weight"] = (int(attrs.get("input_dim", 0)), int(attrs.get("output_dim", 0)))
     elif op == "LeakyReLU" and attrs.get("act_type") == "prelu":
         hints["gamma"] = (data[1] if len(data) > 1 else 1,)
+    elif op in ("SoftmaxOutput", "SVMOutput"):
+        # label shape deduced from data (ref: SoftmaxOutput FInferShape) so
+        # inference-only binds need no label_shapes
+        if op == "SoftmaxOutput" and attrs.get("multi_output"):
+            hints["label"] = (data[0],) + tuple(data[2:])
+        else:
+            hints["label"] = (data[0],)
+    elif op in ("LinearRegressionOutput", "MAERegressionOutput",
+                "LogisticRegressionOutput"):
+        hints["label"] = tuple(data)
     elif op == "RNN":
         H = int(attrs.get("state_size", 0))
         L = int(attrs.get("num_layers", 1))

@@ -1,0 +1,22 @@
+"""Symbolic model builders (ref: example/image-classification/symbols/).
+
+Each module exposes ``get_symbol(num_classes, ...)`` returning a Symbol
+with a ``SoftmaxOutput`` head, matching the reference example zoo that the
+Module training scripts consume. The Gluon model zoo lives separately in
+``gluon/model_zoo``.
+"""
+from . import lenet, mlp, resnet  # noqa: F401
+
+_BUILDERS = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "resnet": resnet,
+}
+
+
+def get_symbol(network, **kwargs):
+    """Dispatch like the reference's train scripts:
+    ``importlib.import_module('symbols.' + args.network).get_symbol(...)``."""
+    if network not in _BUILDERS:
+        raise ValueError("unknown network %r; have %s" % (network, sorted(_BUILDERS)))
+    return _BUILDERS[network].get_symbol(**kwargs)

@@ -61,6 +61,9 @@ def convolution(
         dn = lax.conv_dimension_numbers(data.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
     else:
         dn = lax.conv_dimension_numbers(data.shape, weight.shape, ("NCHW", "OIHW", "NCHW"))
+    # bf16 in/out: the TPU MXU accumulates in fp32 internally; an explicit
+    # preferred_element_type here breaks the conv transpose (mixed-dtype
+    # cotangent) and XLA would insert casts anyway.
     out = lax.conv_general_dilated(
         data,
         weight,
@@ -69,7 +72,6 @@ def convolution(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
     out = out.astype(data.dtype)
     if bias is not None and not no_bias:

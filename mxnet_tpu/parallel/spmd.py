@@ -1,0 +1,409 @@
+"""SPMD fused training step: loss + grad + optimizer update in ONE XLA program.
+
+Reference counterpart: the hot path assembled from
+``DataParallelExecutorGroup`` (python/mxnet/module/executor_group.py:128 —
+batch split across devices), ``Comm::Reduce``/KVStore push-pull gradient
+sync (src/kvstore/comm.h:56, kvstore_local.h), and the ``sgd_mom_update``
+CUDA kernels (src/operator/optimizer_op.cc:39-286). TPU-native design: all
+three stages fuse into a single ``jax.jit`` program over a
+``jax.sharding.Mesh`` —
+
+- batch arrays are sharded over the data axes (``dp``); XLA inserts the
+  gradient all-reduce (psum over ICI) where the reference ran NCCL/ps-lite,
+  and overlaps it with backprop via its latency-hiding scheduler (the
+  reference's priority-queue overlap, model.py:126-137).
+- parameters may be sharded over ``tp`` (tensor parallel) by regex rules —
+  the generalization of the reference's `group2ctx` model parallelism.
+- the optimizer update runs on the sharded gradients in the same program
+  (no separate push/pull round trip); with weight-update sharding
+  (`zero=True`) each dp-shard updates a slice of the weights and
+  all-gathers — the ZeRO analogue of the reference's server-side optimizer
+  (kvstore_dist_server.h set_optimizer).
+- mixed precision: master weights fp32, compute in ``compute_dtype``
+  (bfloat16 on the MXU) — the mp_sgd_* multi-precision pattern
+  (src/operator/optimizer_op.cc mp_sgd_update) without a separate kernel.
+
+This module is pure-functional (params/states are pytrees, not NDArrays):
+it is the engine under ``kvstore='tpu'`` Module training, ``bench.py`` and
+``__graft_entry__.py``.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = [
+    "param_shardings", "data_sharding", "replicated", "make_train_step",
+    "TrainStep", "functional_optimizer", "cross_entropy_loss",
+]
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh, axes=("dp",), ndim=None):
+    """Shard the leading (batch) dimension over the given mesh axes."""
+    names = [a for a in axes if a in mesh.axis_names]
+    spec = P(tuple(names)) if names else P()
+    return NamedSharding(mesh, spec)
+
+
+def param_shardings(params, mesh, rules=None):
+    """Map param name -> NamedSharding via ordered (regex, PartitionSpec)
+    rules; first match wins, default replicated.
+
+    Example rules for megatron-style tensor parallelism::
+
+        [(r".*ffn_up_weight",  P("tp", None)),   # (out, in): shard out dim
+         (r".*ffn_down_weight", P(None, "tp")),
+         (r".*", P())]
+    """
+    rules = rules or []
+    out = {}
+    for name, v in params.items():
+        spec = P()
+        for pat, s in rules:
+            if re.match(pat, name):
+                spec = s if isinstance(s, P) else P(*s)
+                break
+        if spec != P() and not _spec_fits(spec, v.shape, mesh):
+            spec = P()  # unknown axis or indivisible dim: replicate
+        out[name] = NamedSharding(mesh, spec)
+    return out
+
+
+def _spec_fits(spec, shape, mesh):
+    """True iff every axis in spec exists on the mesh and divides its dim."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if ax is None:
+            continue
+        axs = (ax,) if isinstance(ax, str) else tuple(ax)
+        n = 1
+        for a in axs:
+            if a not in sizes:
+                return False
+            n *= sizes[a]
+        if dim % n != 0:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# functional optimizers (pure mirrors of optimizer.py classes, built on the
+# registered pure-JAX update ops in ops/optimizer_ops.py)
+# ---------------------------------------------------------------------------
+class FunctionalOptimizer:
+    """init(params)->state pytree; apply(params, grads, state, step)->new."""
+
+    def __init__(self, init, apply, hyper=None):
+        self.init = init
+        self.apply = apply
+        self.hyper = dict(hyper or {})
+
+
+def functional_optimizer(name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
+                         beta1=0.9, beta2=0.999, epsilon=1e-8,
+                         rescale_grad=1.0, clip_gradient=None,
+                         lr_scheduler=None, wd_pattern=r".*(weight|gamma)$"):
+    """Build a pure optimizer. ``wd_pattern``: params matching get weight
+    decay, others (bias/beta/moving stats) get 0 — set_wd_mult parity
+    (python/mxnet/optimizer.py set_wd_mult)."""
+    name = name.lower()
+    wd_re = re.compile(wd_pattern)
+
+    def lr_at(step):
+        if lr_scheduler is not None:
+            return lr_scheduler(step)
+        return learning_rate
+
+    def preprocess(g):
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return g
+
+    if name == "sgd":
+        def init(params):
+            if momentum == 0.0:
+                return {}
+            return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+        def apply(params, grads, state, step):
+            lr = lr_at(step)
+            new_p, new_s = {}, {}
+            for k, w in params.items():
+                g = preprocess(grads[k])
+                this_wd = wd if wd_re.match(k) else 0.0
+                g = g + this_wd * w
+                if momentum == 0.0:
+                    new_p[k] = w - lr * g
+                else:
+                    m = momentum * state[k] - lr * g
+                    new_s[k] = m
+                    new_p[k] = w + m
+            return new_p, new_s
+
+        return FunctionalOptimizer(init, apply, dict(lr=learning_rate, momentum=momentum, wd=wd))
+
+    if name == "adam":
+        def init(params):
+            return {
+                k: (jnp.zeros_like(v), jnp.zeros_like(v)) for k, v in params.items()
+            }
+
+        def apply(params, grads, state, step):
+            lr = lr_at(step)
+            t = step.astype(jnp.float32) + 1.0
+            coef1 = 1.0 - beta1 ** t
+            coef2 = 1.0 - beta2 ** t
+            lr_t = lr * jnp.sqrt(coef2) / coef1
+            new_p, new_s = {}, {}
+            for k, w in params.items():
+                g = preprocess(grads[k])
+                this_wd = wd if wd_re.match(k) else 0.0
+                g = g + this_wd * w
+                m, v = state[k]
+                m = beta1 * m + (1 - beta1) * g
+                v = beta2 * v + (1 - beta2) * g * g
+                new_s[k] = (m, v)
+                new_p[k] = w - lr_t * m / (jnp.sqrt(v) + epsilon)
+            return new_p, new_s
+
+        return FunctionalOptimizer(init, apply, dict(lr=learning_rate, wd=wd))
+
+    raise MXNetError("functional_optimizer: unknown optimizer %r" % name)
+
+
+def cross_entropy_loss(probs, label, eps=1e-12):
+    """Mean CE given probabilities (SoftmaxOutput forward emits probs)."""
+    lbl = label.astype(jnp.int32).reshape(-1)
+    p = probs.reshape(lbl.shape[0], -1)
+    picked = jnp.take_along_axis(p, lbl[:, None], axis=-1)
+    return -jnp.mean(jnp.log(picked + eps))
+
+
+# ---------------------------------------------------------------------------
+# the fused train step
+# ---------------------------------------------------------------------------
+class TrainStep:
+    """Compiled SPMD training step for a Symbol graph.
+
+    step(carry, batch) -> (carry, loss); carry = (params, opt_state,
+    aux, step_no), all device-resident and donated between steps.
+
+    Gradient semantics: gradients flow through the graph exactly as the
+    reference's ``Executor::Backward`` with ones head-grads — fused loss
+    heads (SoftmaxOutput & co.) substitute their own backward
+    (sum-CE gradient), so for such graphs ``loss_fn`` only affects the
+    *reported* loss, not the gradients (reference parity:
+    src/operator/softmax_output.cc discards out_grad unless out_grad=True).
+    ``normalize_grads=True`` (default) divides gradients by global batch
+    size, mirroring Module's ``rescale_grad=1/batch`` convention so lr
+    values transfer.
+
+    ``zero=True`` shards optimizer state over the data axes (weight-update
+    sharding / ZeRO: XLA reduce-scatters grads into the update and
+    all-gathers the new weights — the TPU answer to the reference's
+    server-side optimizer, kvstore_dist_server.h).
+    """
+
+    def __init__(self, symbol, optimizer, mesh=None, data_axes=("dp",),
+                 param_rules=None, label_names=("softmax_label",),
+                 data_names=("data",), compute_dtype=None, loss_fn=None,
+                 zero=False, remat=False, normalize_grads=True):
+        from ..executor import _graph_closure
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.data_axes = tuple(data_axes)
+        self.optimizer = (
+            optimizer if isinstance(optimizer, FunctionalOptimizer)
+            else functional_optimizer(**optimizer) if isinstance(optimizer, dict)
+            else functional_optimizer(optimizer)
+        )
+        self.label_names = tuple(label_names)
+        self.data_names = tuple(data_names)
+        self.compute_dtype = compute_dtype
+        self.loss_fn = loss_fn or cross_entropy_loss
+        self.zero = zero
+        self.remat = remat
+        self.normalize_grads = normalize_grads
+        self.param_rules = list(param_rules or [])
+
+        arg_names = symbol.list_arguments()
+        self.param_names = [
+            n for n in arg_names if n not in self.data_names and n not in self.label_names
+        ]
+        self.aux_names = symbol.list_auxiliary_states()
+        self._graph = _graph_closure(symbol, is_train=True)
+        self._step_fn = None
+
+    # -- initialization ------------------------------------------------------
+    def init_params(self, data_shapes, initializer=None, dtype=_np.float32, seed=0):
+        """Infer shapes from data shapes and initialize params/aux on host."""
+        from ..initializer import Uniform, InitDesc
+
+        shape_kwargs = dict(data_shapes)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shape_kwargs)
+        arg_names = self.symbol.list_arguments()
+        init = initializer or Uniform(0.01)
+        params, aux = {}, {}
+        rng = _np.random.RandomState(seed)
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self.data_names or name in self.label_names:
+                continue
+            from ..ndarray.ndarray import zeros as nd_zeros
+
+            arr = nd_zeros(shape, dtype=dtype)
+            init(InitDesc(name), arr)
+            params[name] = arr._data()
+        for name, shape in zip(self.aux_names, aux_shapes):
+            val = jnp.ones(shape, dtype) if "var" in name or "gamma" in name else jnp.zeros(shape, dtype)
+            aux[name] = val
+        opt_state = self.optimizer.init(params)
+        return params, opt_state, aux
+
+    # -- sharding ------------------------------------------------------------
+    def shardings(self, params, opt_state, aux, param_rules=None):
+        mesh = self.mesh
+        if mesh is None:
+            return None
+        rules = self.param_rules if param_rules is None else param_rules
+        ps = param_shardings(params, mesh, rules)
+        rep = replicated(mesh)
+        if self.zero:
+            # ZeRO / weight-update sharding: optimizer state shards its
+            # leading dim over the data axes (stacked with any tp sharding
+            # the param already has on later dims).
+            def zero_shard(k):
+                def leaf(x):
+                    if x.ndim == 0:
+                        return rep
+                    base = list(tuple(ps[k].spec) + (None,) * (x.ndim - len(ps[k].spec)))
+                    if base[0] is not None:  # already tp-sharded on dim 0
+                        return ps[k]
+                    spec = P(*([self.data_axes] + base[1:]))
+                    if _spec_fits(spec, x.shape, mesh):
+                        return NamedSharding(mesh, spec)
+                    return ps[k]
+                return leaf
+
+            opt_s = {k: jax.tree_util.tree_map(zero_shard(k), v)
+                     for k, v in opt_state.items()}
+        else:
+            # opt state mirrors its param's sharding
+            opt_s = {k: jax.tree_util.tree_map(lambda _, k=k: ps[k], v)
+                     for k, v in opt_state.items()}
+        aux_s = {k: rep for k in aux}
+        return ps, opt_s, aux_s
+
+    # -- compile -------------------------------------------------------------
+    def _build(self, params, opt_state, aux, param_rules=None):
+        graph = self._graph
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+        data_names, label_names = self.data_names, self.label_names
+        aux_names = list(self.aux_names)
+        cdtype = self.compute_dtype
+
+        def loss_of(params_c, aux_c, batch, key):
+            values = {}
+            values.update(params_c)
+            values.update(aux_c)
+            for n in data_names + label_names:
+                values[n] = batch[n]
+            if cdtype is not None:
+                for n in data_names:
+                    values[n] = values[n].astype(cdtype)
+            outs, aux_updates = graph(values, key)
+            label = batch[label_names[0]] if label_names else None
+            loss = loss_fn(outs[0].astype(jnp.float32), label)
+            return loss, (outs, aux_updates)
+
+        if self.remat:
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+
+        normalize = self.normalize_grads
+
+        def step(carry, batch, key):
+            params_c, opt_state_c, aux_c, step_no = carry
+            if cdtype is not None:
+                cast_params = {k: v.astype(cdtype) for k, v in params_c.items()}
+            else:
+                cast_params = params_c
+            (loss, (outs, aux_updates)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(cast_params, aux_c, batch, key)
+            if normalize:
+                # Module convention: rescale_grad = 1/global_batch (model.py)
+                bsz = batch[data_names[0]].shape[0]
+                grads = {k: g / bsz for k, g in grads.items()}
+            new_params, new_opt = opt.apply(params_c, grads, opt_state_c, step_no)
+            new_aux = dict(aux_c)
+            for k, v in aux_updates.items():
+                if k in new_aux:
+                    new_aux[k] = v.astype(new_aux[k].dtype)
+            return (new_params, new_opt, new_aux, step_no + 1), loss
+
+        mesh = self.mesh
+        if mesh is None:
+            return jax.jit(step, donate_argnums=(0,))
+
+        ps, opt_s, aux_s = self.shardings(params, opt_state, aux, param_rules)
+        rep = replicated(mesh)
+        batch_s = {
+            n: data_sharding(mesh, self.data_axes)
+            for n in self.data_names + self.label_names
+        }
+        carry_s = (ps, opt_s, aux_s, rep)
+        return jax.jit(
+            step,
+            in_shardings=(carry_s, batch_s, rep),
+            out_shardings=(carry_s, rep),
+            donate_argnums=(0,),
+        )
+
+    def compile(self, params, opt_state, aux, param_rules=None):
+        if param_rules is not None:
+            self.param_rules = list(param_rules)
+            self._step_fn = None
+        if self._step_fn is None:
+            self._step_fn = self._build(params, opt_state, aux, self.param_rules)
+        return self._step_fn
+
+    def place(self, params, opt_state, aux, param_rules=None):
+        """device_put the carry with its shardings (host → HBM once)."""
+        if param_rules is not None:
+            self.param_rules = list(param_rules)
+            self._step_fn = None
+        step_no = jnp.zeros((), jnp.int32)
+        if self.mesh is None:
+            return (params, opt_state, aux, step_no)
+        ps, opt_s, aux_s = self.shardings(params, opt_state, aux, self.param_rules)
+        params = {k: jax.device_put(v, ps[k]) for k, v in params.items()}
+        opt_state = (
+            {k: jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), v, opt_s[k])
+             for k, v in opt_state.items()}
+        )
+        aux = {k: jax.device_put(v, aux_s[k]) for k, v in aux.items()}
+        step_no = jax.device_put(step_no, replicated(self.mesh))
+        return (params, opt_state, aux, step_no)
+
+    def __call__(self, carry, batch, key=None):
+        if key is None:
+            from .. import random as _rnd
+
+            key = _rnd.next_key()
+        fn = self.compile(*carry[:3])
+        return fn(carry, batch, key)

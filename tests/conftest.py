@@ -11,3 +11,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon TPU plugin (sitecustomize in /root/.axon_site) force-registers
+# itself ahead of the env var; config.update is the authoritative override.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
