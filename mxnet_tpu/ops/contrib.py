@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register
+from .registry import alias, register
 
 _NEG_INF = -1e30
 
@@ -332,3 +332,6 @@ def quantize_2bit(grad, residual, threshold=0.5):
 @register(name="_contrib_dequantize_2bit", nondiff=True)
 def dequantize_2bit(data, threshold=0.5):
     return data
+
+
+alias("_contrib_CTCLoss", "_contrib_ctc_loss")

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register
+from .registry import alias, register
 
 
 def _pair(x, n=2):
@@ -834,3 +834,8 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1, stri
 @register(name="IdentityAttachKLSparseReg", aliases=("identity_attach_kl_sparse_reg",))
 def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001, momentum=0.9):
     return data
+
+
+# backend-specific names of the reference resolve to the one XLA kernel
+alias("CuDNNBatchNorm", "BatchNorm")
+alias("_contrib_SparseEmbedding", "Embedding")

@@ -1,0 +1,396 @@
+"""Region-based vision ops: ROI pooling, RCNN proposals, deformable conv.
+
+Reference surface: ``src/operator/roi_pooling.cc`` and
+``src/operator/contrib/{proposal,multi_proposal,psroi_pooling,
+deformable_convolution,deformable_psroi_pooling}.{cc,cu}`` (SURVEY §2.5
+contrib group). TPU-native design: the CUDA kernels' per-ROI dynamic loops
+become statically-shaped masked reductions and vmapped bilinear gathers —
+XLA-friendly (no data-dependent shapes), with NMS as a ``lax.fori_loop``
+over a fixed candidate count, like the reference's fixed pre/post-nms tops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _bilinear_sample(img, y, x):
+    """Sample img[C,H,W] at fractional (y, x) grids of any shape -> [C, *grid]."""
+    H, W = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+            xx = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+            w = (wy if dy else 1.0 - wy) * (wx if dx else 1.0 - wx)
+            # out-of-image samples contribute zero (reference deformable_im2col
+            # boundary handling)
+            inb = (y0 + dy >= 0) & (y0 + dy <= H - 1) & (x0 + dx >= 0) & (x0 + dx <= W - 1)
+            out = out + jnp.where(inb, w, 0.0) * img[..., yy, xx]
+    return out
+
+
+@register(name="ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max pooling over ROI bins (ref src/operator/roi_pooling-inl.h:51-128).
+
+    data: (N, C, H, W); rois: (R, 5) as [batch_idx, x1, y1, x2, y2].
+    """
+    N, C, H, W = data.shape
+    PH, PW = int(pooled_size[0]), int(pooled_size[1])
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        img = data[bidx]  # (C, H, W)
+        hs = jnp.arange(H, dtype=data.dtype)
+        ws = jnp.arange(W, dtype=data.dtype)
+        ph = jnp.arange(PH, dtype=data.dtype)
+        pw = jnp.arange(PW, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(ph * bin_h) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1.0) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(pw * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((pw + 1.0) * bin_w) + x1, 0, W)
+        hmask = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])  # (PH,H)
+        wmask = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])  # (PW,W)
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]  # (PH,PW,H,W)
+        neg = jnp.finfo(data.dtype).min
+        vals = jnp.where(mask[None], img[:, None, None, :, :], neg)  # (C,PH,PW,H,W)
+        out = vals.max(axis=(-2, -1))
+        empty = (hend[:, None] <= hstart[:, None]) | (wend[None, :] <= wstart[None, :])
+        return jnp.where(empty[None], 0.0, out).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register(name="_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=1,
+                  group_size=0):
+    """Position-sensitive ROI average pooling (ref contrib/psroi_pooling-inl.h).
+
+    data channels = output_dim * pooled_size**2; bin (ph, pw) of output
+    channel d averages input channel d*P*P + ph*P + pw inside the bin.
+    """
+    N, C, H, W = data.shape
+    P = int(pooled_size)
+    D = int(output_dim)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / P
+        bin_w = rw / P
+        img = data[bidx].reshape(D, P * P, H, W)
+        hs = jnp.arange(H, dtype=data.dtype)
+        ws = jnp.arange(W, dtype=data.dtype)
+        ph = jnp.arange(P, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(ph * bin_h + y1), 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1.0) * bin_h + y1), 0, H)
+        wstart = jnp.clip(jnp.floor(ph * bin_w + x1), 0, W)
+        wend = jnp.clip(jnp.ceil((ph + 1.0) * bin_w + x1), 0, W)
+        hmask = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])
+        wmask = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])
+        mask = (hmask[:, None, :, None] & wmask[None, :, None, :]).astype(data.dtype)
+        # channel index per (ph,pw) bin
+        chan = (jnp.arange(P)[:, None] * P + jnp.arange(P)[None, :]).reshape(-1)
+        binmask = mask.reshape(P * P, H, W)
+        picked = img[:, chan]  # (D, P*P, H, W)
+        s = (picked * binmask[None]).sum(axis=(-2, -1))
+        cnt = binmask.sum(axis=(-2, -1))
+        return (s / jnp.maximum(cnt, 1.0)).reshape(D, P, P).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+def _make_anchors(ratios, scales, stride):
+    """Generate base anchors centered on one stride cell (ref
+    contrib/proposal-inl.h GenerateAnchors)."""
+    import numpy as np
+
+    base = np.array([0, 0, stride - 1.0, stride - 1.0])
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        size_r = size / r
+        ws = np.round(np.sqrt(size_r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss = ws * s
+            hss = hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(anchors, dtype=np.float32)
+
+
+def _bbox_transform(anchors, deltas):
+    """Apply regression deltas to anchors (ref contrib/proposal-inl.h
+    BBoxTransformInv)."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1.0)
+    cy = anchors[:, 1] + 0.5 * (h - 1.0)
+    pcx = deltas[:, 0] * w + cx
+    pcy = deltas[:, 1] * h + cy
+    pw = jnp.exp(deltas[:, 2]) * w
+    ph = jnp.exp(deltas[:, 3]) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], axis=1)
+
+
+def _nms_keep(boxes, scores, thresh, max_out):
+    """Greedy NMS over fixed-size candidate set; returns indices of kept
+    boxes (padded with -1). lax.fori_loop over max_out iterations — static
+    shapes for XLA (the reference uses a CUDA bitmask kernel)."""
+    n = boxes.shape[0]
+    areas = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+
+    def iou_with(i):
+        xx1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
+        w = jnp.maximum(xx2 - xx1 + 1.0, 0.0)
+        h = jnp.maximum(yy2 - yy1 + 1.0, 0.0)
+        inter = w * h
+        return inter / (areas[i] + areas - inter)
+
+    def body(k, state):
+        live, keep = state
+        s = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(s)
+        ok = s[i] > -jnp.inf
+        keep = keep.at[k].set(jnp.where(ok, i, -1))
+        sup = iou_with(i) > thresh
+        live = live & ~sup & ok
+        return live, keep
+
+    live = jnp.ones((n,), bool)
+    keep = jnp.full((max_out,), -1, jnp.int32)
+    _, keep = lax.fori_loop(0, max_out, body, (live, keep))
+    return keep
+
+
+def _proposal_one(score, bbox_pred, im_info, anchors, feature_stride,
+                  rpn_pre_nms_top_n, rpn_post_nms_top_n, threshold, rpn_min_size,
+                  output_score):
+    A = anchors.shape[0]
+    Hf, Wf = score.shape[-2], score.shape[-1]
+    shift_x = jnp.arange(Wf) * feature_stride
+    shift_y = jnp.arange(Hf) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)
+    shifts = jnp.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], axis=1)
+    all_anchors = (anchors[None, :, :] + shifts[:, None, :].astype(jnp.float32))
+    all_anchors = all_anchors.reshape(-1, 4)  # (H*W*A, 4)
+    # scores: foreground half of softmax output, layout (2*A, H, W)
+    fg = score[A:].transpose(1, 2, 0).reshape(-1)  # (H*W*A,)
+    deltas = bbox_pred.transpose(1, 2, 0).reshape(-1, 4)
+    props = _bbox_transform(all_anchors, deltas)
+    # clip to image
+    props = jnp.stack([
+        jnp.clip(props[:, 0], 0, im_info[1] - 1.0),
+        jnp.clip(props[:, 1], 0, im_info[0] - 1.0),
+        jnp.clip(props[:, 2], 0, im_info[1] - 1.0),
+        jnp.clip(props[:, 3], 0, im_info[0] - 1.0)], axis=1)
+    ws = props[:, 2] - props[:, 0] + 1.0
+    hs = props[:, 3] - props[:, 1] + 1.0
+    min_size = rpn_min_size * im_info[2]
+    valid = (ws >= min_size) & (hs >= min_size)
+    fg = jnp.where(valid, fg, -jnp.inf)
+    pre_n = min(rpn_pre_nms_top_n, fg.shape[0]) if rpn_pre_nms_top_n > 0 else fg.shape[0]
+    top_s, top_i = lax.top_k(fg, pre_n)
+    cand = props[top_i]
+    keep = _nms_keep(cand, top_s, threshold, rpn_post_nms_top_n)
+    ok = keep >= 0
+    idx = jnp.maximum(keep, 0)
+    out_boxes = jnp.where(ok[:, None], cand[idx], 0.0)
+    out_scores = jnp.where(ok, top_s[idx], 0.0)
+    # pad by repeating first proposal (reference pads with WorkFill of top box)
+    out = jnp.concatenate([jnp.zeros((rpn_post_nms_top_n, 1), out_boxes.dtype), out_boxes], axis=1)
+    if output_score:
+        return out, out_scores[:, None]
+    return out
+
+
+@register(name="_contrib_Proposal", aliases=("Proposal",), nondiff=True,
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (ref src/operator/contrib/proposal.cc).
+
+    Batch 1 in the reference; here batched via vmap with per-image NMS.
+    """
+    anchors = jnp.asarray(_make_anchors(ratios, scales, feature_stride))
+    f = lambda s, b, i: _proposal_one(
+        s, b, i, anchors, feature_stride, int(rpn_pre_nms_top_n),
+        int(rpn_post_nms_top_n), float(threshold), float(rpn_min_size),
+        bool(output_score))
+    res = jax.vmap(f)(cls_prob, bbox_pred, im_info)
+    if output_score:
+        out, sc = res
+        # batch index in column 0
+        bidx = jnp.arange(out.shape[0], dtype=out.dtype)[:, None, None]
+        out = out.at[..., 0:1].set(bidx * jnp.ones_like(out[..., 0:1]))
+        return out.reshape(-1, 5), sc.reshape(-1, 1)
+    bidx = jnp.arange(res.shape[0], dtype=res.dtype)[:, None, None]
+    res = res.at[..., 0:1].set(bidx * jnp.ones_like(res[..., 0:1]))
+    return res.reshape(-1, 5)
+
+
+@register(name="_contrib_MultiProposal", aliases=("MultiProposal",), nondiff=True,
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1)
+def multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                   rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                   scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                   feature_stride=16, output_score=False, iou_loss=False):
+    """Batched Proposal (ref contrib/multi_proposal.cc) — same math, all
+    images at once."""
+    return proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n,
+                    rpn_post_nms_top_n, threshold, rpn_min_size, scales,
+                    ratios, feature_stride, output_score, iou_loss)
+
+
+@register(name="_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=1, num_group=1, num_deformable_group=1,
+                           workspace=1024, no_bias=False, layout=None):
+    """Deformable convolution v1 (ref contrib/deformable_convolution-inl.h +
+    nn/deformable_im2col.h). Gather-by-bilinear-sampling at offset taps,
+    then one big matmul — the im2col buffer becomes an XLA gather feeding
+    the MXU.
+    """
+    N, C, H, W = data.shape
+    KH, KW = int(kernel[0]), int(kernel[1])
+    SH, SW = int(stride[0]), int(stride[1])
+    DH, DW = int(dilate[0]), int(dilate[1])
+    PH, PW = int(pad[0]), int(pad[1])
+    OH = (H + 2 * PH - DH * (KH - 1) - 1) // SH + 1
+    OW = (W + 2 * PW - DW * (KW - 1) - 1) // SW + 1
+    G = int(num_deformable_group)
+    Cg = C // G
+
+    oy = jnp.arange(OH) * SH - PH
+    ox = jnp.arange(OW) * SW - PW
+    ky = jnp.arange(KH) * DH
+    kx = jnp.arange(KW) * DW
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (OH,1,KH,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,OW,1,KW)
+
+    def one_image(img, off):
+        # off: (2*G*KH*KW, OH, OW) ordered [g, kh, kw, {y,x}] per reference
+        off = off.reshape(G, KH, KW, 2, OH, OW)
+        offy = off[:, :, :, 0].transpose(0, 3, 4, 1, 2)  # (G,OH,OW,KH,KW)
+        offx = off[:, :, :, 1].transpose(0, 3, 4, 1, 2)
+        y = base_y[None] + offy  # (G,OH,OW,KH,KW)
+        x = base_x[None] + offx
+
+        def one_group(imgs_g, yg, xg):
+            # imgs_g: (Cg,H,W); sample at (OH,OW,KH,KW) grid
+            return _bilinear_sample(imgs_g, yg, xg)  # (Cg,OH,OW,KH,KW)
+
+        cols = jax.vmap(one_group)(img.reshape(G, Cg, H, W), y, x)
+        return cols.reshape(C, OH, OW, KH, KW)
+
+    cols = jax.vmap(one_image)(data, offset)  # (N,C,OH,OW,KH,KW)
+    cols = cols.transpose(0, 2, 3, 1, 4, 5).reshape(N * OH * OW, C * KH * KW)
+    wmat = weight.reshape(int(num_filter), -1)
+    ng = int(num_group)
+    if ng > 1:
+        Fg = int(num_filter) // ng
+        Ckk = (C // ng) * KH * KW
+        outs = []
+        for g in range(ng):
+            outs.append(cols[:, g * Ckk:(g + 1) * Ckk] @ wmat[g * Fg:(g + 1) * Fg].T)
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = cols @ wmat.T
+    out = out.reshape(N, OH, OW, int(num_filter)).transpose(0, 3, 1, 2)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+@register(name="_contrib_DeformablePSROIPooling", aliases=("DeformablePSROIPooling",),
+          num_outputs=2, num_visible_outputs=1)
+def deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0, output_dim=1,
+                             group_size=1, pooled_size=1, part_size=0,
+                             sample_per_part=1, trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (ref
+    contrib/deformable_psroi_pooling-inl.h). Average of bilinear samples at
+    learned per-part offsets."""
+    N, C, H, W = data.shape
+    P = int(pooled_size)
+    D = int(output_dim)
+    G = int(group_size)
+    PS = int(part_size) or P
+    SPP = int(sample_per_part)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        sub_w = bin_w / SPP
+        sub_h = bin_h / SPP
+        img = data[bidx]  # (C,H,W)
+
+        ph = jnp.arange(P)
+        pw = jnp.arange(P)
+        # learned offsets per part (trans: (R, 2*(D or 1)?, PS, PS)); class-
+        # agnostic layout (2, PS, PS) per reference's no_trans/trans_std use
+        part_h = jnp.clip((ph.astype(jnp.float32) / P * PS).astype(jnp.int32), 0, PS - 1)
+        part_w = jnp.clip((pw.astype(jnp.float32) / P * PS).astype(jnp.int32), 0, PS - 1)
+        if no_trans:
+            dy = jnp.zeros((P, P))
+            dx = jnp.zeros((P, P))
+        else:
+            dy = tr[0, part_h[:, None], part_w[None, :]] * trans_std * rh
+            dx = tr[1, part_h[:, None], part_w[None, :]] * trans_std * rw
+        sy = jnp.arange(SPP) + 0.5
+        sx = jnp.arange(SPP) + 0.5
+        # full (P, P, SPP, SPP) sample grids with per-part learned offsets
+        Y = y1 + ph[:, None, None, None] * bin_h + sy[None, None, :, None] * sub_h + dy[:, :, None, None]
+        X = x1 + pw[None, :, None, None] * bin_w + sx[None, None, None, :] * sub_w + dx[:, :, None, None]
+        # channel grouping: output d, bin (ph,pw) reads channel (d*G+gh)*G+gw
+        gh = jnp.clip((ph.astype(jnp.float32) * G / P).astype(jnp.int32), 0, G - 1)
+        gw = jnp.clip((pw.astype(jnp.float32) * G / P).astype(jnp.int32), 0, G - 1)
+        chan = (gh[:, None] * G + gw[None, :])  # (P,P) in [0, G*G)
+        vals = _bilinear_sample(img, Y, X)  # (C,P,P,SPP,SPP)
+        vals = vals.mean(axis=(-2, -1))  # (C,P,P)
+        vals = vals.reshape(D, G * G, P, P)
+        out = jnp.take_along_axis(vals, chan[None, None, :, :], axis=1)[:, 0]
+        return out.astype(data.dtype)
+
+    pooled = jax.vmap(one_roi)(rois, trans if not no_trans else
+                               jnp.zeros((rois.shape[0], 2, PS, PS), data.dtype))
+    return pooled, jnp.zeros_like(pooled)

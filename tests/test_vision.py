@@ -1,0 +1,137 @@
+"""Region-based vision ops + small parity ops added for reference coverage.
+
+Reference tests modeled: tests/python/unittest/test_operator.py
+(test_roipooling, test_smooth_l1, ...) and gpu consistency checks.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_roipooling_matches_naive():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7], [1, 2, 2, 6, 6], [0, 1, 3, 5, 7]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    assert out.shape == (3, 3, 2, 2)
+
+    def naive(data, roi, P):
+        b, x1, y1, x2, y2 = [int(v) for v in roi]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        res = np.zeros((data.shape[1], P, P), np.float32)
+        for c in range(data.shape[1]):
+            for ph in range(P):
+                for pw in range(P):
+                    hs = int(np.floor(ph * rh / P)) + y1
+                    he = int(np.ceil((ph + 1) * rh / P)) + y1
+                    ws = int(np.floor(pw * rw / P)) + x1
+                    we = int(np.ceil((pw + 1) * rw / P)) + x1
+                    hs, he = max(hs, 0), min(he, data.shape[2])
+                    ws, we = max(ws, 0), min(we, data.shape[3])
+                    if he > hs and we > ws:
+                        res[c, ph, pw] = data[b, c, hs:he, ws:we].max()
+        return res
+
+    for i, roi in enumerate(rois):
+        np.testing.assert_allclose(out[i], naive(data, roi, 2), rtol=1e-5)
+
+
+def test_psroipooling_uniform_input():
+    # constant input -> every bin averages to the constant of its channel
+    data = np.zeros((1, 8, 6, 6), np.float32)
+    for c in range(8):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=2,
+                                  pooled_size=2).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    # output_dim=2, P=2: out[d, ph, pw] = channel d*4 + ph*2 + pw
+    for d in range(2):
+        for ph in range(2):
+            for pw in range(2):
+                assert out[0, d, ph, pw] == d * 4 + ph * 2 + pw
+
+
+def test_proposal_shapes_and_nms():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 3, 4, 4
+    cls_prob = rng.uniform(0, 1, (N, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out = nd.contrib.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                              nd.array(im_info), feature_stride=16,
+                              scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+                              rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+                              threshold=0.7, rpn_min_size=4).asnumpy()
+    assert out.shape == (8, 5)
+    # boxes inside image
+    assert (out[:, 1:] >= 0).all() and (out[:, [1, 3]] <= 63).all()
+    mp = nd.contrib.MultiProposal(nd.array(cls_prob), nd.array(bbox_pred),
+                                  nd.array(im_info), feature_stride=16,
+                                  scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+                                  rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+                                  threshold=0.7, rpn_min_size=4).asnumpy()
+    assert mp.shape == (8, 5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 4, 7, 7).astype(np.float32)
+    weight = rng.randn(6, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 3 * 3, 5, 5), np.float32)
+    out_d = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(3, 3), num_filter=6, no_bias=True).asnumpy()
+    out_c = nd.Convolution(nd.array(data), nd.array(weight), kernel=(3, 3),
+                           num_filter=6, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out_d, out_c, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    data = np.zeros((1, 4, 6, 6), np.float32)
+    for c in range(4):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans), spatial_scale=1.0,
+        output_dim=1, group_size=2, pooled_size=2, no_trans=True).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    # bin (ph,pw) averages channel ph*2+pw (constant) -> exact values
+    np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]], atol=1e-5)
+
+
+def test_small_parity_ops():
+    a = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    b = nd.array(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(nd.add_n(a, b, b).asnumpy(), a.asnumpy() + 2)
+    np.testing.assert_allclose(
+        nd.reshape_like(a, nd.array(np.zeros((3, 2)))).asnumpy().shape, (3, 2))
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd._square_sum(a, axis=1).asnumpy(), (a.asnumpy() ** 2).sum(1), rtol=1e-6)
+
+
+def test_gelqf_reconstruction():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 5).astype(np.float32)
+    L, Q = mx.nd._linalg_gelqf(nd.array(A))
+    L, Q = L.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(L @ Q, A, atol=1e-4)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-4)
+
+
+def test_sparse_retain_dense_fallback():
+    data = np.arange(12).reshape(4, 3).astype(np.float32)
+    out = nd._sparse_retain(nd.array(data), nd.array(np.array([0, 2], np.float32))).asnumpy()
+    expect = data.copy()
+    expect[[1, 3]] = 0
+    np.testing.assert_allclose(out, expect)
