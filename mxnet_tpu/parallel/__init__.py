@@ -2,6 +2,14 @@
 
 This package is the TPU-native answer to the reference's src/kvstore comm
 stack (SURVEY §2.4): parallelism is expressed as jax.sharding over a Mesh
-and compiled into the training step, not as a runtime service.
+and compiled into the training step, not as a runtime service. Beyond the
+reference's data parallelism it adds the TPU generalizations the survey
+mandates: ring-attention/Ulysses sequence parallelism (ring.py) and a
+GPipe collective-permute pipeline (pipeline.py).
 """
-from .mesh import default_mesh, make_mesh  # noqa: F401
+from .mesh import default_mesh, make_mesh, set_default_mesh  # noqa: F401
+from .ring import (  # noqa: F401
+    full_attention, ring_attention, ring_attention_inner,
+    ulysses_attention, ulysses_attention_inner,
+)
+from .pipeline import pipeline, pipeline_apply  # noqa: F401

@@ -1,0 +1,90 @@
+"""Pipeline parallelism (GPipe schedule) over a ``pp`` mesh axis.
+
+Reference counterpart: **absent** (SURVEY §2.4: "Pipeline parallelism —
+Absent... optional: shard_map + collective-permute pipeline over stages").
+This implements that optional TPU-native generalization: stages live on
+submeshes along ``pp``; activations ride ``lax.ppermute`` (ICI
+collective-permute); microbatches fill the pipe GPipe-style. Backward is
+jax autodiff through the schedule — ppermute transposes to the reverse
+permute, giving the textbook reverse pipe.
+
+``pipeline_apply`` is the shard_map-inner building block (composable with
+tp/sp inside a stage); ``pipeline`` wraps it standalone.
+
+Schedule: ``n_micro + n_stages - 1`` ticks; at tick t stage 0 ingests
+microbatch t, stage s computes microbatch ``t - s``, the last stage
+retires microbatch ``t - (n_stages-1)``. Bubble fraction
+``(n_stages-1)/(n_micro + n_stages - 1)`` — pick n_micro >= 4 * n_stages.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply", "pipeline"]
+
+
+def pipeline_apply(stage_fn, stage_params, x, *, axis_name="pp",
+                   n_microbatches=None):
+    """Run ``stage_fn(stage_params, act) -> act`` as a GPipe pipeline.
+
+    Call *inside* shard_map. ``stage_params`` is this stage's slice (enter
+    the enclosing shard_map with the stacked leading stage dim sharded
+    P('pp', ...) and squeeze it). ``x``: (n_micro, mb, ...) microbatched
+    input, replicated over ``pp``. Returns (n_micro, mb, ...) outputs
+    (replicated over ``pp`` via a masked psum).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x.shape[0] if n_microbatches is None else n_microbatches
+    mb_shape = x.shape[1:]
+
+    state0 = jnp.zeros(mb_shape, x.dtype) + x[0] * 0   # varying like x
+    ys0 = jnp.zeros_like(x)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, ys = carry
+        # stage 0 ingests microbatch t (clamped; ticks past the last
+        # microbatch push zeros through the drain phase)
+        x_t = lax.dynamic_index_in_dim(x, jnp.minimum(t, n_micro - 1),
+                                       keepdims=False)
+        inp = jnp.where(stage == 0, x_t, state)
+        out = stage_fn(stage_params, inp)
+        # the last stage retires microbatch t-(n_stages-1)
+        mi = t - (n_stages - 1)
+        take = (stage == n_stages - 1) & (mi >= 0)
+        ys = lax.cond(
+            take,
+            lambda ys: lax.dynamic_update_index_in_dim(
+                ys, out.astype(ys.dtype), jnp.maximum(mi, 0), 0),
+            lambda ys: ys, ys)
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, ys), None
+
+    total = n_micro + n_stages - 1
+    (_, ys), _ = lax.scan(tick, (state0, ys0), jnp.arange(total))
+    # replicate outputs to every stage (only the last stage holds them)
+    return lax.psum(jnp.where(stage == n_stages - 1, ys, 0.0), axis_name)
+
+
+def pipeline(stage_fn, stacked_params, x, mesh, *, axis_name="pp",
+             n_microbatches=None, param_spec=None, data_spec=None):
+    """Standalone GPipe: ``stacked_params`` leaves have a leading
+    ``n_stages`` dim (sharded over ``axis_name``); ``x`` is the *global*
+    (n_micro, mb, ...) input."""
+    pspec = param_spec or jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    dspec = data_spec or P()
+
+    def inner(sp, xin):
+        local = jax.tree_util.tree_map(lambda a: a[0], sp)  # squeeze stage dim
+        return pipeline_apply(stage_fn, local, xin, axis_name=axis_name,
+                              n_microbatches=n_microbatches)
+
+    return jax.shard_map(inner, mesh=mesh, in_specs=(pspec, dspec),
+                         out_specs=P(), check_vma=False)(stacked_params, x)

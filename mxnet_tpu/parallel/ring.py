@@ -1,0 +1,183 @@
+"""Sequence / context parallelism: ring attention and Ulysses all-to-all.
+
+Reference counterpart: **none** — the reference (2017, SURVEY §5.7) handles
+long sequences only via BucketingModule / fused RNN / memory mirroring.
+These are the TPU-native generalizations mandated by the survey: scale
+sequence length over a mesh axis (``sp``) with ICI collectives.
+
+Design (How-to-Scale-Your-Model recipe):
+
+- **Ring attention** (`ring_attention`): Q stays put, K/V chunks rotate
+  around the ``sp`` ring via ``lax.ppermute`` (XLA lowers to ICI
+  collective-permute, overlapped with the per-step attention matmuls).
+  Online-softmax accumulation (running max ``m``, running sum ``l``,
+  unnormalized accumulator) makes the per-chunk combine exact — the same
+  math as flash attention's outer loop, so the result is bit-comparable
+  to full attention up to fp associativity.
+- **Ulysses** (`ulysses_attention`): ``lax.all_to_all`` reshards
+  sequence-sharded activations to head-sharded, runs *local, full-sequence*
+  attention per head group, then reshards back. Cheaper at moderate
+  sequence lengths (2 all-to-alls vs (n-1) permutes); requires
+  ``num_heads % axis_size == 0``.
+
+Both inner functions are written to run *inside* an enclosing
+``shard_map`` (composable with dp/tp axes); the module-level wrappers
+build the ``shard_map`` for the common standalone case.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "ring_attention_inner", "ring_attention",
+    "ulysses_attention_inner", "ulysses_attention",
+    "full_attention",
+]
+
+_NEG_INF = -1e30  # finite -inf stand-in: keeps online-softmax NaN-free
+
+
+def full_attention(q, k, v, *, causal=False, sm_scale=None, q_offset=0,
+                   k_offset=0):
+    """Plain softmax attention, (B, H, S, D) layout, fp32 softmax.
+
+    ``q_offset``/``k_offset`` are the global positions of q[...,0,:] and
+    k[...,0,:] — needed for causal masking of sequence *shards*.
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[2])[:, None]
+        ki = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _attend_chunk(q, k, v, m, l, acc, *, scale, causal, q_offset, k_offset):
+    """One online-softmax accumulation step against a K/V chunk.
+
+    m: (B,H,Sq) running max; l: (B,H,Sq) running denominator;
+    acc: (B,H,Sq,D) unnormalized numerator. All fp32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[2])[:, None]
+        ki = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    m_step = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_step)
+    alpha = jnp.exp(m - m_new)                      # rescale old state
+    p = jnp.exp(s - m_new[..., None])               # (B,H,Sq,Sk)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention_inner(q, k, v, *, axis_name="sp", causal=False,
+                         sm_scale=None):
+    """Ring attention over a sequence-sharded axis; call inside shard_map.
+
+    q, k, v: (B, H, S_local, D) — the local sequence shard. Returns the
+    local output shard (B, H, S_local, D) in q.dtype.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    s_local = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32)
+
+    # derive the accumulators from q/k so they carry the same device-varying
+    # axes as the loop outputs (jax>=0.9 vma tracking rejects a constant
+    # carry combined with shard_map-varying values)
+    zero_qk = q32[..., 0] * 0 + k.astype(jnp.float32)[..., 0, 0][..., None] * 0
+    m0 = zero_qk + _NEG_INF
+    l0 = zero_qk
+    acc0 = jnp.zeros_like(q32) + zero_qk[..., None]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, acc, kc, vc = carry
+        # chunk currently held = the one originating at device (my_idx - t);
+        # under causal masking, future chunks (src > my_idx) contribute
+        # exactly zero via the per-element mask in _attend_chunk
+        src = (my_idx - t) % n
+        m, l, acc = _attend_chunk(
+            q32, kc.astype(jnp.float32), vc, m, l, acc,
+            scale=scale, causal=causal,
+            q_offset=my_idx * q.shape[2], k_offset=src * s_local)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return m, l, acc, kc, vc
+
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, *, axis_name="sp", causal=False,
+                   sm_scale=None, batch_axis=None):
+    """Standalone ring attention: shard seq (dim 2) over ``axis_name``.
+
+    q, k, v: *global* (B, H, S, D) arrays; S % axis_size == 0. With
+    ``batch_axis`` the batch dim additionally shards over that mesh axis
+    (dp composition).
+    """
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    spec = P(batch_axis, None, axis_name, None)
+    fn = functools.partial(ring_attention_inner, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ulysses_attention_inner(q, k, v, *, axis_name="sp", causal=False,
+                            sm_scale=None, attn_fn=None):
+    """Ulysses sequence parallelism; call inside shard_map.
+
+    Input is sequence-sharded (B, H, S_local, D); all-to-all swaps the
+    shard dim to heads (B, H/n, S, D), local full attention runs on the
+    complete sequence, and a second all-to-all swaps back.
+    ``attn_fn(q,k,v,causal,sm_scale)`` defaults to `full_attention` —
+    pass the Pallas flash kernel for the fused path.
+    """
+    def to_heads(x):   # (B, H, S/n, D) -> (B, H/n, S, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):     # (B, H/n, S, D) -> (B, H, S/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if attn_fn is None:
+        out = full_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh=None, *, axis_name="sp", causal=False,
+                      sm_scale=None, batch_axis=None, attn_fn=None):
+    """Standalone Ulysses attention on global (B, H, S, D) arrays."""
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    spec = P(batch_axis, None, axis_name, None)
+    fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale, attn_fn=attn_fn)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

@@ -34,13 +34,14 @@ def test_module_bind_init_forward():
 
 
 def test_module_fit_learns():
+    np.random.seed(7)  # parameter init draws from the global numpy RNG
     x, y = _toy_data()
     train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
     val = mx.io.NDArrayIter(x, y, batch_size=32)
     mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
     mod.fit(
         train, eval_data=val, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.1}, num_epoch=5,
+        optimizer_params={"learning_rate": 0.1}, num_epoch=8,
     )
     score = mod.score(val, "acc")
     assert score[0][1] > 0.8, "accuracy %s too low" % score
