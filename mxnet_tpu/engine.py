@@ -1,0 +1,183 @@
+"""Host-side async dependency engine (Python front end).
+
+Reference counterpart: ``include/mxnet/engine.h`` Engine API +
+``python/mxnet/engine.py`` (bulk control). On TPU the device schedule is
+XLA's; this engine orders *host* work — prefetch, checkpoint IO,
+callbacks — with the reference's exact var semantics (concurrent readers,
+exclusive writers, program order; threaded_engine.h:115-217).
+
+Engines (env ``MXNET_ENGINE_TYPE``, ref src/engine/engine.cc:32-62):
+- ``ThreadedEngine`` (default): the native C++ scheduler in
+  src/engine.cc via ctypes (workers = ``MXNET_CPU_WORKER_NTHREADS``).
+- ``NaiveEngine``: synchronous execute-on-push, the determinism escape
+  hatch (ref src/engine/naive_engine.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from . import _native
+from .base import MXNetError
+
+__all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get", "create",
+           "new_var", "push", "wait_for_var", "wait_for_all",
+           "set_bulk_size", "bulk"]
+
+
+class NaiveEngine:
+    """Execute-on-push; trivially respects all dependencies."""
+
+    def __init__(self, num_threads=None):
+        self._pushed = 0
+
+    def new_var(self):
+        return object()
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        self._pushed += 1
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    def stats(self):
+        return {"pushed": self._pushed, "executed": self._pushed}
+
+
+class ThreadedEngine:
+    """ctypes front end of the native C++ dependency engine."""
+
+    def __init__(self, num_threads=None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise MXNetError(
+                "native runtime unavailable (%s); use NaiveEngine or unset "
+                "MXNET_TPU_NO_NATIVE" % (_native.last_error() or "build failed"))
+        self._lib = lib
+        if num_threads is None:
+            num_threads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._handle = lib.MXTEngineCreate(num_threads)
+        self._cb_lock = threading.Lock()
+        self._callbacks = {}
+        self._next_cb = 1  # keys start at 1: c_void_p(0) arrives as None
+
+        def trampoline(arg):
+            key = int(arg)
+            with self._cb_lock:
+                fn = self._callbacks.pop(key)
+            fn()
+
+        self._trampoline = _native.ENGINE_FN(trampoline)
+
+    def __del__(self):
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and self._lib is not None:
+            self._lib.MXTEngineFree(handle)
+
+    def new_var(self):
+        return self._lib.MXTEngineNewVar(self._handle)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._cb_lock:
+            key = self._next_cb
+            self._next_cb += 1
+            self._callbacks[key] = fn
+        cv = (ctypes.c_int64 * max(len(const_vars), 1))(*const_vars)
+        mv = (ctypes.c_int64 * max(len(mutable_vars), 1))(*mutable_vars)
+        rc = self._lib.MXTEnginePush(
+            self._handle, self._trampoline, ctypes.c_void_p(key),
+            cv, len(const_vars), mv, len(mutable_vars), priority)
+        if rc != 0:
+            with self._cb_lock:
+                self._callbacks.pop(key, None)
+            raise MXNetError("engine push failed: %s" % _native.last_error())
+
+    def wait_for_var(self, var):
+        if self._lib.MXTEngineWaitForVar(self._handle, var) != 0:
+            raise MXNetError("wait_for_var failed: %s" % _native.last_error())
+
+    def wait_for_all(self):
+        self._lib.MXTEngineWaitAll(self._handle)
+
+    def stats(self):
+        pushed = ctypes.c_int64()
+        executed = ctypes.c_int64()
+        self._lib.MXTEngineStats(self._handle, ctypes.byref(pushed),
+                                 ctypes.byref(executed))
+        return {"pushed": pushed.value, "executed": executed.value}
+
+
+Engine = ThreadedEngine
+
+_ENGINE = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def create(kind=None, num_threads=None):
+    """Engine factory (ref src/engine/engine.cc CreateEngine)."""
+    kind = kind or os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+    if kind in ("ThreadedEngine", "ThreadedEnginePerDevice"):
+        try:
+            return ThreadedEngine(num_threads)
+        except MXNetError:
+            return NaiveEngine(num_threads)
+    if kind == "NaiveEngine":
+        return NaiveEngine(num_threads)
+    raise MXNetError("unknown engine type %r" % kind)
+
+
+def get():
+    """Process-wide engine singleton (ref Engine::Get)."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = create()
+    return _ENGINE
+
+
+def new_var():
+    return get().new_var()
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority=0):
+    get().push(fn, const_vars, mutable_vars, priority)
+
+
+def wait_for_var(var):
+    get().wait_for_var(var)
+
+
+def wait_for_all():
+    get().wait_for_all()
+
+
+# ---- bulk-execution API parity (python/mxnet/engine.py) ----------------
+_BULK_SIZE = 0
+
+
+def set_bulk_size(size):
+    """API parity with mx.engine.set_bulk_size. Under XLA the jit trace
+    is the bulk segment, so this only records the value."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+class bulk:
+    """Context manager parity (python/mxnet/engine.py bulk)."""
+
+    def __init__(self, size):
+        self.size = size
+        self._old = None
+
+    def __enter__(self):
+        self._old = set_bulk_size(self.size)
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._old)

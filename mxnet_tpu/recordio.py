@@ -29,27 +29,49 @@ def _decode_lrec(lrec):
 
 
 class MXRecordIO:
-    """Sequential record reader/writer (ref: recordio.py MXRecordIO)."""
+    """Sequential record reader/writer (ref: recordio.py MXRecordIO).
+
+    Uses the native C++ runtime (src/recordio.cc via _native) when
+    available — the dmlc-core tier of the reference — and falls back to
+    pure Python (same on-disk bytes either way).
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
         self.writable = None
+        self._nat = None      # (lib, native handle) when native-backed
         self.open()
 
     def open(self):
+        from . import _native
+
+        lib = _native.get_lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("Invalid flag %s" % self.flag)
+        if lib is not None:
+            uri = self.uri.encode()
+            h = (lib.MXTRecordIOWriterCreate(uri) if self.writable
+                 else lib.MXTRecordIOReaderCreate(uri))
+            if not h:
+                raise MXNetError(_native.last_error()
+                                 or "cannot open %s" % self.uri)
+            self._nat = (lib, h)
+        else:
+            self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()
 
     def close(self):
+        if self._nat is not None:
+            lib, h = self._nat
+            self._nat = None
+            (lib.MXTRecordIOWriterClose if self.writable
+             else lib.MXTRecordIOReaderClose)(h)
         if self.handle is not None:
             self.handle.close()
             self.handle = None
@@ -60,6 +82,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["handle"] = None
+        d["_nat"] = None
         return d
 
     def __setstate__(self, d):
@@ -75,17 +98,37 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._nat is not None:
+            lib, h = self._nat
+            return (lib.MXTRecordIOWriterTell if self.writable
+                    else lib.MXTRecordIOReaderTell)(h)
         return self.handle.tell()
 
     def seek(self, pos):
         if self.writable:
             raise MXNetError("seek on a writable recordio")
-        self.handle.seek(pos)
+        if self._nat is not None:
+            lib, h = self._nat
+            if lib.MXTRecordIOReaderSeek(h, pos) != 0:
+                from . import _native
+
+                raise MXNetError("recordio seek(%d) failed: %s"
+                                 % (pos, _native.last_error()))
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf):
         assert self.writable
         if not isinstance(buf, bytes):
             buf = bytes(buf)
+        if self._nat is not None:
+            lib, h = self._nat
+            if lib.MXTRecordIOWriterWrite(h, buf, len(buf)) != 0:
+                from . import _native
+
+                raise MXNetError("recordio write failed: %s"
+                                 % _native.last_error())
+            return
         self.handle.write(_LREC_HEADER.pack(_MAGIC, _encode_lrec(0, len(buf))))
         self.handle.write(buf)
         pad = (4 - (len(buf) % 4)) % 4
@@ -94,18 +137,47 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = _LREC_HEADER.unpack(header)
-        if magic != _MAGIC:
-            raise MXNetError("invalid record magic %x" % magic)
-        _, length = _decode_lrec(lrec)
-        buf = self.handle.read(length)
-        pad = (4 - (length % 4)) % 4
-        if pad:
-            self.handle.read(pad)
-        return buf
+        if self._nat is not None:
+            lib, h = self._nat
+            out = ctypes.c_char_p()
+            size = ctypes.c_size_t()
+            rc = lib.MXTRecordIOReaderNext(h, ctypes.byref(out),
+                                           ctypes.byref(size))
+            if rc == 0:
+                return None
+            if rc < 0:
+                from . import _native
+
+                raise MXNetError("recordio read failed: %s"
+                                 % _native.last_error())
+            return ctypes.string_at(out, size.value)
+        # split-record reassembly (cflag 1=first, 2=middle, 3=last chunk;
+        # dmlc splits payloads at embedded magic words and the reader
+        # re-inserts them) — same logic as the native src/recordio.cc
+        parts = []
+        in_split = False
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                if in_split:
+                    raise MXNetError("truncated split record")
+                return None if not parts else b"".join(parts)
+            magic, lrec = _LREC_HEADER.unpack(header)
+            if magic != _MAGIC:
+                raise MXNetError("invalid record magic %x" % magic)
+            cflag, length = _decode_lrec(lrec)
+            if in_split:
+                parts.append(_LREC_HEADER.pack(_MAGIC, 0)[:4])  # the magic
+            parts.append(self.handle.read(length))
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.handle.read(pad)
+            if cflag in (0, 3):
+                return b"".join(parts)
+            if cflag in (1, 2):
+                in_split = True
+                continue
+            raise MXNetError("unknown cflag %d in recordio stream" % cflag)
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -1,0 +1,9 @@
+/* Shared error surface for the native runtime (ref: dmlc LOG/CHECK →
+ * MXGetLastError plumbing in src/c_api/c_api_error.cc). */
+#include "mxtpu_runtime.h"
+
+#include <string>
+
+thread_local std::string g_mxt_last_error;
+
+extern "C" const char *MXTGetLastError() { return g_mxt_last_error.c_str(); }

@@ -1,0 +1,321 @@
+/*
+ * Threaded dependency engine.
+ *
+ * TPU-native rebuild of the reference's scheduler semantics
+ * (ref include/mxnet/engine.h:96-291, src/engine/threaded_engine.h:
+ * 115-217 ThreadedVar append/complete read/write): vars serialize
+ * writers and admit concurrent readers in program order; ops wait
+ * until every dependency grants, then run on a priority thread pool
+ * (ref threaded_engine_perdevice.cc priority CPU pool). Device work
+ * is XLA's problem; this engine orders host-side IO/prefetch/ckpt.
+ */
+#include "mxtpu_runtime.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern thread_local std::string g_mxt_last_error;
+
+namespace {
+
+struct Opr;
+
+/* One scheduling record in a var's queue: an op waiting to read or
+ * write this var (ref ThreadedVar::VersionedVarBlock). */
+struct VarBlock {
+  Opr *opr;
+  bool write;
+};
+
+/* Var state mirrors ThreadedVar (threaded_engine.h:115-217):
+ * - pending queue of blocks in program order
+ * - num_pending_reads_ = readers currently granted
+ * - ready_to_write/pending write head                                  */
+struct Var {
+  std::mutex mu;
+  std::deque<VarBlock> queue;   // not yet granted
+  int running_reads = 0;        // granted, not completed
+  bool writer_active = false;   // a writer is granted
+};
+
+struct Opr {
+  MXTEngineFn fn;
+  void *arg;
+  int priority;
+  std::atomic<int> wait{0};     // deps not yet granted (ref OprBlock::wait)
+  std::vector<Var *> const_vars;
+  std::vector<Var *> mutable_vars;
+  uint64_t seq;                 // FIFO tie-break within a priority
+};
+
+struct OprCompare {
+  bool operator()(const Opr *a, const Opr *b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;     // earlier push first
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) {
+    if (num_threads <= 0) num_threads = 4;
+    for (int i = 0; i < num_threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      shutdown_ = true;
+    }
+    task_cv_.notify_all();
+    for (auto &t : workers_) t.join();
+    for (auto &kv : vars_) delete kv.second;
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    int64_t h = next_var_++;
+    vars_[h] = new Var();
+    return h;
+  }
+
+  Var *GetVar(int64_t h) {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    auto it = vars_.find(h);
+    return it == vars_.end() ? nullptr : it->second;
+  }
+
+  /* ref ThreadedEngine::PushAsync: register with every var, then the op
+   * self-schedules when its wait count drains to zero. */
+  int Push(MXTEngineFn fn, void *arg, const int64_t *cvars, int nc,
+           const int64_t *mvars, int nm, int priority) {
+    auto *op = new Opr();
+    op->fn = fn;
+    op->arg = arg;
+    op->priority = priority;
+    op->seq = seq_.fetch_add(1);
+    /* dedup (ref engine.h:264 DeduplicateVarHandle): repeated vars would
+     * self-deadlock, and a var that is both read and written is a write */
+    for (int i = 0; i < nm; ++i) {
+      Var *v = GetVar(mvars[i]);
+      if (!v) { g_mxt_last_error = "unknown mutable var"; delete op; return -1; }
+      bool dup = false;
+      for (Var *u : op->mutable_vars) dup = dup || (u == v);
+      if (!dup) op->mutable_vars.push_back(v);
+    }
+    for (int i = 0; i < nc; ++i) {
+      Var *v = GetVar(cvars[i]);
+      if (!v) { g_mxt_last_error = "unknown const var"; delete op; return -1; }
+      bool dup = false;
+      for (Var *u : op->const_vars) dup = dup || (u == v);
+      for (Var *u : op->mutable_vars) dup = dup || (u == v);
+      if (!dup) op->const_vars.push_back(v);
+    }
+    pushed_.fetch_add(1);
+    pending_.fetch_add(1);
+    /* +1 sentinel so the op cannot fire while deps are still being
+     * appended (ref threaded_engine.cc initial wait setup) */
+    op->wait.store(1 + static_cast<int>(op->const_vars.size() +
+                                        op->mutable_vars.size()));
+    for (Var *v : op->const_vars) AppendRead(v, op);
+    for (Var *v : op->mutable_vars) AppendWrite(v, op);
+    DecWait(op);
+    return 0;
+  }
+
+  int WaitForVar(int64_t var) {
+    /* push a no-op reader on the var and wait for it (ref
+     * ThreadedEngine::WaitForVar's OnComplete-signal pattern) */
+    struct Sync {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } sync;
+    auto fn = [](void *p) {
+      auto *s = static_cast<Sync *>(p);
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->done = true;
+      s->cv.notify_all();
+    };
+    int64_t cv[1] = {var};
+    if (Push(fn, &sync, cv, 1, nullptr, 0, 1 << 20) != 0) return -1;
+    std::unique_lock<std::mutex> lk(sync.mu);
+    sync.cv.wait(lk, [&] { return sync.done; });
+    return 0;
+  }
+
+  int WaitAll() {
+    std::unique_lock<std::mutex> lk(finished_mu_);
+    finished_cv_.wait(lk, [&] { return pending_.load() == 0; });
+    return 0;
+  }
+
+  void Stats(int64_t *pushed, int64_t *executed) {
+    if (pushed) *pushed = pushed_.load();
+    if (executed) *executed = executed_.load();
+  }
+
+ private:
+  /* grant rules — exactly ThreadedVar::AppendReadDependency /
+   * AppendWriteDependency (threaded_engine.h:115-139): a read is granted
+   * iff no writer is active and no earlier writer queues; a write is
+   * granted iff nothing is active and it is at the queue head. */
+  void AppendRead(Var *v, Opr *op) {
+    bool grant = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->writer_active && v->queue.empty()) {
+        ++v->running_reads;
+        grant = true;
+      } else {
+        v->queue.push_back({op, false});
+      }
+    }
+    if (grant) DecWait(op);
+  }
+
+  void AppendWrite(Var *v, Opr *op) {
+    bool grant = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->writer_active && v->running_reads == 0 && v->queue.empty()) {
+        v->writer_active = true;
+        grant = true;
+      } else {
+        v->queue.push_back({op, true});
+      }
+    }
+    if (grant) DecWait(op);
+  }
+
+  /* ref ThreadedVar::CompleteReadDependency / CompleteWriteDependency */
+  void CompleteRead(Var *v) {
+    std::vector<Opr *> to_grant;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      --v->running_reads;
+      DrainLocked(v, &to_grant);
+    }
+    for (Opr *op : to_grant) DecWait(op);
+  }
+
+  void CompleteWrite(Var *v) {
+    std::vector<Opr *> to_grant;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->writer_active = false;
+      DrainLocked(v, &to_grant);
+    }
+    for (Opr *op : to_grant) DecWait(op);
+  }
+
+  void DrainLocked(Var *v, std::vector<Opr *> *to_grant) {
+    /* grant queue head: one writer, or a maximal run of readers */
+    while (!v->queue.empty()) {
+      VarBlock blk = v->queue.front();
+      if (blk.write) {
+        if (v->running_reads == 0 && !v->writer_active) {
+          v->writer_active = true;
+          v->queue.pop_front();
+          to_grant->push_back(blk.opr);
+        }
+        break;
+      }
+      if (v->writer_active) break;
+      ++v->running_reads;
+      v->queue.pop_front();
+      to_grant->push_back(blk.opr);
+    }
+  }
+
+  void DecWait(Opr *op) {
+    if (op->wait.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      ready_.push(op);
+      task_cv_.notify_one();
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr *op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(task_mu_);
+        task_cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.top();
+        ready_.pop();
+      }
+      op->fn(op->arg);
+      executed_.fetch_add(1);
+      for (Var *v : op->const_vars) CompleteRead(v);
+      for (Var *v : op->mutable_vars) CompleteWrite(v);
+      delete op;
+      if (pending_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(finished_mu_);
+        finished_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex vars_mu_;
+  std::unordered_map<int64_t, Var *> vars_;
+  int64_t next_var_ = 1;
+
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+  std::priority_queue<Opr *, std::vector<Opr *>, OprCompare> ready_;
+  bool shutdown_ = false;
+
+  std::mutex finished_mu_;
+  std::condition_variable finished_cv_;
+
+  std::atomic<uint64_t> seq_{0};
+  std::atomic<int64_t> pushed_{0}, executed_{0}, pending_{0};
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *MXTEngineCreate(int num_threads) { return new Engine(num_threads); }
+
+void MXTEngineFree(void *engine) { delete static_cast<Engine *>(engine); }
+
+int64_t MXTEngineNewVar(void *engine) {
+  return static_cast<Engine *>(engine)->NewVar();
+}
+
+int MXTEnginePush(void *engine, MXTEngineFn fn, void *arg,
+                  const int64_t *const_vars, int num_const,
+                  const int64_t *mutable_vars, int num_mutable,
+                  int priority) {
+  return static_cast<Engine *>(engine)->Push(
+      fn, arg, const_vars, num_const, mutable_vars, num_mutable, priority);
+}
+
+int MXTEngineWaitForVar(void *engine, int64_t var) {
+  return static_cast<Engine *>(engine)->WaitForVar(var);
+}
+
+int MXTEngineWaitAll(void *engine) {
+  return static_cast<Engine *>(engine)->WaitAll();
+}
+
+void MXTEngineStats(void *engine, int64_t *pushed, int64_t *executed) {
+  static_cast<Engine *>(engine)->Stats(pushed, executed);
+}
+
+}  // extern "C"

@@ -1,0 +1,76 @@
+/*
+ * mxtpu native host runtime — C ABI.
+ *
+ * TPU-native equivalents of the reference's native runtime tier
+ * (SURVEY §2.1): the async dependency engine (ref src/engine/
+ * threaded_engine*.{h,cc}), the pooled storage manager (ref
+ * src/storage/pooled_storage_manager.h) and the RecordIO container
+ * (ref dmlc-core recordio, src/io/). On TPU the *device* schedule
+ * belongs to XLA; this layer orders host-side work — IO, prefetch,
+ * checkpoint, callbacks — exactly where the reference used its
+ * ThreadedEnginePerDevice for everything.
+ *
+ * All functions return 0 on success, -1 on error (message via
+ * MXTGetLastError), unless documented otherwise.
+ */
+#ifndef MXTPU_RUNTIME_H_
+#define MXTPU_RUNTIME_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#if defined(__GNUC__)
+#define MXT_DLL __attribute__((visibility("default")))
+#else
+#define MXT_DLL
+#endif
+
+extern "C" {
+
+MXT_DLL const char *MXTGetLastError();
+
+/* ------------------------- dependency engine ------------------------- */
+typedef void (*MXTEngineFn)(void *arg);
+
+MXT_DLL void *MXTEngineCreate(int num_threads);
+MXT_DLL void MXTEngineFree(void *engine);
+/* vars are small integer handles private to one engine */
+MXT_DLL int64_t MXTEngineNewVar(void *engine);
+MXT_DLL int MXTEnginePush(void *engine, MXTEngineFn fn, void *arg,
+                          const int64_t *const_vars, int num_const,
+                          const int64_t *mutable_vars, int num_mutable,
+                          int priority);
+MXT_DLL int MXTEngineWaitForVar(void *engine, int64_t var);
+MXT_DLL int MXTEngineWaitAll(void *engine);
+/* counters: ops pushed / executed (for tests + profiling) */
+MXT_DLL void MXTEngineStats(void *engine, int64_t *pushed, int64_t *executed);
+
+/* ------------------------- pooled storage ---------------------------- */
+MXT_DLL void *MXTStoragePoolCreate(size_t max_cached_bytes);
+MXT_DLL void MXTStoragePoolFree(void *pool);
+MXT_DLL void *MXTStorageAlloc(void *pool, size_t size);
+MXT_DLL void MXTStorageRelease(void *pool, void *ptr, size_t size);
+MXT_DLL void MXTStoragePoolStats(void *pool, int64_t *live_bytes,
+                                 int64_t *cached_bytes, int64_t *hits,
+                                 int64_t *misses);
+MXT_DLL void MXTStoragePoolDrain(void *pool);
+
+/* --------------------------- RecordIO -------------------------------- */
+MXT_DLL void *MXTRecordIOWriterCreate(const char *path);
+MXT_DLL int MXTRecordIOWriterWrite(void *writer, const char *data,
+                                   size_t size);
+MXT_DLL int64_t MXTRecordIOWriterTell(void *writer);
+MXT_DLL int MXTRecordIOWriterClose(void *writer);
+
+MXT_DLL void *MXTRecordIOReaderCreate(const char *path);
+/* next record; *out points into an internal buffer valid until the next
+ * call. returns 1 = ok, 0 = eof, -1 = error. */
+MXT_DLL int MXTRecordIOReaderNext(void *reader, const char **out,
+                                  size_t *size);
+MXT_DLL int MXTRecordIOReaderSeek(void *reader, int64_t pos);
+MXT_DLL int64_t MXTRecordIOReaderTell(void *reader);
+MXT_DLL int MXTRecordIOReaderClose(void *reader);
+
+}  /* extern "C" */
+
+#endif  /* MXTPU_RUNTIME_H_ */

@@ -1,0 +1,164 @@
+/*
+ * RecordIO reader/writer — dmlc recordio on-disk format.
+ *
+ * TPU-native rebuild of the container consumed by the reference's data
+ * pipeline (ref src/io/, dmlc-core recordio; python/mxnet/recordio.py):
+ * magic 0xced7230a, then lrec = (cflag << 29) | length, payload padded
+ * to 4-byte alignment. cflag: 0 = whole record, 1/2/3 = split-record
+ * continuation markers (emitted by dmlc when a record contains the
+ * magic; we read them, we always write cflag 0). Byte-compatible with
+ * files produced by the reference's tools/im2rec.
+ */
+#include "mxtpu_runtime.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern thread_local std::string g_mxt_last_error;
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+void SetErr(const std::string &msg) { g_mxt_last_error = msg; }
+
+struct Writer {
+  FILE *fp;
+};
+
+struct Reader {
+  FILE *fp;
+  std::vector<char> buf;
+};
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t len) {
+  return (cflag << 29U) | len;
+}
+inline uint32_t DecodeFlag(uint32_t lrec) { return (lrec >> 29U) & 7U; }
+inline uint32_t DecodeLen(uint32_t lrec) { return lrec & ((1U << 29U) - 1U); }
+
+}  // namespace
+
+extern "C" {
+
+void *MXTRecordIOWriterCreate(const char *path) {
+  FILE *fp = std::fopen(path, "wb");
+  if (!fp) {
+    SetErr(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  return new Writer{fp};
+}
+
+int MXTRecordIOWriterWrite(void *writer, const char *data, size_t size) {
+  auto *w = static_cast<Writer *>(writer);
+  if (size >= (1U << 29U)) {
+    SetErr("record too large (>= 2^29 bytes)");
+    return -1;
+  }
+  uint32_t header[2] = {kMagic, EncodeLRec(0, static_cast<uint32_t>(size))};
+  if (std::fwrite(header, sizeof(header), 1, w->fp) != 1) {
+    SetErr("recordio write: header fwrite failed (disk full?)");
+    return -1;
+  }
+  if (size && std::fwrite(data, 1, size, w->fp) != size) {
+    SetErr("recordio write: payload fwrite failed (disk full?)");
+    return -1;
+  }
+  size_t pad = (4 - (size & 3U)) & 3U;
+  if (pad) {
+    const char zeros[4] = {0, 0, 0, 0};
+    if (std::fwrite(zeros, 1, pad, w->fp) != pad) {
+      SetErr("recordio write: pad fwrite failed (disk full?)");
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int64_t MXTRecordIOWriterTell(void *writer) {
+  return std::ftell(static_cast<Writer *>(writer)->fp);
+}
+
+int MXTRecordIOWriterClose(void *writer) {
+  auto *w = static_cast<Writer *>(writer);
+  int rc = std::fclose(w->fp);
+  delete w;
+  return rc == 0 ? 0 : -1;
+}
+
+void *MXTRecordIOReaderCreate(const char *path) {
+  FILE *fp = std::fopen(path, "rb");
+  if (!fp) {
+    SetErr(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  return new Reader{fp, {}};
+}
+
+int MXTRecordIOReaderNext(void *reader, const char **out, size_t *size) {
+  auto *r = static_cast<Reader *>(reader);
+  r->buf.clear();
+  /* reassemble split records: dmlc splits a payload at embedded magic
+   * words (cflag 1=first, 2=middle, 3=last chunk) and the reader
+   * re-inserts the magic between chunks */
+  bool in_split = false;
+  for (;;) {
+    uint32_t header[2];
+    size_t n = std::fread(header, 1, sizeof(header), r->fp);
+    if (n == 0 && !in_split) return 0;  /* clean EOF */
+    if (n != sizeof(header)) {
+      SetErr("truncated record header");
+      return -1;
+    }
+    if (header[0] != kMagic) {
+      SetErr("bad magic — corrupt recordio file");
+      return -1;
+    }
+    uint32_t cflag = DecodeFlag(header[1]);
+    uint32_t len = DecodeLen(header[1]);
+    if (in_split) {
+      /* the magic that separated the chunks is part of the payload */
+      const char *m = reinterpret_cast<const char *>(&header[0]);
+      r->buf.insert(r->buf.end(), m, m + sizeof(uint32_t));
+    }
+    size_t old = r->buf.size();
+    r->buf.resize(old + len);
+    if (len && std::fread(r->buf.data() + old, 1, len, r->fp) != len) {
+      SetErr("truncated record payload");
+      return -1;
+    }
+    size_t pad = (4 - (len & 3U)) & 3U;
+    if (pad) std::fseek(r->fp, static_cast<long>(pad), SEEK_CUR);
+    if (cflag == 0 || cflag == 3) break;  /* whole record or final chunk */
+    if (cflag == 1 || cflag == 2) {
+      in_split = true;
+      continue;
+    }
+    SetErr("unknown cflag in recordio stream");
+    return -1;
+  }
+  *out = r->buf.data();
+  *size = r->buf.size();
+  return 1;
+}
+
+int MXTRecordIOReaderSeek(void *reader, int64_t pos) {
+  auto *r = static_cast<Reader *>(reader);
+  return std::fseek(r->fp, static_cast<long>(pos), SEEK_SET) == 0 ? 0 : -1;
+}
+
+int64_t MXTRecordIOReaderTell(void *reader) {
+  return std::ftell(static_cast<Reader *>(reader)->fp);
+}
+
+int MXTRecordIOReaderClose(void *reader) {
+  auto *r = static_cast<Reader *>(reader);
+  int rc = std::fclose(r->fp);
+  delete r;
+  return rc == 0 ? 0 : -1;
+}
+
+}  // extern "C"

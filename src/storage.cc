@@ -1,0 +1,125 @@
+/*
+ * Pooled host storage manager.
+ *
+ * TPU-native rebuild of the reference's GPU memory pool
+ * (ref src/storage/pooled_storage_manager.h GPUPooledStorageManager:
+ * size-bucketed free lists, reserve watermark) for *host* staging
+ * buffers: infeed batches, checkpoint shards, recordio scratch. HBM
+ * is managed by XLA; the host side still wants recycling to avoid
+ * malloc churn in the input pipeline.
+ */
+#include "mxtpu_runtime.h"
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+
+inline size_t RoundSize(size_t size) {
+  /* bucket to the next power of two ≥ 4 KiB granule (ref
+   * GPUPooledStorageManager::GetSize rounding) */
+  size_t s = 4096;
+  while (s < size) s <<= 1;
+  return s;
+}
+
+class StoragePool {
+ public:
+  explicit StoragePool(size_t max_cached) : max_cached_(max_cached) {}
+
+  ~StoragePool() { Drain(); }
+
+  void *Alloc(size_t size) {
+    size_t bucket = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(bucket);
+      if (it != free_.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        cached_bytes_ -= bucket;
+        live_bytes_ += bucket;
+        ++hits_;
+        return p;
+      }
+      ++misses_;
+      live_bytes_ += bucket;
+    }
+    void *p = nullptr;
+    if (posix_memalign(&p, kAlign, bucket) != 0) {
+      std::lock_guard<std::mutex> lk(mu_);
+      live_bytes_ -= bucket;
+      return nullptr;
+    }
+    return p;
+  }
+
+  void Release(void *ptr, size_t size) {
+    size_t bucket = RoundSize(size);
+    std::lock_guard<std::mutex> lk(mu_);
+    live_bytes_ -= bucket;
+    if (cached_bytes_ + bucket <= max_cached_) {
+      free_[bucket].push_back(ptr);
+      cached_bytes_ += bucket;
+    } else {
+      std::free(ptr);
+    }
+  }
+
+  void Drain() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto &kv : free_)
+      for (void *p : kv.second) std::free(p);
+    free_.clear();
+    cached_bytes_ = 0;
+  }
+
+  void Stats(int64_t *live, int64_t *cached, int64_t *hits, int64_t *misses) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (live) *live = live_bytes_;
+    if (cached) *cached = cached_bytes_;
+    if (hits) *hits = hits_;
+    if (misses) *misses = misses_;
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<size_t, std::vector<void *>> free_;
+  size_t max_cached_;
+  int64_t live_bytes_ = 0, cached_bytes_ = 0, hits_ = 0, misses_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *MXTStoragePoolCreate(size_t max_cached_bytes) {
+  return new StoragePool(max_cached_bytes);
+}
+
+void MXTStoragePoolFree(void *pool) { delete static_cast<StoragePool *>(pool); }
+
+void *MXTStorageAlloc(void *pool, size_t size) {
+  return static_cast<StoragePool *>(pool)->Alloc(size);
+}
+
+void MXTStorageRelease(void *pool, void *ptr, size_t size) {
+  static_cast<StoragePool *>(pool)->Release(ptr, size);
+}
+
+void MXTStoragePoolStats(void *pool, int64_t *live_bytes,
+                         int64_t *cached_bytes, int64_t *hits,
+                         int64_t *misses) {
+  static_cast<StoragePool *>(pool)->Stats(live_bytes, cached_bytes, hits,
+                                          misses);
+}
+
+void MXTStoragePoolDrain(void *pool) {
+  static_cast<StoragePool *>(pool)->Drain();
+}
+
+}  // extern "C"

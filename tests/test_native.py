@@ -1,0 +1,274 @@
+"""Native host runtime tests: dependency engine, storage pool, recordio.
+
+Models: tests/cpp/engine/threaded_engine_test.cc (random dependency
+stress), tests/cpp/storage/storage_test.cc, tests/python/unittest/
+test_recordio.py (SURVEY §4).
+"""
+import gc
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as mxe
+from mxnet_tpu import recordio
+from mxnet_tpu import _native
+
+
+requires_native = pytest.mark.skipif(
+    _native.get_lib() is None, reason="native runtime unavailable")
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+@requires_native
+def test_engine_write_read_ordering():
+    eng = mxe.ThreadedEngine(4)
+    v = eng.new_var()
+    order = []
+
+    def slow_write():
+        time.sleep(0.05)
+        order.append("w1")
+
+    eng.push(slow_write, mutable_vars=[v])
+    eng.push(lambda: order.append("r1"), const_vars=[v])
+    eng.push(lambda: order.append("r2"), const_vars=[v])
+    eng.push(lambda: order.append("w2"), mutable_vars=[v])
+    eng.wait_for_all()
+    assert order[0] == "w1" and order[-1] == "w2"
+    assert set(order[1:3]) == {"r1", "r2"}
+
+
+@requires_native
+def test_engine_serializes_writers():
+    eng = mxe.ThreadedEngine(8)
+    v = eng.new_var()
+    state = {"x": 0}
+
+    def inc():
+        # read-modify-write: only safe if writes are exclusive + ordered
+        cur = state["x"]
+        state["x"] = cur + 1
+
+    for _ in range(2000):
+        eng.push(inc, mutable_vars=[v])
+    eng.wait_for_all()
+    assert state["x"] == 2000
+
+
+@requires_native
+def test_engine_random_dependency_stress():
+    """Random var sets (threaded_engine_test.cc pattern): per-var
+    monotonic version stamps must be observed by readers."""
+    rng = np.random.RandomState(0)
+    eng = mxe.ThreadedEngine(8)
+    nvars = 10
+    vars_ = [eng.new_var() for _ in range(nvars)]
+    versions = [0] * nvars
+    lock = threading.Lock()
+    failures = []
+
+    def make_writer(idxs, expect):
+        def fn():
+            with lock:
+                for i, e in zip(idxs, expect):
+                    if versions[i] != e:
+                        failures.append((i, versions[i], e))
+                for i in idxs:
+                    versions[i] += 1
+        return fn
+
+    expected = [0] * nvars
+    for _ in range(300):
+        k = rng.randint(1, 4)
+        idxs = sorted(rng.choice(nvars, size=k, replace=False).tolist())
+        eng.push(make_writer(idxs, [expected[i] for i in idxs]),
+                 mutable_vars=[vars_[i] for i in idxs])
+        for i in idxs:
+            expected[i] += 1
+    eng.wait_for_all()
+    assert not failures, failures[:5]
+    assert versions == expected
+
+
+@requires_native
+def test_engine_dedups_overlapping_vars():
+    """Same var as const+mutable (or repeated) must not deadlock."""
+    eng = mxe.ThreadedEngine(2)
+    v = eng.new_var()
+    out = []
+    eng.push(lambda: out.append(1), const_vars=[v], mutable_vars=[v])
+    eng.push(lambda: out.append(2), const_vars=[v, v])
+    eng.push(lambda: out.append(3), mutable_vars=[v, v])
+    eng.wait_for_all()
+    assert out == [1, 2, 3]
+
+
+@requires_native
+def test_engine_wait_unknown_var_raises():
+    eng = mxe.ThreadedEngine(2)
+    with pytest.raises(Exception):
+        eng.wait_for_var(10**9)
+
+
+@requires_native
+def test_engine_wait_for_var():
+    eng = mxe.ThreadedEngine(2)
+    v = eng.new_var()
+    done = []
+
+    def slow():
+        time.sleep(0.1)
+        done.append(1)
+
+    eng.push(slow, mutable_vars=[v])
+    eng.wait_for_var(v)
+    assert done == [1]
+
+
+def test_naive_engine_and_factory():
+    eng = mxe.NaiveEngine()
+    out = []
+    eng.push(lambda: out.append(1), mutable_vars=[eng.new_var()])
+    assert out == [1]
+    assert mxe.create("NaiveEngine").__class__ is mxe.NaiveEngine
+    prev = mxe.set_bulk_size(16)
+    with mxe.bulk(32):
+        pass
+    mxe.set_bulk_size(prev)
+
+
+# ---------------------------------------------------------------------------
+# storage pool
+# ---------------------------------------------------------------------------
+@requires_native
+def test_storage_pool_reuse_and_stats():
+    from mxnet_tpu.storage import StoragePool
+
+    pool = StoragePool(1 << 22)
+    a = pool.empty((64, 64), np.float32)
+    a[:] = 2.0
+    assert float(a.sum()) == 2.0 * 64 * 64
+    del a
+    gc.collect()
+    b = pool.empty((64, 64), np.float32)  # same bucket → pool hit
+    st = pool.stats()
+    assert st["hits"] >= 1
+    assert st["live_bytes"] > 0
+    del b
+    gc.collect()
+    pool.drain()
+    assert pool.stats()["cached_bytes"] == 0
+
+
+@requires_native
+def test_storage_pool_views_keep_buffer_alive():
+    from mxnet_tpu.storage import StoragePool
+
+    pool = StoragePool(1 << 20)
+    a = pool.empty((32, 32), np.float32)
+    a[:] = 7.0
+    view = a[3:5]
+    del a
+    gc.collect()
+    # buffer must not have been recycled while a view exists
+    assert float(view.sum()) == 7.0 * 2 * 32
+
+
+# ---------------------------------------------------------------------------
+# recordio (native <-> python byte compatibility)
+# ---------------------------------------------------------------------------
+def _roundtrip(tmp_path, writer_native, reader_native, monkeypatch):
+    rng = np.random.RandomState(0)
+    recs = [bytes(rng.bytes(int(rng.randint(1, 512)))) for _ in range(100)]
+    recs.append(b"\x0a\x23\xd7\xce" * 8)  # payload containing the magic
+    path = str(tmp_path / "t.rec")
+
+    monkeypatch.setenv("MXNET_TPU_NO_NATIVE", "0" if writer_native else "1")
+    _native._LIB = None
+    w = recordio.MXRecordIO(path, "w")
+    for r in recs:
+        w.write(r)
+    w.close()
+
+    monkeypatch.setenv("MXNET_TPU_NO_NATIVE", "0" if reader_native else "1")
+    _native._LIB = None
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        b = r.read()
+        if b is None:
+            break
+        got.append(b)
+    r.close()
+    _native._LIB = None
+    assert got == recs
+
+
+@pytest.mark.parametrize("writer_native,reader_native",
+                         [(True, True), (True, False), (False, True)])
+def test_recordio_native_python_compat(tmp_path, monkeypatch, writer_native,
+                                       reader_native):
+    if (writer_native or reader_native) and _native.get_lib() is None:
+        pytest.skip("native runtime unavailable")
+    _roundtrip(tmp_path, writer_native, reader_native, monkeypatch)
+
+
+def test_recordio_split_record_reassembly(tmp_path, monkeypatch):
+    """cflag 1/3 chunked records (dmlc splits payloads at embedded magic
+    words) reassemble identically on the python and native readers."""
+    import struct
+
+    magic = 0xCED7230A
+    path = str(tmp_path / "split.rec")
+    with open(path, "wb") as f:
+        def chunk(cflag, payload):
+            f.write(struct.pack("<II", magic, (cflag << 29) | len(payload)))
+            f.write(payload)
+            f.write(b"\0" * ((4 - len(payload) % 4) % 4))
+        chunk(1, b"AB")
+        chunk(3, b"CD")
+        chunk(0, b"plain")
+    want = b"AB" + struct.pack("<I", magic) + b"CD"
+
+    for native_flag in ("1", "0"):
+        if native_flag == "0" and _native.get_lib() is None:
+            continue
+        monkeypatch.setenv("MXNET_TPU_NO_NATIVE", native_flag)
+        _native._LIB = None
+        r = recordio.MXRecordIO(path, "r")
+        assert r.read() == want
+        assert r.read() == b"plain"
+        assert r.read() is None
+        r.close()
+    _native._LIB = None
+
+
+def test_storage_pool_zero_sized(tmp_path):
+    if _native.get_lib() is None:
+        pytest.skip("native runtime unavailable")
+    from mxnet_tpu.storage import StoragePool
+
+    pool = StoragePool(1 << 16)
+    z = pool.empty((0, 4), np.float32)
+    assert z.shape == (0, 4) and z.size == 0
+
+
+@requires_native
+def test_indexed_recordio_native(tmp_path):
+    path = str(tmp_path / "x.rec")
+    idxp = str(tmp_path / "x.idx")
+    recs = [os.urandom(100 + i) for i in range(20)]
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i, r in enumerate(recs):
+        w.write_idx(i, r)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.read_idx(13) == recs[13]
+    assert r.read_idx(0) == recs[0]
+    assert r.read_idx(19) == recs[19]
+    r.close()
