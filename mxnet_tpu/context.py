@@ -119,11 +119,16 @@ def _backend_devices(platform):
             if "__default__" not in _DEVICE_CACHE:
                 # initialize the default backend set first — querying a
                 # specific platform before general init breaks plugin
-                # discovery (observed with the axon TPU plugin)
-                _DEVICE_CACHE["__default__"] = tuple(jax.devices())
+                # discovery (observed with the axon TPU plugin). Only
+                # this process's addressable devices are usable as
+                # NDArray homes (multi-host: jax.devices() includes
+                # other workers' devices).
+                jax.devices()
+                _DEVICE_CACHE["__default__"] = tuple(jax.local_devices())
             if platform != "__default__":
                 try:
-                    _DEVICE_CACHE[platform] = tuple(jax.devices(platform))
+                    _DEVICE_CACHE[platform] = tuple(
+                        jax.local_devices(backend=platform))
                 except RuntimeError:
                     _DEVICE_CACHE[platform] = ()
         return _DEVICE_CACHE[platform]

@@ -1,0 +1,144 @@
+"""Multi-host distributed runtime (the ps-lite/tracker replacement).
+
+Reference counterpart: ps-lite worker/server/scheduler over ZeroMQ +
+dmlc tracker (SURVEY §2.4, §5.8: kvstore_dist.h, tools/launch.py). The
+TPU-native design has **no server processes**: every worker process joins
+one jax.distributed job (GRPC coordinator = the scheduler's rendezvous
+role); all devices form a single global mesh whose outermost axis spans
+hosts (DCN), and gradient sync is an XLA all-reduce riding ICI within a
+host/slice and DCN across — compiled into the step, not a runtime
+service.
+
+Environment (set by tools/launch.py; DMLC_* aliases accepted for
+reference-script compatibility):
+- MXNET_TPU_COORDINATOR   host:port  (DMLC_PS_ROOT_URI/PORT)
+- MXNET_TPU_NUM_WORKERS   int        (DMLC_NUM_WORKER)
+- MXNET_TPU_WORKER_RANK   int        (DMLC_WORKER_ID)
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .base import MXNetError
+
+_INITIALIZED = False
+
+
+def env_spec():
+    """Read the launcher env; returns (coordinator, num, rank) or None."""
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if coord is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        coord = "%s:%s" % (os.environ["DMLC_PS_ROOT_URI"],
+                           os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    num = os.environ.get("MXNET_TPU_NUM_WORKERS",
+                         os.environ.get("DMLC_NUM_WORKER"))
+    rank = os.environ.get("MXNET_TPU_WORKER_RANK",
+                          os.environ.get("DMLC_WORKER_ID"))
+    if coord is None or num is None or rank is None:
+        return None
+    return coord, int(num), int(rank)
+
+
+def init_from_env():
+    """jax.distributed.initialize from the launcher env (idempotent).
+
+    Returns True if running multi-process, False for single-process.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    spec = env_spec()
+    if spec is None:
+        return False
+    import jax
+
+    coord, num, rank = spec
+    if num <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coord, num_processes=num,
+                               process_id=rank)
+    _INITIALIZED = True
+    return True
+
+
+def is_initialized():
+    return _INITIALIZED
+
+
+def rank():
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+
+    return jax.process_count()
+
+
+def global_mesh(axes=None):
+    """Global mesh over all processes' devices, hosts on the outermost
+    axis (DCN) — jax collectives ride DCN across it, ICI within a host.
+
+    axes: {name: size} for the *within-host* layout; a leading "dcn" axis
+    of size num_processes is prepended automatically when multi-process
+    (and merged into the first data axis by consumers that want one flat
+    data-parallel axis)."""
+    import jax
+    from jax.sharding import Mesh
+
+    nproc = jax.process_count()
+    local = jax.local_device_count()
+    devices = np.asarray(jax.devices())
+    if axes is None:
+        axes = {"dp": local}
+    sizes = list(axes.values())
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        sizes[sizes.index(-1)] = local // known
+    if int(np.prod(sizes)) != local:
+        raise MXNetError("global_mesh: axes %r must use all %d local devices"
+                         % (axes, local))
+    if nproc > 1:
+        return Mesh(devices.reshape([nproc] + sizes),
+                    ("dcn",) + tuple(axes.keys()))
+    return Mesh(devices.reshape(sizes), tuple(axes.keys()))
+
+
+def allreduce(value):
+    """Sum a host-local numpy array across all worker processes; the
+    result is identical (replicated) on every worker.
+
+    This is the KVStore-dist push semantics (kvstore_dist.h Push_ →
+    server-side aggregation) as one XLA collective: each process
+    contributes its slice of a stacked (num_workers, ...) array and the
+    sum collapses the worker axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    value = np.asarray(value)
+    nproc = num_workers()
+    if nproc == 1 or not _INITIALIZED:
+        return value
+    mesh = global_mesh()
+    axis0 = mesh.axis_names[0]                      # "dcn"
+    sh = NamedSharding(mesh, P(axis0))
+    garr = jax.make_array_from_process_local_data(
+        sh, value[None], global_shape=(nproc,) + value.shape)
+    out = jax.jit(
+        lambda x: jnp.sum(x, axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )(garr)
+    return np.asarray(out)
+
+
+def barrier():
+    """Block until every worker reaches the barrier (ref
+    KVStore::Barrier, kvstore.h:254-311)."""
+    if not _INITIALIZED:
+        return
+    allreduce(np.zeros((1,), np.float32))

@@ -225,6 +225,52 @@ class TPUKVStore(KVStore):
         self._mesh = None  # lazy; tests may build their own
 
 
+class DistKVStore(TPUKVStore):
+    """dist_sync / dist_async / dist_sync_device over jax.distributed.
+
+    Reference counterpart: KVStoreDist worker + KVStoreDistServer
+    (kvstore_dist.h:49, kvstore_dist_server.h:113). Serverless TPU
+    design: every worker joined one jax.distributed job (launched by
+    tools/launch.py); ``push`` reduces locally then all-reduces across
+    workers with one XLA collective over the DCN mesh axis — the
+    server-side merge-buffer aggregation becomes a compiled sum. The
+    updater then runs identically on every worker (replacing the
+    server-side optimizer), so weights stay bit-identical without a
+    pull round-trip. dist_async maps to the same synchronous collective
+    (no stale-gradient tier exists on a single-controller mesh).
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        from . import dist
+
+        dist.init_from_env()
+
+    def push(self, key, value, priority=0):
+        from . import dist
+
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("kvstore: key %r not initialized" % (k,))
+            agg = self._reduce(vlist)
+            if self._compression_params is not None:
+                agg = self._compress_decompress(k, agg)
+            total = dist.allreduce(agg.asnumpy())
+            agg = NDArray(total, ctx=agg.ctx)
+            if self._updater is not None:
+                self._updater(self._normalize_key(k), agg, self._store[k])
+            else:
+                self._store[k] += agg
+
+    def barrier(self):
+        from . import dist
+
+        nd.waitall()
+        dist.barrier()
+
+
 def create(name="local"):
     """Create a KVStore (ref: kvstore.cc:38-66 factory)."""
     if not isinstance(name, str):
@@ -235,7 +281,5 @@ def create(name="local"):
     if name in ("tpu", "dist_sync_tpu"):
         return TPUKVStore(name)
     if name.startswith("dist"):
-        # dist tiers: single-controller JAX — worker processes join a global
-        # mesh instead of talking to servers; same in-process store per host.
-        return TPUKVStore(name)
+        return DistKVStore(name)
     raise MXNetError("unknown kvstore type %r" % name)

@@ -1,0 +1,29 @@
+"""KVStore server entry point — serverless-parity shim.
+
+Reference counterpart: ``python/mxnet/kvstore_server.py`` (the server
+main loop driven by DMLC_ROLE=server, executing optimizer updates on
+sharded keys; kvstore_dist_server.h:113). The TPU backend has **no
+server processes** — aggregation is an XLA all-reduce over the device
+mesh and the optimizer runs replicated (or ZeRO-sharded) on workers
+(see kvstore.DistKVStore, parallel/spmd.py zero=True).
+
+This module keeps reference launch scripts working: a process started
+with DMLC_ROLE=server or =scheduler exits immediately with success
+(the jax coordinator, spawned inside worker 0's process, already plays
+the scheduler's rendezvous role).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    if role in ("server", "scheduler"):
+        # serverless backend: nothing to run (see module docstring)
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    _init_kvstore_server_module()

@@ -239,6 +239,11 @@ def make_loss_fn(config, mesh, data_axes=("dp",)):
     axes = set(mesh.axis_names)
     specs = param_specs(c, mesh)
 
+    # every mesh axis the batch/sequence is split over must join the
+    # loss psum (incl. a multi-host "dcn" axis ahead of dp)
+    reduce_axes = tuple(a for a in mesh.axis_names
+                        if a in set(data_axes) | {"sp"})
+
     def local_loss(params, tokens):
         inp, tgt = tokens[:, :-1], tokens[:, 1:]
         logits = _forward_local(params, inp, c, axes)
@@ -247,10 +252,9 @@ def make_loss_fn(config, mesh, data_axes=("dp",)):
                                    axis=-1)[..., 0]
         loss_sum = jnp.sum(nll)
         count = jnp.float32(nll.size)
-        psum_axes = tuple(a for a in ("dp", "sp") if a in axes)
-        if psum_axes:
-            loss_sum = lax.psum(loss_sum, psum_axes)
-            count = lax.psum(count, psum_axes)
+        if reduce_axes:
+            loss_sum = lax.psum(loss_sum, reduce_axes)
+            count = lax.psum(count, reduce_axes)
         return loss_sum / count
 
     # tokens enter with seq split over sp: shard (B_loc, S_loc + 1) needs

@@ -1,0 +1,81 @@
+"""Worker script for the multi-process dist test — run via
+tools/launch.py (reference pattern: tests/nightly/dist_sync_kvstore.py
+value-identity invariants on the local tracker).
+
+Asserts, on every worker:
+- rank/num_workers from the launcher env
+- kv push aggregates across workers (sum of per-worker grads)
+- result identical on all workers (sync invariant)
+- barrier completes
+- dist training step: global-mesh TrainStep loss finite and identical
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import dist, nd
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    n = kv.num_workers
+    r = kv.rank
+    assert n == int(os.environ["MXNET_TPU_NUM_WORKERS"]), (n, os.environ)
+    assert r == int(os.environ["MXNET_TPU_WORKER_RANK"]), r
+
+    # --- push/pull identity: sum over workers -------------------------
+    kv.init("w", nd.zeros((4, 4)))
+    grad = nd.ones((4, 4)) * (r + 1)
+    kv.push("w", grad)
+    out = nd.zeros((4, 4))
+    kv.pull("w", out=out)
+    expect = sum(range(1, n + 1))  # no updater → store += sum of pushes
+    got = out.asnumpy()
+    assert np.allclose(got, expect), (r, got[0, 0], expect)
+
+    kv.barrier()
+
+    # --- global-mesh fused training step ------------------------------
+    from mxnet_tpu.models import transformer as tfm
+
+    mesh = dist.global_mesh({"dp": -1})
+    data_axes = mesh.axis_names  # ("dcn", "dp") multi-proc, ("dp",) single
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                                d_ff=64, max_len=32, dtype="float32")
+    step, place = tfm.make_train_step(
+        cfg, mesh, optimizer=dict(name="sgd", learning_rate=0.1),
+        data_axes=data_axes)
+    carry = place(tfm.init_params(cfg, seed=0))
+    # every worker supplies its local slice of the global batch
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gbatch = 8 * n
+    rng = np.random.RandomState(0)
+    all_toks = rng.randint(0, 64, (gbatch, 17)).astype(np.int32)
+    sh = NamedSharding(mesh, P(data_axes))
+    local = all_toks[r * 8:(r + 1) * 8]
+    toks = jax.make_array_from_process_local_data(
+        sh, local, global_shape=all_toks.shape)
+    carry, loss = step(carry, toks)
+    carry, loss = step(carry, toks)
+    lv = float(loss)
+    assert np.isfinite(lv), lv
+    # identical loss on every worker (sync-invariant, multi_lenet.py style)
+    agreed = dist.allreduce(np.asarray([lv], np.float32))
+    assert abs(agreed[0] - n * lv) < 1e-4 * max(1.0, abs(n * lv)), (agreed, lv)
+
+    print("DIST_CHECK_OK rank=%d loss=%.4f" % (r, lv), flush=True)
+
+
+if __name__ == "__main__":
+    main()

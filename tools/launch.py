@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Distributed job launcher — the dmlc tracker replacement.
+
+Reference counterpart: ``tools/launch.py`` → dmlc-core tracker spawning
+scheduler + servers + workers over ssh/mpi/local (SURVEY §2.4). The
+TPU-native job has only **workers** (one process per host; the jax
+coordinator plays the scheduler's rendezvous role, there are no
+parameter servers), so this launcher spawns N worker processes with the
+rendezvous env and waits.
+
+Usage (reference-compatible):
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+Modes:
+    --launcher local  (default) N processes on this host, each seeing
+                      the same devices (CPU testing: combine with
+                      XLA_FLAGS=--xla_force_host_platform_device_count=K)
+    --launcher manual print the env each host must export, for running
+                      one process per host by hand / with your own
+                      orchestrator (k8s, slurm, GKE).
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference compatibility; the TPU "
+                         "backend has no server processes (ignored)")
+    ap.add_argument("--launcher", choices=("local", "manual"),
+                    default="local")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port rendezvous (default: 127.0.0.1:random)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for workers (repeatable)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
+
+    def worker_env(rank):
+        env = dict(os.environ)
+        env["MXNET_TPU_COORDINATOR"] = coord
+        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_TPU_WORKER_RANK"] = str(rank)
+        # DMLC aliases so reference scripts keep working
+        host, port = coord.rsplit(":", 1)
+        env["DMLC_PS_ROOT_URI"] = host
+        env["DMLC_PS_ROOT_PORT"] = port
+        env["DMLC_NUM_WORKER"] = str(args.num_workers)
+        env["DMLC_WORKER_ID"] = str(rank)
+        env["DMLC_ROLE"] = "worker"
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        return env
+
+    if args.launcher == "manual":
+        print("# export on host i (i = 0..%d):" % (args.num_workers - 1))
+        for k, v in sorted(worker_env(0).items()):
+            if k.startswith(("MXNET_TPU_", "DMLC_")):
+                v = "<rank>" if k in ("MXNET_TPU_WORKER_RANK",
+                                      "DMLC_WORKER_ID") else v
+                print("export %s=%s" % (k, v))
+        print("# then run on every host: %s" % " ".join(args.command))
+        return 0
+
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            procs.append(subprocess.Popen(args.command,
+                                          env=worker_env(rank)))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
