@@ -39,7 +39,20 @@ from . import model  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import gluon  # noqa: F401
+from . import operator  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from . import rtc  # noqa: F401
+from . import log  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import executor_manager  # noqa: F401
+from . import storage  # noqa: F401
 from . import profiler  # noqa: F401
+from . import engine  # noqa: F401
+from . import dist  # noqa: F401
 from . import test_utils  # noqa: F401
 
 from .model import load_checkpoint, save_checkpoint  # noqa: F401

@@ -1,0 +1,55 @@
+"""Attribute scoping for symbols (``mx.AttrScope``).
+
+Reference counterpart: ``python/mxnet/attribute.py`` — a context manager
+stamping user attributes (``ctx_group``, ``lr_mult``, …) onto every
+symbol created inside the scope. ``ctx_group`` is how the reference
+expresses manual model parallelism (``group2ctx``, SURVEY §2.4); the
+executor maps ctx groups onto mesh submeshes.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_TLS = threading.local()
+
+
+def _stack():
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = []
+    return _TLS.stack
+
+
+def current_attrs():
+    """Merged attrs of all active scopes (inner wins)."""
+    out = {}
+    for scope in _stack():
+        out.update(scope._attrs)
+    return out
+
+
+class AttrScope:
+    """``with mx.AttrScope(ctx_group='dev1', lr_mult='0.1'): ...``"""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                kwargs[k] = str(v)
+        self._attrs = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs into an explicit attr dict (scope loses)."""
+        out = current_attrs()
+        out.update(self._attrs)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _stack().pop()
+        return False

@@ -51,6 +51,12 @@ _NAME_COUNTER = _NameCounter()
 
 
 def auto_name(prefix: str) -> str:
+    # route through an active mx.name.NameManager/Prefix scope if any
+    import sys
+
+    name_mod = sys.modules.get("mxnet_tpu.name")
+    if name_mod is not None:
+        return name_mod._auto_name(prefix)
     return _NAME_COUNTER.get(prefix.lower())
 
 

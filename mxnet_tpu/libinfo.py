@@ -1,0 +1,48 @@
+"""Library location / feature info (``mx.libinfo``).
+
+Reference counterpart: ``python/mxnet/libinfo.py`` — ``find_lib_path``
+locating libmxnet.so. Here the native library is the host runtime
+``libmxtpu_runtime.so`` (src/, built on demand); the compute "library"
+is XLA, reported via features().
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Path list of the native runtime library (ref libinfo.py:find_lib_path).
+
+    Empty list when the native runtime is unavailable (pure-Python mode) —
+    the reference raises instead, but here native is optional by design.
+    """
+    from . import _native
+
+    lib = _native.get_lib()
+    if lib is None:
+        return []
+    return [_native._lib_path()]
+
+
+def find_include_path():
+    """Path of the C ABI header (ref libinfo.py:find_include_path)."""
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+    return src if os.path.isdir(src) else ""
+
+
+def features():
+    """Build/runtime feature flags (ref: mx.runtime.Features)."""
+    import jax
+
+    from . import _native
+
+    return {
+        "NATIVE_RUNTIME": _native.get_lib() is not None,
+        "BACKEND": jax.default_backend(),
+        "DEVICES": len(jax.devices()),
+        "PALLAS": True,
+        "DIST": True,
+    }

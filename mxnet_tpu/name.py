@@ -1,0 +1,70 @@
+"""Automatic naming of symbols/blocks (``mx.name``).
+
+Reference counterpart: ``python/mxnet/name.py`` — ``NameManager`` context
+assigns ``convolution0``-style names; ``Prefix`` prepends a scope prefix.
+``base.auto_name`` consults the innermost active manager.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import base
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_TLS = threading.local()
+
+
+def _stack():
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = []
+    return _TLS.stack
+
+
+def current():
+    """The innermost active NameManager, or None."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+class NameManager:
+    """Assigns per-prefix sequential names (ref name.py NameManager)."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old_scope = None
+
+    def get(self, name, hint):
+        """Explicit name wins; otherwise hint + counter."""
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _stack().pop()
+        return False
+
+
+class Prefix(NameManager):
+    """Prefixes every auto name (ref name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)
+
+
+def _auto_name(hint):
+    """Hook used by base.auto_name: route through the active manager."""
+    mgr = current()
+    if mgr is not None:
+        return mgr.get(None, hint.lower())
+    return base._NAME_COUNTER.get(hint.lower())

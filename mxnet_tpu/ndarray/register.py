@@ -32,9 +32,23 @@ def _make_op_func(op):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
         if var_inputs:
+            # kwarg tensors follow positionals; when the op defines an
+            # input order (Custom: prop.list_arguments()), named tensors
+            # bind by NAME, not kwarg insertion order
             tensors = [a for a in args if isinstance(a, NDArray)]
+            named = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
             attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
             attrs.pop("num_args", None)
+            if named and op.kwarg_input_order is not None:
+                order = op.kwarg_input_order(attrs)
+                unknown = set(named) - set(order)
+                if unknown:
+                    raise MXNetError(
+                        "op %s: tensor kwargs %s not in its argument "
+                        "list %s" % (op.name, sorted(unknown), order))
+                tensors += [named[k] for k in order if k in named]
+            else:
+                tensors += list(named.values())
         else:
             # merge positional + named tensors into signature order; scalar
             # positionals map onto attr slots in signature order (parity with

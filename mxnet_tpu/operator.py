@@ -1,0 +1,261 @@
+"""Custom operators written in Python (``mx.operator``).
+
+Reference counterpart: ``python/mxnet/operator.py`` (887 LoC) +
+``src/operator/custom/custom.cc:50-414``: user forward/backward callbacks
+invoked from the C++ engine through ctypes function pointers on a
+dedicated custom-op thread. TPU-native design: the callback crosses the
+XLA boundary via ``jax.pure_callback`` (SURVEY §7 "hard parts"), so a
+``Custom`` node works identically in the imperative path, inside
+``jax.jit``-compiled symbolic graphs, and under autograd (a
+``jax.custom_vjp`` routes gradients through the user's ``backward``).
+
+User surface (same as reference):
+
+    @mx.operator.register("softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ['data']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]]
+        def create_operator(self, ctx, shapes, dtypes): return Softmax()
+
+    out = mx.nd.Custom(x, op_type="softmax")
+    sym = mx.sym.Custom(data=d, op_type="softmax")
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
+
+_CUSTOM_PROPS = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations (ref:
+    operator.py:418 CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Assign src to dst per req (ref operator.py:455)."""
+        if req in ("null", 0):
+            return
+        if req in ("write", "inplace", 1, 2):
+            dst[:] = src
+        elif req in ("add", 3):
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %r" % (req,))
+
+
+class CustomOpProp:
+    """Declarative half: shapes/dtypes/arity (ref operator.py:464)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (ref operator.py:598)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("%r must subclass CustomOpProp" % prop_cls)
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered():
+    return dict(_CUSTOM_PROPS)
+
+
+# ---------------------------------------------------------------------------
+# execution bridge (the custom.cc equivalent)
+# ---------------------------------------------------------------------------
+def make_prop(op_type, kwargs):
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError(
+            "custom op type %r is not registered (known: %s)"
+            % (op_type, sorted(_CUSTOM_PROPS)))
+    # reference passes kwargs as strings to the prop ctor
+    return _CUSTOM_PROPS[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+
+_PROP_CACHE = {}
+
+
+def _cached_prop(op_type, kwargs):
+    """Prop instance for metadata queries (arity, arg names) — cached so
+    graph traversals don't re-run user __init__ per query. Execution
+    paths build a fresh prop (user code may keep state on it)."""
+    key = (op_type, tuple(sorted((k, str(v)) for k, v in kwargs.items())))
+    if key not in _PROP_CACHE:
+        _PROP_CACHE[key] = make_prop(op_type, kwargs)
+    return _PROP_CACHE[key]
+
+
+def _normalize_infer(ret, what, n_out):
+    """Accept 2-tuple (in, out) or 3-tuple (in, out, aux) returns from
+    user infer_shape/infer_type (both allowed in the reference)."""
+    if not isinstance(ret, (tuple, list)) or len(ret) not in (2, 3):
+        raise MXNetError(
+            "custom op %s must return (in, out) or (in, out, aux)" % what)
+    ins, outs = ret[0], ret[1]
+    aux = ret[2] if len(ret) == 3 else []
+    if len(outs) != n_out:
+        raise MXNetError(
+            "custom op %s returned %d outputs, list_outputs() has %d"
+            % (what, len(outs), n_out))
+    return ins, outs, aux
+
+
+def _to_ndarrays(np_arrays):
+    from .ndarray import ndarray as nd
+
+    return [nd.array(a) for a in np_arrays]
+
+
+def custom_call(data, op_type, kwargs, is_train=True):
+    """Execute a custom op on jax values (tracers or concrete).
+
+    Shapes/dtypes come from the prop; the body runs host-side through
+    pure_callback; backward is a second callback wired via custom_vjp.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    prop = make_prop(op_type, kwargs)
+    n_out = len(prop.list_outputs())
+    if prop.list_auxiliary_states():
+        raise MXNetError(
+            "custom op %r declares auxiliary states — not supported by the "
+            "TPU callback bridge yet" % op_type)
+
+    in_shapes = [tuple(d.shape) for d in data]
+    _, out_shapes, _ = _normalize_infer(
+        prop.infer_shape([list(s) for s in in_shapes]), "infer_shape", n_out)
+    in_types = [np.dtype(d.dtype) for d in data]
+    _, out_types, _ = _normalize_infer(
+        prop.infer_type(in_types), "infer_type", n_out)
+    out_struct = [jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                  for s, t in zip(out_shapes, out_types)]
+    in_struct = [jax.ShapeDtypeStruct(tuple(s), t)
+                 for s, t in zip(in_shapes, in_types)]
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    def fwd_cb(*xs):
+        from .ndarray import ndarray as nd
+
+        in_nd = _to_ndarrays(xs)
+        out_nd = [nd.zeros(tuple(s.shape), dtype=s.dtype) for s in out_struct]
+        op.forward(is_train=is_train, req=["write"] * n_out, in_data=in_nd,
+                   out_data=out_nd, aux=[])
+        return [np.asarray(o.asnumpy(), dtype=s.dtype)
+                for o, s in zip(out_nd, out_struct)]
+
+    def bwd_cb(*args):
+        from .ndarray import ndarray as nd
+
+        xs = args[:len(data)]
+        ys = args[len(data):len(data) + n_out]
+        gys = args[len(data) + n_out:]
+        in_nd = _to_ndarrays(xs)
+        out_nd = _to_ndarrays(ys)
+        ograd_nd = _to_ndarrays(gys)
+        igrad_nd = [nd.zeros(tuple(s.shape), dtype=s.dtype)
+                    for s in in_struct]
+        op.backward(req=["write"] * len(data), out_grad=ograd_nd,
+                    in_data=in_nd, out_data=out_nd, in_grad=igrad_nd,
+                    aux=[])
+        return [np.asarray(g.asnumpy(), dtype=s.dtype)
+                for g, s in zip(igrad_nd, in_struct)]
+
+    @jax.custom_vjp
+    def run(*xs):
+        return tuple(jax.pure_callback(fwd_cb, out_struct, *xs))
+
+    def run_fwd(*xs):
+        ys = run(*xs)
+        return ys, (xs, ys)
+
+    def run_bwd(res, gys):
+        xs, ys = res
+        gxs = jax.pure_callback(bwd_cb, in_struct, *(xs + ys + tuple(gys)))
+        return tuple(gxs)
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(*(jnp.asarray(d) for d in data))
+    return out[0] if n_out == 1 else tuple(out)
+
+
+def _strip(attrs):
+    return {k: v for k, v in attrs.items()
+            if k not in ("op_type", "__is_train__")}
+
+
+def custom_num_outputs(attrs):
+    op_type = attrs.get("op_type", "")
+    return len(_cached_prop(op_type, _strip(attrs)).list_outputs())
+
+
+def custom_arg_order(attrs):
+    """list_arguments() of the prop — binds named tensor kwargs."""
+    op_type = attrs.get("op_type", "")
+    return list(_cached_prop(op_type, _strip(attrs)).list_arguments())
+
+
+# ---------------------------------------------------------------------------
+# legacy interfaces (ref operator.py PythonOp/NumpyOp/NDArrayOp) — the
+# reference itself deprecates these in favor of CustomOp
+# ---------------------------------------------------------------------------
+class PythonOp:
+    """Deprecated in the reference (operator.py:37); use CustomOp."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError(
+            "PythonOp/NumpyOp/NDArrayOp are deprecated legacy interfaces "
+            "(deprecated in the reference too) — subclass "
+            "mx.operator.CustomOp / CustomOpProp instead")
+
+
+NumpyOp = PythonOp
+NDArrayOp = PythonOp

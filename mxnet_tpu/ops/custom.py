@@ -1,0 +1,37 @@
+"""The ``Custom`` operator node — dispatches to Python CustomOp classes.
+
+Reference counterpart: ``src/operator/custom/custom.cc`` registering the
+``Custom`` op whose kernels call frontend callbacks. Here the op is a
+registry entry whose fn crosses into Python via jax.pure_callback
+(see mxnet_tpu/operator.py for the bridge and the user surface).
+"""
+from .registry import register
+
+
+def _num_outputs(attrs):
+    from ..operator import custom_num_outputs
+
+    return custom_num_outputs(attrs)
+
+
+@register(name="Custom", num_outputs=_num_outputs)
+def Custom(*data, op_type="", __is_train__=False, **kwargs):
+    """Apply a registered custom operator (ref: mx.nd.Custom).
+
+    Parameters: ``op_type`` names a class registered with
+    ``mx.operator.register``; remaining kwargs forward to its constructor.
+    """
+    from ..operator import custom_call
+
+    return custom_call(data, op_type, kwargs, is_train=__is_train__)
+
+
+def _arg_order(attrs):
+    from ..operator import custom_arg_order
+
+    return custom_arg_order(attrs)
+
+
+from .registry import get as _get_op  # noqa: E402
+
+_get_op("Custom").kwarg_input_order = _arg_order

@@ -57,6 +57,8 @@ class OpDef:
         "doc",
         "input_names",
         "var_inputs",
+        "var_attrs",
+        "kwarg_input_order",
     )
 
     def __init__(
@@ -80,6 +82,14 @@ class OpDef:
         self.num_visible_outputs = num_visible_outputs
         self.doc = fn.__doc__ or ""
         self.attr_defaults = _kwarg_defaults(fn, needs_rng)
+        # fn taking **kwargs accepts arbitrary attrs (Custom op: user
+        # kwargs forward to the CustomOpProp ctor uncoerced)
+        self.var_attrs = any(
+            p.kind is p.VAR_KEYWORD
+            for p in inspect.signature(fn).parameters.values())
+        # var-input ops may define how named tensor kwargs map to input
+        # order (Custom: the prop's list_arguments()); set post-register
+        self.kwarg_input_order = None
         self.input_names, self.var_inputs = _input_names(fn, needs_rng)
         for n in self.input_names:
             self.attr_defaults.pop(n, None)
@@ -101,6 +111,9 @@ class OpDef:
         out = {}
         for k, v in kwargs.items():
             if k not in self.attr_defaults:
+                if self.var_attrs:
+                    out[k] = v
+                    continue
                 raise MXNetError(
                     "op %s: unknown attribute %r (known: %s)"
                     % (self.name, k, sorted(self.attr_defaults))

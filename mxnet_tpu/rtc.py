@@ -1,0 +1,80 @@
+"""Runtime kernel compilation (``mx.rtc``).
+
+Reference counterpart: ``python/mxnet/rtc.py`` + ``src/common/rtc.cc`` —
+NVRTC-compiled CUDA source strings launched on NDArrays. The TPU-native
+equivalent compiles **Python source defining a JAX/Pallas kernel** at
+runtime: the source must define a function named like the requested
+kernel taking jax arrays; ``get_kernel(...).launch(args, ctx, ...)``
+jit-compiles it for the target device (grid/block dims are accepted for
+API compatibility and ignored — XLA/Mosaic choose the schedule).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+
+class CudaKernel:
+    """A compiled kernel handle (ref rtc.py CudaKernel)."""
+
+    def __init__(self, fn, name):
+        import jax
+
+        self._fn = fn
+        self._jit = jax.jit(fn)
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Run on the NDArray args; returns NDArray(s). grid/block/shared
+        accepted for reference API compatibility (XLA schedules)."""
+        from .ndarray.ndarray import NDArray
+
+        vals = [a._data() if isinstance(a, NDArray) else a for a in args]
+        out = self._jit(*vals)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o, ctx=ctx) for o in out)
+        return NDArray(out, ctx=ctx)
+
+
+class CudaModule:
+    """Compile kernel source at runtime (ref rtc.py CudaModule).
+
+    ``source`` is Python defining one or more kernel functions over jax
+    arrays (jnp / jax.lax / pallas all in scope)::
+
+        mod = mx.rtc.CudaModule('''
+        def axpy(a, x, y):
+            return a * x + y
+        ''')
+        k = mod.get_kernel("axpy", "")
+        out = k.launch([a, x, y], mx.tpu(0))
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        import jax
+        import jax.numpy as jnp
+
+        self._namespace = {"jax": jax, "jnp": jnp, "lax": jax.lax}
+        try:
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            self._namespace["pl"] = pl
+            self._namespace["pltpu"] = pltpu
+        except ImportError:
+            pass
+        try:
+            exec(compile(source, "<mx.rtc source>", "exec"), self._namespace)
+        except SyntaxError as e:
+            raise MXNetError("rtc: cannot compile kernel source: %s" % e)
+        self._exports = tuple(exports)
+
+    def get_kernel(self, name, signature=""):
+        """Fetch a kernel by function name; ``signature`` accepted for
+        reference API compatibility (types come from the arrays)."""
+        fn = self._namespace.get(name)
+        if fn is None or not callable(fn):
+            raise MXNetError("rtc: source defines no kernel %r" % name)
+        return CudaKernel(fn, name)

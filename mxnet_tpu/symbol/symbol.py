@@ -377,7 +377,10 @@ class Symbol:
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """Create a symbolic variable (ref: symbol.py var/Variable)."""
-    attr_dict = dict(attr or {})
+    from ..attribute import current_attrs
+
+    attr_dict = current_attrs()  # active AttrScope attrs (explicit wins)
+    attr_dict.update(attr or {})
     if shape is not None:
         attr_dict["__shape__"] = tuple(shape)
     if dtype is not None:

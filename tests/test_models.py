@@ -1,0 +1,58 @@
+"""Symbolic model zoo: shape inference + small forward checks.
+
+Model: the reference's example zoo consumed by train scripts
+(example/image-classification/symbols/, example/ssd/symbol/).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.models import get_symbol, ssd
+
+
+@pytest.mark.parametrize("net,shape", [
+    ("alexnet", (2, 3, 224, 224)),
+    ("vgg", (2, 3, 224, 224)),
+    ("googlenet", (2, 3, 224, 224)),
+    ("inception-bn", (2, 3, 224, 224)),
+    ("inception-v3", (2, 3, 299, 299)),
+    ("mobilenet", (2, 3, 224, 224)),
+    ("resnext", (2, 3, 224, 224)),
+    ("resnet", (2, 3, 224, 224)),
+])
+def test_model_zoo_shapes(net, shape):
+    kwargs = {"num_classes": 10}
+    if net == "resnet":
+        kwargs.update(num_layers=18, image_shape=(3, 224, 224))
+    s = get_symbol(net, **kwargs)
+    _, outs, _ = s.infer_shape(data=shape, softmax_label=(shape[0],))
+    assert outs[0] == (shape[0], 10)
+
+
+def test_mobilenet_forward_runs():
+    s = get_symbol("mobilenet", num_classes=7, multiplier=0.25)
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 96, 96)
+                 .astype(np.float32))
+    ex = s.simple_bind(mx.cpu(), data=(1, 3, 96, 96), softmax_label=(1,))
+    out = ex.forward(is_train=False, data=x)[0]
+    p = out.asnumpy()
+    assert p.shape == (1, 7)
+    assert abs(p.sum() - 1.0) < 1e-4
+
+
+def test_ssd_anchor_parity():
+    """300x300 VGG16-reduced pyramid must emit the canonical 8732 anchors
+    (example/ssd 77.8 mAP config)."""
+    strain = ssd.get_symbol_train(num_classes=20)
+    _, outs, _ = strain.infer_shape(data=(2, 3, 300, 300), label=(2, 4, 5))
+    cls_prob, loc_loss, cls_label = outs
+    assert cls_prob == (2, 21, 8732)
+    assert loc_loss == (2, 8732 * 4)
+    assert cls_label == (2, 8732)
+
+
+def test_ssd_detection_output_format():
+    sdet = ssd.get_symbol(num_classes=3)
+    _, outs, _ = sdet.infer_shape(data=(1, 3, 300, 300))
+    assert outs[0] == (1, 8732, 6)

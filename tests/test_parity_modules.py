@@ -1,0 +1,198 @@
+"""Parity-surface tests: custom ops, name/attr scopes, viz, rtc, libinfo.
+
+Models: tests/python/unittest/{test_operator.py custom-op section,
+test_symbol.py attr tests, test_viz.py} (SURVEY §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+# ---------------------------------------------------------------------------
+# mx.operator.CustomOp
+# ---------------------------------------------------------------------------
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        scale = self.scale
+
+        class Sqr(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], nd.array(scale * x * x))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                x = in_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0], nd.array(2 * scale * x * g))
+
+        return Sqr()
+
+
+def test_custom_op_imperative_and_grad():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.Custom(x, op_type="sqr", scale="3.0")
+    np.testing.assert_allclose(y.asnumpy(), 3 * x.asnumpy() ** 2)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.Custom(x, op_type="sqr", scale="2.0")
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy(), atol=1e-5)
+
+
+def test_custom_op_symbolic_in_graph():
+    data = mx.sym.var("data")
+    s = mx.sym.Custom(data=data, op_type="sqr", scale="1.5", name="sq")
+    s = mx.sym.sum(s)
+    x = nd.array(np.ones((2, 2), np.float32) * 2)
+    ex = s.bind(mx.cpu(), {"data": x})
+    out = ex.forward()[0]
+    assert abs(float(out.asnumpy()) - 1.5 * 4 * 4) < 1e-5
+
+
+@mx.operator.register("sub2_test")
+class Sub2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]  # 2-tuple return (reference-legal)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class Sub2(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] - in_data[1])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0])
+                self.assign(in_grad[1], req[0], -out_grad[0])
+
+        return Sub2()
+
+
+def test_custom_op_kwargs_bind_by_name_not_order():
+    a, b = nd.array([10.0]), nd.array([1.0])
+    assert float(nd.Custom(lhs=a, rhs=b, op_type="sub2_test").asnumpy()) == 9.0
+    assert float(nd.Custom(rhs=b, lhs=a, op_type="sub2_test").asnumpy()) == 9.0
+    sa, sb = mx.sym.var("a"), mx.sym.var("b")
+    ex = mx.sym.Custom(rhs=sb, lhs=sa, op_type="sub2_test").bind(
+        mx.cpu(), {"a": a, "b": b})
+    assert float(ex.forward()[0].asnumpy()) == 9.0
+
+
+def test_custom_op_sees_real_is_train_flag():
+    @mx.operator.register("trainflag_test")
+    class TFProp(mx.operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class TF(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    scale = 2.0 if is_train else 1.0
+                    self.assign(out_data[0], req[0], in_data[0] * scale)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return TF()
+
+    x = nd.array([3.0])
+    assert float(nd.Custom(x, op_type="trainflag_test").asnumpy()) == 3.0
+    with autograd.record():
+        y = nd.Custom(x, op_type="trainflag_test")
+    assert float(y.asnumpy()) == 6.0
+
+
+def test_custom_op_unknown_type_errors():
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        nd.Custom(nd.ones((2,)), op_type="no_such_op")
+
+
+def test_legacy_python_op_deprecated():
+    with pytest.raises(mx.MXNetError, match="deprecated"):
+        mx.operator.NumpyOp()
+
+
+# ---------------------------------------------------------------------------
+# name / attribute scopes
+# ---------------------------------------------------------------------------
+def test_name_prefix_scope():
+    x = mx.sym.var("x")
+    with mx.name.Prefix("net_"):
+        fc = mx.sym.FullyConnected(data=x, num_hidden=4)
+    assert fc.name.startswith("net_fullyconnected")
+    fc2 = mx.sym.FullyConnected(data=x, num_hidden=4)
+    assert not fc2.name.startswith("net_")
+
+
+def test_name_manager_counts_per_scope():
+    x = mx.sym.var("x")
+    with mx.name.NameManager():
+        a = mx.sym.FullyConnected(data=x, num_hidden=4)
+        b = mx.sym.FullyConnected(data=x, num_hidden=4)
+    assert a.name == "fullyconnected0"
+    assert b.name == "fullyconnected1"
+
+
+def test_attr_scope_stamps_symbols():
+    with mx.AttrScope(ctx_group="stage1", mark="yes"):
+        v = mx.sym.var("w")
+        fc = mx.sym.FullyConnected(data=v, num_hidden=4, name="fc_attr")
+    assert v.attr("ctx_group") == "stage1"
+    assert fc.attr("mark") == "yes"
+    # explicit attr beats scope
+    with mx.AttrScope(ctx_group="a"):
+        v2 = mx.sym.var("w2", attr={"ctx_group": "b"})
+    assert v2.attr("ctx_group") == "b"
+
+
+# ---------------------------------------------------------------------------
+# visualization / rtc / libinfo / engine bulk
+# ---------------------------------------------------------------------------
+def test_print_summary_counts_params(capsys):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    total = mx.viz.print_summary(net, shape={"data": (2, 16)})
+    out = capsys.readouterr().out
+    assert total == (16 * 8 + 8) + (8 * 4 + 4)
+    assert "fc1 (FullyConnected)" in out
+
+
+def test_rtc_module_compiles_and_launches():
+    mod = mx.rtc.CudaModule("""
+def saxpy(a, x, y):
+    return a * x + y
+""")
+    k = mod.get_kernel("saxpy", "const float a, float *x, float *y")
+    out = k.launch([nd.array([2.0]), nd.array([3.0]), nd.array([4.0])],
+                   mx.cpu(), (1, 1, 1), (1, 1, 1))
+    assert float(out.asnumpy()[0]) == 10.0
+    with pytest.raises(mx.MXNetError, match="no kernel"):
+        mod.get_kernel("nope")
+
+
+def test_libinfo_features():
+    f = mx.libinfo.features()
+    assert "NATIVE_RUNTIME" in f and "BACKEND" in f
+    assert isinstance(mx.libinfo.find_lib_path(), list)
+
+
+def test_split_input_slice():
+    slices = mx.executor_manager._split_input_slice(10, [1, 1, 2])
+    assert slices[0] == slice(0, 2) and slices[-1].stop == 10
