@@ -232,6 +232,7 @@ class HybridBlock(Block):
         pass
 
     def __call__(self, *args):
+        self._num_inputs = len(args)  # remembered for export()
         if self._active:
             out = self._call_cached(args)
             for hook in self._forward_hooks:
@@ -239,11 +240,61 @@ class HybridBlock(Block):
             return out
         return super().__call__(*args)
 
+    def export(self, path, epoch=0):
+        """Export the inference graph + params for deployment (ref:
+        block.py HybridBlock.export — emits ``path-symbol.json`` and
+        ``path-%04d.params``, loadable by SymbolBlock / Module / the
+        reference's C predict API surface).
+
+        Requires initialized params (run a forward once first). The
+        forward is re-traced with Symbol placeholders, so blocks whose
+        forward inspects concrete shapes cannot be exported.
+        """
+        from .. import symbol as symmod
+        from ..ndarray import utils as nd_utils
+
+        params = self._collect_all_reg_params()
+        for p in params.values():
+            p.data()  # raises for uninitialized/deferred params
+        n = getattr(self, "_num_inputs", 1)
+        ins = [symmod.var("data" if n == 1 else "data%d" % i)
+               for i in range(n)]
+        # disable hybrid caching during the symbolic trace
+        saved = {}
+
+        def walk(b):
+            if isinstance(b, HybridBlock):
+                saved[b] = b._active
+                b._active = False
+            for c in b._children.values():
+                walk(c)
+
+        walk(self)
+        try:
+            out = self.forward(*ins)
+        finally:
+            for b, a in saved.items():
+                b._active = a
+        if isinstance(out, (list, tuple)):
+            out = symmod.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        arg_names = set(out.list_arguments())
+        aux_names = set(out.list_auxiliary_states())
+        save_dict = {}
+        for name, p in params.items():
+            if name in arg_names:
+                save_dict["arg:%s" % name] = p.data()
+            elif name in aux_names:
+                save_dict["aux:%s" % name] = p.data()
+        nd_utils.save("%s-%04d.params" % (path, epoch), save_dict)
+        return out
+
     # -- the CachedOp analogue ----------------------------------------------
     def _call_cached(self, args):
         import jax
 
         flat_args = [a for a in args if isinstance(a, NDArray)]
+        self._num_inputs = len(args)
         try:
             params = {k: p.data() for k, p in self._collect_all_reg_params().items()}
         except DeferredInitializationError:

@@ -83,6 +83,11 @@ class _ParamLayer(HybridBlock):
     """Common deferred-shape machinery: subclasses define _infer_param_shapes."""
 
     def _get_params(self, x):
+        from ...symbol.symbol import Symbol, var
+
+        if isinstance(x, Symbol):
+            # symbolic tracing (export): placeholders named by param name
+            return {k: var(p.name) for k, p in self._reg_params.items()}
         try:
             return {k: p.data() for k, p in self._reg_params.items()}
         except (DeferredInitializationError, MXNetError):

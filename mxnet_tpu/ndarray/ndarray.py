@@ -561,6 +561,22 @@ def invoke(op, inputs, attrs, out=None, ctx=None):
     if isinstance(op, str):
         op = _reg.get(op)
     inputs = [x for x in inputs]
+    # symbolic tracing (HybridBlock.export): any Symbol input composes a
+    # graph node instead of executing — the layer code is F-agnostic
+    from ..symbol.symbol import Symbol as _Sym
+
+    if any(isinstance(x, _Sym) for x in inputs):
+        from ..symbol.register import create_symbol
+
+        bad = [x for x in inputs if x is not None and not isinstance(x, _Sym)]
+        if bad:
+            raise MXNetError(
+                "op %s: cannot mix NDArray and Symbol inputs during "
+                "symbolic tracing" % op.name)
+        sattrs = {k: v for k, v in attrs.items() if v is not None}
+        sattrs.pop("name", None)
+        sattrs.pop("ctx", None)
+        return create_symbol(op, inputs, sattrs)
     ctx = ctx or (inputs[0]._ctx if inputs else None) or current_context()
 
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "dtype")}

@@ -412,19 +412,41 @@ def load(fname):
 
 
 def load_json(json_str):
+    """Load symbol JSON — current format and the pre-NNVM legacy format
+    (2-element input entries, ``param``/``attr`` keys; the reference's
+    LoadLegacyJSON upgrade chain, src/nnvm/legacy_json_util.cc:30-116,
+    fixture tests/python/unittest/save_000800.json)."""
     data = json.loads(json_str)
     jnodes = data["nodes"]
     built = []
     for jn in jnodes:
+        # legacy files put user attrs under "attr", modern under "attrs"
+        jattrs = jn.get("attrs", jn.get("attr", {}))
         if jn["op"] == "null":
-            node = _Node(None, {}, [], jn["name"], dict(jn.get("attrs", {})))
+            user = dict(jattrs)
+            user.update(jn.get("param", {}))
+            node = _Node(None, {}, [], jn["name"], user)
         else:
             op = _reg.get(jn["op"])
-            raw_attrs = dict(jn.get("attrs", jn.get("param", {})))
-            user_attrs = {k: v for k, v in raw_attrs.items() if k.startswith("__")}
-            op_attrs = {k: v for k, v in raw_attrs.items() if not k.startswith("__") and k in op.attr_defaults}
+            raw_attrs = dict(jn.get("param", {}))
+            raw_attrs.update(jattrs)
+            user_attrs = {
+                k: v for k, v in raw_attrs.items()
+                if k.startswith("__") or k not in op.attr_defaults}
+            op_attrs = {k: v for k, v in raw_attrs.items()
+                        if not k.startswith("__") and k in op.attr_defaults}
             attrs = op.parse_attrs(op_attrs)
-            inputs = [(built[i], oi) for i, oi, _ in jn["inputs"]]
+            inputs = [(built[e[0]], e[1]) for e in jn["inputs"]]
+            # legacy upgrade: pre-NNVM graphs omit aux-state inputs
+            # (BatchNorm moving_mean/var etc.) — synthesize the variables
+            # exactly as the reference's legacy_op_util.cc adaptation does
+            if not op.var_inputs:
+                for aux_name in op.input_names[len(inputs):]:
+                    if aux_name in ("moving_mean", "moving_var"):
+                        aux_node = _Node(None, {}, [],
+                                         "%s_%s" % (jn["name"], aux_name))
+                        built.append(aux_node)
+                        inputs.append((aux_node, 0))
             arity = _infer_arity(op, len(inputs))
             node = _Node(op, attrs, inputs, jn["name"], user_attrs, arity)
         built.append(node)

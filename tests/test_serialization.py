@@ -1,0 +1,100 @@
+"""Checkpoint/serialization parity: legacy JSON upgrade, HybridBlock
+export, checkpoint roundtrip.
+
+Models: reference back-compat fixtures (tests/python/unittest/
+save_000800.json + legacy_ndarray.v0, SURVEY §5.4) and
+test_gluon.py export tests.
+"""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+def _legacy_json():
+    """A pre-NNVM-format graph: 2-element input entries, 'param' attr
+    key, BatchNorm without aux inputs (the save_000800.json schema)."""
+    nodes = [
+        {"op": "null", "param": {}, "name": "data", "inputs": [],
+         "backward_source_id": -1,
+         "attr": {"ctx_group": "stage1", "lr_mult": "0.2"}},
+        {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "FullyConnected",
+         "param": {"no_bias": "False", "num_hidden": "16"},
+         "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "bn_gamma", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "bn_beta", "inputs": [],
+         "backward_source_id": -1},
+        {"op": "BatchNorm",
+         "param": {"eps": "0.001", "fix_gamma": "True",
+                   "momentum": "0.9", "use_global_stats": "False"},
+         "name": "bn", "inputs": [[3, 0], [4, 0], [5, 0]],
+         "backward_source_id": -1},
+        {"op": "null", "param": {}, "name": "softmax_label",
+         "inputs": [], "backward_source_id": -1},
+        {"op": "SoftmaxOutput",
+         "param": {"grad_scale": "1", "ignore_label": "-1",
+                   "multi_output": "False", "normalization": "null",
+                   "out_grad": "False", "preserve_shape": "False",
+                   "use_ignore": "False"},
+         "name": "softmax", "inputs": [[6, 0], [7, 0]],
+         "backward_source_id": -1},
+    ]
+    return json.dumps({"nodes": nodes, "arg_nodes": [0, 1, 2, 4, 5, 7],
+                       "heads": [[8, 0]]})
+
+
+def test_legacy_json_loads_and_runs():
+    s = mx.sym.load_json(_legacy_json())
+    assert "fc1_weight" in s.list_arguments()
+    # upgrade synthesizes the BatchNorm aux inputs
+    assert s.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    # user attrs from the legacy "attr" key survive
+    assert s.attr_dict()["data"]["ctx_group"] == "stage1"
+    _, outs, _ = s.infer_shape(data=(4, 10), softmax_label=(4,))
+    assert outs[0] == (4, 16)
+    ex = s.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    out = ex.forward(is_train=False,
+                     data=nd.array(np.ones((4, 10), np.float32)))
+    assert out[0].shape == (4, 16)
+
+
+def test_hybrid_export_and_module_reload(tmp_path):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    y_ref = net(x)
+    prefix = str(tmp_path / "m")
+    net.export(prefix, epoch=7)
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 7)
+    ex = sym.bind(mx.cpu(), dict(arg_params, data=x),
+                  aux_states=aux_params)
+    out = ex.forward(is_train=False)[0]
+    np.testing.assert_allclose(out.asnumpy(), y_ref.asnumpy(), atol=1e-5)
+
+
+def test_save_load_checkpoint_roundtrip(tmp_path):
+    data = mx.sym.var("data")
+    net = mx.sym.SoftmaxOutput(
+        data=mx.sym.FullyConnected(data=data, num_hidden=4, name="fc"),
+        name="softmax")
+    arg = {"fc_weight": nd.ones((4, 6)), "fc_bias": nd.zeros((4,))}
+    prefix = str(tmp_path / "ck")
+    mx.model.save_checkpoint(prefix, 2, net, arg, {})
+    sym, arg2, aux2 = mx.model.load_checkpoint(prefix, 2)
+    assert sym.list_arguments() == net.list_arguments()
+    np.testing.assert_allclose(arg2["fc_weight"].asnumpy(),
+                               arg["fc_weight"].asnumpy())
