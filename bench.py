@@ -69,6 +69,8 @@ def main():
             for _ in range(n_steps):
                 carry, loss = ts(carry, batch_dev, key)
             jax.block_until_ready(loss)
+            float(loss)  # host materialization: guarantees completion even
+            # where a remote-tunnel runtime under-reports block_until_ready
             dt = time.perf_counter() - t0
             img_s = batch * n_steps / dt
             print(json.dumps({

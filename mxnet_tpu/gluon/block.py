@@ -294,7 +294,6 @@ class HybridBlock(Block):
         import jax
 
         flat_args = [a for a in args if isinstance(a, NDArray)]
-        self._num_inputs = len(args)
         try:
             params = {k: p.data() for k, p in self._collect_all_reg_params().items()}
         except DeferredInitializationError:

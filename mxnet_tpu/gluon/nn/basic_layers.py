@@ -86,8 +86,17 @@ class _ParamLayer(HybridBlock):
         from ...symbol.symbol import Symbol, var
 
         if isinstance(x, Symbol):
-            # symbolic tracing (export): placeholders named by param name
-            return {k: var(p.name) for k, p in self._reg_params.items()}
+            # symbolic tracing (export): ONE placeholder per parameter —
+            # cached on the Parameter so shared/tied layers reuse the
+            # same graph node instead of emitting duplicate arg names
+            out = {}
+            for k, p in self._reg_params.items():
+                ph = getattr(p, "_sym_placeholder", None)
+                if ph is None:
+                    ph = var(p.name)
+                    p._sym_placeholder = ph
+                out[k] = ph
+            return out
         try:
             return {k: p.data() for k, p in self._reg_params.items()}
         except (DeferredInitializationError, MXNetError):

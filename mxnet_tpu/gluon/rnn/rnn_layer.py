@@ -33,28 +33,46 @@ class _RNNLayer(HybridBlock):
         self._i2h_bias_initializer = i2h_bias_initializer
         self._h2h_bias_initializer = h2h_bias_initializer
         self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        # unfused per-layer/direction params, reference naming
+        # (rnn_layer.py:80: l0_i2h_weight, r0_i2h_weight, …) — fused into
+        # the flat cuDNN-style vector only at the RNN op boundary
+        ng, H = self._gates, hidden_size
+        self._rnn_param_names = []
         with self.name_scope():
-            self.parameters = self.params.get(
-                "parameters", shape=(self._total_param_size(input_size) if input_size else 0,),
-                init=None, allow_deferred_init=True,
-            )
-
-    def _total_param_size(self, input_size):
-        H = self._hidden_size
-        L = self._num_layers
-        D = self._dir
-        ng = self._gates
-        size = 0
-        for layer in range(L):
-            for _ in range(D):
-                in_size = input_size if layer == 0 else H * D
-                size += ng * H * in_size + ng * H * H
-        size += L * D * 2 * ng * H
-        return size
+            for layer in range(num_layers):
+                for d in ("l", "r")[: self._dir]:
+                    in_size = input_size if layer == 0 else H * self._dir
+                    names = ["%s%d_i2h_weight" % (d, layer),
+                             "%s%d_h2h_weight" % (d, layer),
+                             "%s%d_i2h_bias" % (d, layer),
+                             "%s%d_h2h_bias" % (d, layer)]
+                    shapes = [(ng * H, in_size if in_size else 0),
+                              (ng * H, H), (ng * H,), (ng * H,)]
+                    inits = [i2h_weight_initializer, h2h_weight_initializer,
+                             i2h_bias_initializer, h2h_bias_initializer]
+                    for pname, shp, ini in zip(names, shapes, inits):
+                        self.params.get(pname, shape=shp, init=ini,
+                                        allow_deferred_init=True)
+                    self._rnn_param_names.append(names)
 
     def _infer_param_shapes(self, x):
-        input_size = x.shape[2] if self._layout == "TNC" else x.shape[2]
-        self.parameters.shape = (self._total_param_size(input_size),)
+        input_size = x.shape[2]
+        ng, H = self._gates, self._hidden_size
+        for layer_names in self._rnn_param_names[: self._dir]:
+            # only layer-0 i2h shapes depend on the input size
+            p = self.params.get(layer_names[0])
+            p.shape = (ng * H, input_size)
+
+    def _fused_parameters(self):
+        """Concatenate unfused params into the RNN op's flat layout:
+        all (w_ih, w_hh) pairs, then all (b_ih, b_hh) pairs."""
+        weights, biases = [], []
+        for names in self._rnn_param_names:
+            i2h_w, h2h_w, i2h_b, h2h_b = (self.params.get(n) for n in names)
+            weights += [i2h_w.data().reshape((-1,)),
+                        h2h_w.data().reshape((-1,))]
+            biases += [i2h_b.data(), h2h_b.data()]
+        return invoke("Concat", weights + biases, {"dim": 0})
 
     def state_info(self, batch_size=0):
         raise NotImplementedError
@@ -79,11 +97,15 @@ class _RNNLayer(HybridBlock):
         if isinstance(states, NDArray):
             states = [states]
         try:
-            params = self.parameters.data()
+            params = self._fused_parameters()
         except (DeferredInitializationError, MXNetError):
             self._infer_param_shapes(inputs)
-            self.parameters._finish_deferred_init()
-            params = self.parameters.data()
+            for names in self._rnn_param_names:
+                for n in names:
+                    p = self.params.get(n)
+                    if p._data is None:
+                        p._finish_deferred_init()
+            params = self._fused_parameters()
         op_inputs = [inputs, params, states[0]]
         if self._mode == "lstm":
             op_inputs.append(states[1])

@@ -272,6 +272,9 @@ class FusedRNN(Initializer):
         self._set(arr, flat)
 
 
+_INIT_REGISTRY["fusedrnn"] = FusedRNN
+
+
 class Mixed:
     """Pattern→initializer dispatch (ref: initializer.py Mixed)."""
 

@@ -551,6 +551,9 @@ def register_stateful_post(op_name):
     return deco
 
 
+_SYMBOL_CLS = None
+
+
 def invoke(op, inputs, attrs, out=None, ctx=None):
     """Invoke a registered op on NDArrays.
 
@@ -563,7 +566,12 @@ def invoke(op, inputs, attrs, out=None, ctx=None):
     inputs = [x for x in inputs]
     # symbolic tracing (HybridBlock.export): any Symbol input composes a
     # graph node instead of executing — the layer code is F-agnostic
-    from ..symbol.symbol import Symbol as _Sym
+    global _SYMBOL_CLS
+    if _SYMBOL_CLS is None:
+        from ..symbol.symbol import Symbol as _SYMBOL_CLS_  # noqa: N806
+
+        _SYMBOL_CLS = _SYMBOL_CLS_
+    _Sym = _SYMBOL_CLS
 
     if any(isinstance(x, _Sym) for x in inputs):
         from ..symbol.register import create_symbol

@@ -418,7 +418,9 @@ def load_json(json_str):
     fixture tests/python/unittest/save_000800.json)."""
     data = json.loads(json_str)
     jnodes = data["nodes"]
-    built = []
+    # jmap[i] must stay aligned with the file's node indices — synthesized
+    # aux variables are wired into inputs directly, never indexed
+    jmap = []
     for jn in jnodes:
         # legacy files put user attrs under "attr", modern under "attrs"
         jattrs = jn.get("attrs", jn.get("attr", {}))
@@ -436,7 +438,7 @@ def load_json(json_str):
             op_attrs = {k: v for k, v in raw_attrs.items()
                         if not k.startswith("__") and k in op.attr_defaults}
             attrs = op.parse_attrs(op_attrs)
-            inputs = [(built[e[0]], e[1]) for e in jn["inputs"]]
+            inputs = [(jmap[e[0]], e[1]) for e in jn["inputs"]]
             # legacy upgrade: pre-NNVM graphs omit aux-state inputs
             # (BatchNorm moving_mean/var etc.) — synthesize the variables
             # exactly as the reference's legacy_op_util.cc adaptation does
@@ -445,13 +447,12 @@ def load_json(json_str):
                     if aux_name in ("moving_mean", "moving_var"):
                         aux_node = _Node(None, {}, [],
                                          "%s_%s" % (jn["name"], aux_name))
-                        built.append(aux_node)
                         inputs.append((aux_node, 0))
             arity = _infer_arity(op, len(inputs))
             node = _Node(op, attrs, inputs, jn["name"], user_attrs, arity)
-        built.append(node)
-    heads = data.get("heads", [[len(built) - 1, 0, 0]])
-    return Symbol([(built[h[0]], h[1]) for h in heads])
+        jmap.append(node)
+    heads = data.get("heads", [[len(jmap) - 1, 0, 0]])
+    return Symbol([(jmap[h[0]], h[1]) for h in heads])
 
 
 def _infer_arity(op, n_inputs):

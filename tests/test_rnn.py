@@ -1,0 +1,131 @@
+"""RNN stack: fused RNN op, gluon recurrent layers, BucketingModule.
+
+Models: tests/python/unittest/test_operator.py RNN sections,
+test_module.py test_bucketing (SURVEY §4), example/rnn/lstm_bucketing.py
+(SURVEY §5.7 long-sequence coverage).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_fused_rnn_lstm_shapes_and_grad():
+    T, N, I, H, L = 5, 3, 4, 8, 2
+    x = nd.array(np.random.RandomState(0).randn(T, N, I).astype(np.float32))
+    rnn = gluon.rnn.LSTM(H, num_layers=L)
+    rnn.initialize()
+    out = rnn(x)
+    assert out.shape == (T, N, H)
+    # bidirectional doubles the feature dim
+    birnn = gluon.rnn.LSTM(H, num_layers=1, bidirectional=True)
+    birnn.initialize()
+    assert birnn(x).shape == (T, N, 2 * H)
+
+
+def test_gluon_lstm_learns_sequence_sum():
+    """Tiny regression: predict the running sum of inputs."""
+    rng = np.random.RandomState(0)
+    T, N = 8, 16
+    x_np = rng.uniform(-1, 1, (T, N, 1)).astype(np.float32)
+    y_np = np.cumsum(x_np, axis=0)
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        rnn = gluon.rnn.RNN(16, num_layers=1)
+        dense = gluon.nn.Dense(1, flatten=False)
+    net.add(rnn)
+    net.add(dense)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+    x, y = nd.array(x_np), nd.array(y_np)
+    first = None
+    for i in range(60):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(N)
+        cur = float(loss.mean().asnumpy())
+        if first is None:
+            first = cur
+    assert cur < first * 0.5, (first, cur)
+
+
+def _lstm_lm_sym(seq_len, vocab=32, embed=8, hidden=16):
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    emb = mx.sym.Embedding(data=data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    # (N, T, E) -> (T, N, E) for the fused RNN
+    x = mx.sym.transpose(emb, axes=(1, 0, 2))
+    rnn = mx.sym.RNN(data=x, state_size=hidden, num_layers=1, mode="lstm",
+                     name="lstm")
+    x = mx.sym.transpose(rnn, axes=(1, 0, 2))
+    x = mx.sym.Reshape(x, shape=(-1, hidden))
+    fc = mx.sym.FullyConnected(data=x, num_hidden=vocab, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(data=fc, label=lab, name="softmax")
+
+
+def test_bucketing_module_variable_length_lm():
+    """Per-length graphs share params; training reduces loss on both
+    buckets (reference test_bucketing pattern)."""
+    buckets = [4, 8]
+    vocab = 32
+    rng = np.random.RandomState(0)
+
+    def sym_gen(seq_len):
+        return (_lstm_lm_sym(seq_len, vocab=vocab), ("data",),
+                ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+
+    batches = []
+    for seq_len in buckets * 3:
+        tokens = rng.randint(1, vocab, (8, seq_len + 1))
+        batch = mx.io.DataBatch(
+            data=[nd.array(tokens[:, :-1].astype(np.float32))],
+            label=[nd.array(tokens[:, 1:].astype(np.float32))],
+            bucket_key=seq_len,
+            provide_data=[("data", (8, seq_len))],
+            provide_label=[("softmax_label", (8, seq_len))])
+        batches.append(batch)
+
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8, 8))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.05})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    losses = []
+    for epoch in range(6):
+        for batch in batches:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        metric.reset()
+        mod.forward(batches[0], is_train=False)
+        mod.update_metric(metric, batches[0].label)
+        losses.append(metric.get()[1])
+    assert losses[-1] < losses[0], losses
+
+
+def test_sequence_ops_padded_batch():
+    """SequenceMask/Last/Reverse on padded batches (SURVEY §5.7)."""
+    T, N, D = 4, 2, 3
+    x = nd.array(np.arange(T * N * D, dtype=np.float32).reshape(T, N, D))
+    lens = nd.array(np.array([2, 4], np.float32))
+    masked = nd.SequenceMask(x, sequence_length=lens, use_sequence_length=True)
+    mnp = masked.asnumpy()
+    assert mnp[2:, 0].sum() == 0       # steps >= len masked for seq 0
+    assert (mnp[:, 1] == x.asnumpy()[:, 1]).all()
+    last = nd.SequenceLast(x, sequence_length=lens, use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy()[0], x.asnumpy()[1, 0])
+    rev = nd.SequenceReverse(x, sequence_length=lens,
+                             use_sequence_length=True)
+    np.testing.assert_allclose(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])

@@ -53,6 +53,9 @@ def _legacy_json():
 
 def test_legacy_json_loads_and_runs():
     s = mx.sym.load_json(_legacy_json())
+    # the head must still be the SoftmaxOutput, not a shifted node
+    assert s.list_outputs() == ["softmax_output"]
+    assert "softmax_label" in s.list_arguments()
     assert "fc1_weight" in s.list_arguments()
     # upgrade synthesizes the BatchNorm aux inputs
     assert s.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]

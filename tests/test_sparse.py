@@ -1,0 +1,95 @@
+"""Sparse NDArray + sparse op tests.
+
+Models: tests/python/unittest/test_sparse_ndarray.py +
+test_sparse_operator.py (1,778 LoC, SURVEY §4) — construction,
+stype conversion, sparse dot, retain, kvstore row_sparse_pull,
+sparse-aware optimizer updates.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _dense_with_zero_rows(shape=(6, 4), nz_rows=(1, 4), seed=0):
+    rng = np.random.RandomState(seed)
+    out = np.zeros(shape, np.float32)
+    for r in nz_rows:
+        out[r] = rng.randn(shape[1])
+    return out
+
+
+def test_row_sparse_roundtrip():
+    dense = _dense_with_zero_rows()
+    rsp = sparse.cast_storage(nd.array(dense), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert set(np.asarray(rsp.indices.asnumpy()).tolist()) == {1, 4}
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_csr_roundtrip():
+    rng = np.random.RandomState(0)
+    dense = (rng.rand(5, 7) > 0.7) * rng.randn(5, 7).astype(np.float32)
+    csr = sparse.cast_storage(nd.array(dense), "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense, atol=1e-6)
+    np.testing.assert_allclose(csr.tostype("default").asnumpy(), dense,
+                               atol=1e-6)
+
+
+def test_row_sparse_array_constructor():
+    data = np.arange(8, dtype=np.float32).reshape(2, 4)
+    idx = np.array([0, 3], np.int64)
+    rsp = sparse.row_sparse_array((nd.array(data), nd.array(idx)),
+                                  shape=(5, 4))
+    dense = rsp.asnumpy()
+    np.testing.assert_allclose(dense[0], data[0])
+    np.testing.assert_allclose(dense[3], data[1])
+    assert dense[1].sum() == 0
+
+
+def test_csr_dot_dense():
+    rng = np.random.RandomState(0)
+    dense_a = (rng.rand(4, 6) > 0.5) * rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(6, 3).astype(np.float32)
+    csr = sparse.cast_storage(nd.array(dense_a), "csr")
+    out = nd.dot(csr, nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), dense_a @ b, atol=1e-5)
+
+
+def test_sparse_retain():
+    dense = _dense_with_zero_rows(nz_rows=(1, 2, 4))
+    rsp = sparse.cast_storage(nd.array(dense), "row_sparse")
+    kept = nd._sparse_retain(rsp.data, rsp.indices)
+    assert kept.shape == rsp.data.shape
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    weight = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    kv.init("emb", nd.array(weight))
+    row_ids = nd.array(np.array([1, 5], np.int64))
+    out = nd.zeros((8, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=row_ids)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], weight[1], atol=1e-6)
+    np.testing.assert_allclose(got[5], weight[5], atol=1e-6)
+    assert got[0].sum() == 0
+
+
+def test_sgd_lazy_update_semantics():
+    """lazy_update only touches rows with non-zero grads (ref sparse
+    sgd_update, optimizer_op.cc): emulated on the dense op — rows with
+    all-zero grad still incur wd when lazy_update=False."""
+    w = nd.array(np.ones((4, 2), np.float32))
+    g = nd.array(_dense_with_zero_rows((4, 2), nz_rows=(2,)))
+    w2 = nd.array(np.ones((4, 2), np.float32))
+    nd.sgd_update(w, g, lr=0.1, wd=0.0)
+    expect = 1.0 - 0.1 * g.asnumpy()
+    np.testing.assert_allclose(w.asnumpy(), expect, atol=1e-6)
+    nd.sgd_update(w2, g, lr=0.1, wd=0.1)
+    assert not np.allclose(w2.asnumpy()[0], 1.0)  # wd applied everywhere
