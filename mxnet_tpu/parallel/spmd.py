@@ -251,7 +251,15 @@ class TrainStep:
 
     # -- initialization ------------------------------------------------------
     def init_params(self, data_shapes, initializer=None, dtype=_np.float32, seed=0):
-        """Infer shapes from data shapes and initialize params/aux on host."""
+        """Infer shapes from data shapes and initialize params/aux.
+
+        All allocation happens on the target mesh's first device (or the
+        process default when no mesh is set) so that a mesh built from
+        non-default devices — e.g. the 8-CPU-device dryrun mesh while the
+        default platform is a TPU — never touches the default device.
+        """
+        import contextlib
+
         from ..initializer import Uniform, InitDesc
 
         shape_kwargs = dict(data_shapes)
@@ -259,19 +267,31 @@ class TrainStep:
         arg_names = self.symbol.list_arguments()
         init = initializer or Uniform(0.01)
         params, aux = {}, {}
-        rng = _np.random.RandomState(seed)
-        for name, shape in zip(arg_names, arg_shapes):
-            if name in self.data_names or name in self.label_names:
-                continue
-            from ..ndarray.ndarray import zeros as nd_zeros
+        dev = None
+        if self.mesh is not None:
+            # First *addressable* device: in a multi-host mesh, devices.flat[0]
+            # may belong to another process and cannot host allocations.
+            pidx = jax.process_index()
+            dev = next((d for d in self.mesh.devices.flat if d.process_index == pidx), None)
+        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+        np_state = _np.random.get_state()
+        _np.random.seed(seed)
+        try:
+            with ctx:
+                for name, shape in zip(arg_names, arg_shapes):
+                    if name in self.data_names or name in self.label_names:
+                        continue
+                    from ..ndarray.ndarray import zeros as nd_zeros
 
-            arr = nd_zeros(shape, dtype=dtype)
-            init(InitDesc(name), arr)
-            params[name] = arr._data()
-        for name, shape in zip(self.aux_names, aux_shapes):
-            val = jnp.ones(shape, dtype) if "var" in name or "gamma" in name else jnp.zeros(shape, dtype)
-            aux[name] = val
-        opt_state = self.optimizer.init(params)
+                    arr = nd_zeros(shape, dtype=dtype)
+                    init(InitDesc(name), arr)
+                    params[name] = arr._data()
+                for name, shape in zip(self.aux_names, aux_shapes):
+                    val = jnp.ones(shape, dtype) if "var" in name or "gamma" in name else jnp.zeros(shape, dtype)
+                    aux[name] = val
+                opt_state = self.optimizer.init(params)
+        finally:
+            _np.random.set_state(np_state)
         return params, opt_state, aux
 
     # -- sharding ------------------------------------------------------------
