@@ -502,14 +502,46 @@ def batch_norm(
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if __is_train__ and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # One-pass statistics: sum and sum-of-squares reduce in a single
+        # fused XLA pass over the activation (f32 accumulation). The
+        # textbook mean-then-var formulation is two *sequential* passes
+        # (var needs mean), which leaves conv+BN towers HBM-bound at ~1/3
+        # of MXU throughput on TPU.
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        if data.dtype in (jnp.bfloat16, jnp.float16):
+            # Half-precision inputs: their own quantization noise floor
+            # (~(|mean|·2⁻⁸)² for bf16) sits far above the f32 cancellation
+            # threshold of E[x²]−E[x]², so the unshifted one-pass is safe
+            # and keeps the reduce fully fused (the perf-critical path).
+            pivot = None
+            xf = data.astype(jnp.float32)
+        else:
+            # f32 inputs: subtract a per-channel pivot (any sample) so
+            # E[(x-p)²]−E[x-p]² stays clear of catastrophic cancellation
+            # when |mean| >> std; both sums still fuse into one pass.
+            pivot = lax.stop_gradient(
+                data[tuple(slice(0, 1) if i in red else slice(None) for i in range(data.ndim))]
+            ).astype(jnp.float32)
+            xf = data.astype(jnp.float32) - pivot
+        s1 = jnp.sum(xf, axis=red)
+        s2 = jnp.sum(xf * xf, axis=red)
+        mean_c = s1 / n
+        var = jnp.maximum(s2 / n - mean_c * mean_c, 0.0)
+        mean = mean_c if pivot is None else mean_c + pivot.reshape(mean_c.shape)
     else:
         mean = moving_mean
         var = moving_var
-    inv = lax.rsqrt(var.reshape(bshape) + eps)
-    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
-    return out, mean, var
+    # Fold (mean, var, gamma, beta) into one per-channel scale+shift so the
+    # big tensor sees a single fused multiply-add.
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = (inv * g.astype(jnp.float32)).astype(data.dtype)
+    shift = (beta.astype(jnp.float32) - mean.astype(jnp.float32) * inv * g.astype(jnp.float32)).astype(data.dtype)
+    out = data * scale.reshape(bshape) + shift.reshape(bshape)
+    # Stats take the moving-stat dtype: f32 aux gets full-precision updates,
+    # and a net cast to bf16 keeps bf16 running stats (no dtype drift).
+    return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
 @register(name="LayerNorm", aliases=("layer_norm",), num_outputs=3, num_visible_outputs=1)
