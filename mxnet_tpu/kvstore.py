@@ -220,9 +220,16 @@ class TPUKVStore(KVStore):
 
     def __init__(self, kv_type="tpu"):
         super().__init__(kv_type)
-        from .parallel.mesh import default_mesh
+        self._mesh = None  # attached by Module when the fused step binds
 
-        self._mesh = None  # lazy; tests may build their own
+    def attach_mesh(self, mesh):
+        """Record the device mesh whose data axis carries this store's
+        reductions (set by Module's fused SPMD group)."""
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
 
 
 class DistKVStore(TPUKVStore):

@@ -72,6 +72,7 @@ class Module(BaseModule):
         self._updater = None
         self._preload_opt_states = None
         self._exec_group = None
+        self._fused = None
         self._data_shapes = None
         self._label_shapes = None
 
@@ -96,6 +97,7 @@ class Module(BaseModule):
     def _reset_bind(self):
         self.binded = False
         self._exec_group = None
+        self._fused = None
         self._data_shapes = None
         self._label_shapes = None
 
@@ -179,6 +181,8 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params, allow_extra=allow_extra)
+        if self._fused is not None:
+            self._fused.set_params(self._arg_params, self._aux_params)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None, grad_req="write"):
@@ -269,6 +273,33 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
+        # kvstore='tpu': run the whole train step (fwd+bwd+update) as one
+        # compiled SPMD program over a mesh built from the context list —
+        # the TPU answer to the reference's DataParallelExecutorGroup +
+        # Comm reduce (module.py:468-530, comm.h). Falls back to the
+        # per-executor path for optimizers the fused step can't mirror.
+        self._fused = None
+        if kvstore is not None and kvstore.type == "tpu" and self.for_training:
+            from .spmd_group import FusedSPMDGroup
+
+            try:
+                self._fused = FusedSPMDGroup(
+                    self._symbol, self._context, self._optimizer,
+                    self._arg_params, self._aux_params,
+                    self._data_names, self._label_names,
+                    fixed_param_names=self._fixed_param_names,
+                    logger=self.logger,
+                    batch_size=self._exec_group.batch_size,
+                    inputs_need_grad=self.inputs_need_grad,
+                )
+                kvstore.attach_mesh(self._fused.mesh)
+                update_on_kvstore = False
+                self._update_on_kvstore = False
+            except MXNetError as e:
+                self.logger.warning(
+                    "kvstore='tpu': %s; using per-executor update path", e)
+                self._fused = None
+
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
@@ -289,6 +320,12 @@ class Module(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            self._last_fused = False
+            if self._params_dirty:
+                # eval/predict goes through the per-ctx executors: refresh
+                # them (and the host copies) from the fused device carry.
+                self._sync_params_from_devices()
         curr_data_shapes = tuple(i.shape for i in self._exec_group.data_shapes)
         if isinstance(data_batch, list):
             new_data_shapes = tuple(b.data[0].shape for b in data_batch)
@@ -316,6 +353,13 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            # One compiled step: fwd+bwd+optimizer update, batch sharded
+            # over the mesh. update() below becomes a no-op.
+            self._fused.forward_backward_update(data_batch)
+            self._params_dirty = True
+            self._last_fused = True
+            return
         self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
@@ -325,6 +369,8 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        if self._fused is not None:
+            return  # update already applied inside the fused step
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
@@ -339,6 +385,8 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused is not None and getattr(self, "_last_fused", False):
+            return self._fused.get_outputs()
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -346,9 +394,17 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused is not None and getattr(self, "_last_fused", False):
+            self._fused.update_metric(eval_metric, labels)
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
+        if self._fused is not None:
+            self._fused.copy_params_to(self._arg_params, self._aux_params)
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+            self._params_dirty = False
+            return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
             for param_name, param_val in sorted(self._arg_params.items()):
@@ -357,6 +413,10 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if self._fused is not None:
+            with open(fname, "wb") as fout:
+                fout.write(self._fused.get_states())
+            return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -365,6 +425,9 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if self._fused is not None:
+            self._fused.set_states(open(fname, "rb").read())
+            return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

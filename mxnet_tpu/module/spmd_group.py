@@ -1,0 +1,144 @@
+"""Fused SPMD execution group for Module(kvstore='tpu').
+
+The reference's ``kvstore='device'/'nccl'`` tier runs one executor per GPU
+and reduces gradients through a Comm tree (module/module.py:468-530 +
+kvstore comm.h). The TPU-native tier replaces that whole pipeline with ONE
+compiled XLA program per batch: forward + backward + optimizer update with
+the batch sharded over the mesh's ``dp`` axis, so the gradient all-reduce
+is a psum over ICI *inside* the step (the reference's priority-scheduled
+push/pull overlap becomes XLA latency hiding).
+
+Module routes ``forward_backward``/``update`` here when it detects a
+``tpu`` kvstore; the kvstore itself carries the mesh (TPUKVStore.mesh) for
+introspection parity.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import ndarray as nd
+from ..parallel.mesh import make_mesh
+from ..parallel.spmd import (
+    TrainStep,
+    data_sharding,
+    functional_from_optimizer,
+)
+
+
+class FusedSPMDGroup:
+    """One fused train step over a dp mesh built from Module's contexts."""
+
+    def __init__(self, symbol, contexts, optimizer, arg_params, aux_params,
+                 data_names, label_names, fixed_param_names=None, logger=None,
+                 batch_size=None, inputs_need_grad=False):
+        import jax
+
+        if fixed_param_names:
+            raise MXNetError("fused SPMD step: fixed_param_names not supported")
+        if inputs_need_grad:
+            raise MXNetError("fused SPMD step: inputs_need_grad not supported")
+        devices = [c.jax_device() for c in contexts]
+        if len({id(d) for d in devices}) != len(devices):
+            raise MXNetError("fused SPMD step: duplicate devices in context list")
+        if batch_size is not None and batch_size % len(devices) != 0:
+            raise MXNetError(
+                "fused SPMD step: batch size %d not divisible by %d devices"
+                % (batch_size, len(devices)))
+        self.mesh = make_mesh({"dp": len(devices)}, devices=devices)
+        self._fopt = functional_from_optimizer(
+            optimizer, [n for n in symbol.list_arguments()
+                        if n not in data_names and n not in label_names])
+        # rescale_grad already carries the 1/batch normalization Module set.
+        self._ts = TrainStep(
+            symbol, self._fopt, mesh=self.mesh,
+            data_names=tuple(data_names), label_names=tuple(label_names),
+            compute_dtype=None, normalize_grads=False, return_outputs=True,
+        )
+        self.param_names = list(self._ts.param_names)
+        self.aux_names = list(self._ts.aux_names)
+        params = {k: arg_params[k]._data() for k in self.param_names}
+        aux = {k: aux_params[k]._data() for k in self.aux_names}
+        opt_state = self._fopt.init(params)
+        self._carry = self._ts.place(params, opt_state, aux)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._key = jax.random.PRNGKey(0)
+        self._step_no = 0
+        self._loss = None
+        self._outputs = None
+
+    # -- the hot loop --------------------------------------------------------
+    def forward_backward_update(self, data_batch):
+        """Run one fused step: shard batch over dp, fwd+bwd+update in XLA."""
+        import jax
+
+        ndev = self.mesh.devices.size
+        sh = data_sharding(self.mesh)
+        batch = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            if arr.shape[0] % ndev != 0:
+                raise MXNetError(
+                    "fused SPMD step: batch dim %d of %r not divisible by "
+                    "%d mesh devices" % (arr.shape[0], name, ndev))
+            batch[name] = jax.device_put(arr._data(), sh)
+        labels = getattr(data_batch, "label", None) or []
+        for name, arr in zip(self._label_names, labels):
+            batch[name] = jax.device_put(arr._data(), sh)
+        key = jax.random.fold_in(self._key, self._step_no)
+        self._carry, (loss, outs) = self._ts(self._carry, batch, key)
+        self._step_no += 1
+        self._loss = loss
+        self._outputs = [nd.NDArray(o) for o in outs]
+
+    def get_outputs(self):
+        if self._outputs is None:
+            raise MXNetError("fused SPMD step: no batch has run yet")
+        return list(self._outputs)
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- host sync -----------------------------------------------------------
+    def copy_params_to(self, arg_params, aux_params):
+        params, _opt, aux, _step = self._carry
+        for k in self.param_names:
+            nd.NDArray(np.asarray(params[k])).copyto(arg_params[k])
+        for k in self.aux_names:
+            nd.NDArray(np.asarray(aux[k])).copyto(aux_params[k])
+
+    def _replace(self, params=None, opt_state=None, aux=None, step=None):
+        """Re-place the carry, preserving the pieces not overridden."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.spmd import replicated
+
+        old_p, old_o, old_a, old_s = self._carry
+        p = params if params is not None else dict(old_p)
+        o = opt_state if opt_state is not None else old_o
+        a = aux if aux is not None else dict(old_a)
+        carry = self._ts.place(p, o, a)
+        s = old_s if step is None else jax.device_put(
+            jnp.asarray(step, jnp.int32), replicated(self.mesh))
+        self._carry = (carry[0], carry[1], carry[2], s)
+
+    def set_params(self, arg_params, aux_params):
+        """Reset device params/aux from host (e.g. after load)."""
+        params = {k: arg_params[k]._data() for k in self.param_names}
+        aux = {k: aux_params[k]._data() for k in self.aux_names}
+        self._replace(params=params, aux=aux)
+
+    # -- optimizer state -----------------------------------------------------
+    def get_states(self):
+        import jax
+
+        _params, opt_state, _aux, step_no = self._carry
+        host = jax.tree_util.tree_map(np.asarray, opt_state)
+        return pickle.dumps({"opt_state": host, "step": int(step_no)})
+
+    def set_states(self, blob):
+        data = pickle.loads(blob)
+        self._replace(opt_state=data["opt_state"], step=data["step"])
+        self._step_no = data["step"]

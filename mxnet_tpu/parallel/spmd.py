@@ -40,7 +40,8 @@ from ..base import MXNetError
 
 __all__ = [
     "param_shardings", "data_sharding", "replicated", "make_train_step",
-    "TrainStep", "functional_optimizer", "cross_entropy_loss",
+    "TrainStep", "functional_optimizer", "functional_from_optimizer",
+    "cross_entropy_loss",
 ]
 
 
@@ -115,10 +116,13 @@ class FunctionalOptimizer:
 def functional_optimizer(name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
                          beta1=0.9, beta2=0.999, epsilon=1e-8,
                          rescale_grad=1.0, clip_gradient=None,
-                         lr_scheduler=None, wd_pattern=r".*(weight|gamma)$"):
+                         lr_scheduler=None, wd_pattern=r".*(weight|gamma)$",
+                         lr_mult=None, wd_mult=None):
     """Build a pure optimizer. ``wd_pattern``: params matching get weight
     decay, others (bias/beta/moving stats) get 0 — set_wd_mult parity
-    (python/mxnet/optimizer.py set_wd_mult)."""
+    (python/mxnet/optimizer.py set_wd_mult). Explicit per-name ``lr_mult``
+    / ``wd_mult`` dicts (default multiplier 1.0) override the pattern,
+    mirroring Optimizer.set_lr_mult/set_wd_mult exactly."""
     name = name.lower()
     wd_re = re.compile(wd_pattern)
 
@@ -126,6 +130,14 @@ def functional_optimizer(name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
         if lr_scheduler is not None:
             return lr_scheduler(step)
         return learning_rate
+
+    def mults(k):
+        lm = 1.0 if lr_mult is None else float(lr_mult.get(k, 1.0))
+        if wd_mult is not None:
+            wm = wd * float(wd_mult.get(k, 1.0))
+        else:
+            wm = wd if wd_re.match(k) else 0.0
+        return lm, wm
 
     def preprocess(g):
         g = g.astype(jnp.float32) * rescale_grad
@@ -144,12 +156,12 @@ def functional_optimizer(name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
             new_p, new_s = {}, {}
             for k, w in params.items():
                 g = preprocess(grads[k])
-                this_wd = wd if wd_re.match(k) else 0.0
+                lm, this_wd = mults(k)
                 g = g + this_wd * w
                 if momentum == 0.0:
-                    new_p[k] = w - lr * g
+                    new_p[k] = w - (lr * lm) * g
                 else:
-                    m = momentum * state[k] - lr * g
+                    m = momentum * state[k] - (lr * lm) * g
                     new_s[k] = m
                     new_p[k] = w + m
             return new_p, new_s
@@ -171,18 +183,49 @@ def functional_optimizer(name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
             new_p, new_s = {}, {}
             for k, w in params.items():
                 g = preprocess(grads[k])
-                this_wd = wd if wd_re.match(k) else 0.0
+                lm, this_wd = mults(k)
                 g = g + this_wd * w
                 m, v = state[k]
                 m = beta1 * m + (1 - beta1) * g
                 v = beta2 * v + (1 - beta2) * g * g
                 new_s[k] = (m, v)
-                new_p[k] = w - lr_t * m / (jnp.sqrt(v) + epsilon)
+                new_p[k] = w - (lr_t * lm) * m / (jnp.sqrt(v) + epsilon)
             return new_p, new_s
 
         return FunctionalOptimizer(init, apply, dict(lr=learning_rate, wd=wd))
 
     raise MXNetError("functional_optimizer: unknown optimizer %r" % name)
+
+
+def functional_from_optimizer(opt, param_names):
+    """Map an imperative ``optimizer.Optimizer`` instance to the pure
+    FunctionalOptimizer used by the fused SPMD step (Module kvstore='tpu').
+
+    Raises MXNetError for optimizers/features the fused path cannot
+    reproduce exactly (callers fall back to per-executor update).
+    """
+    from .. import optimizer as opt_mod
+
+    if opt.lr_scheduler is not None:
+        raise MXNetError(
+            "fused SPMD step: lr_scheduler uses python control flow per "
+            "update and cannot be traced; falling back")
+    if getattr(opt, "param_dict", None):
+        raise MXNetError("fused SPMD step: param_dict mults not supported")
+    lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in param_names}
+    wd_mult = {n: opt.wd_mult.get(n, 1.0) for n in param_names}
+    common = dict(
+        learning_rate=opt.lr, wd=opt.wd, rescale_grad=opt.rescale_grad,
+        clip_gradient=opt.clip_gradient, lr_mult=lr_mult, wd_mult=wd_mult,
+    )
+    if type(opt) is opt_mod.SGD:
+        return functional_optimizer("sgd", momentum=opt.momentum, **common)
+    if type(opt) is opt_mod.Adam:
+        return functional_optimizer(
+            "adam", beta1=opt.beta1, beta2=opt.beta2, epsilon=opt.epsilon, **common)
+    raise MXNetError(
+        "fused SPMD step: optimizer %s has no functional mirror"
+        % type(opt).__name__)
 
 
 def cross_entropy_loss(probs, label, eps=1e-12):
@@ -221,7 +264,8 @@ class TrainStep:
     def __init__(self, symbol, optimizer, mesh=None, data_axes=("dp",),
                  param_rules=None, label_names=("softmax_label",),
                  data_names=("data",), compute_dtype=None, loss_fn=None,
-                 zero=False, remat=False, normalize_grads=True):
+                 zero=False, remat=False, normalize_grads=True,
+                 return_outputs=False):
         from ..executor import _graph_closure
 
         self.symbol = symbol
@@ -239,6 +283,7 @@ class TrainStep:
         self.zero = zero
         self.remat = remat
         self.normalize_grads = normalize_grads
+        self.return_outputs = return_outputs
         self.param_rules = list(param_rules or [])
 
         arg_names = symbol.list_arguments()
@@ -374,7 +419,10 @@ class TrainStep:
             for k, v in aux_updates.items():
                 if k in new_aux:
                     new_aux[k] = v.astype(new_aux[k].dtype)
-            return (new_params, new_opt, new_aux, step_no + 1), loss
+            new_carry = (new_params, new_opt, new_aux, step_no + 1)
+            if self.return_outputs:
+                return new_carry, (loss, tuple(outs))
+            return new_carry, loss
 
         mesh = self.mesh
         if mesh is None:
@@ -387,10 +435,16 @@ class TrainStep:
             for n in self.data_names + self.label_names
         }
         carry_s = (ps, opt_s, aux_s, rep)
+        if self.return_outputs:
+            n_out = len(self.symbol.list_outputs())
+            out_sh = tuple(data_sharding(mesh, self.data_axes) for _ in range(n_out))
+            out_s = (carry_s, (rep, out_sh))
+        else:
+            out_s = (carry_s, rep)
         return jax.jit(
             step,
             in_shardings=(carry_s, batch_s, rep),
-            out_shardings=(carry_s, rep),
+            out_shardings=out_s,
             donate_argnums=(0,),
         )
 
