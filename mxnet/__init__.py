@@ -1,0 +1,45 @@
+"""``import mxnet as mx`` — drop-in alias for :mod:`mxnet_tpu`.
+
+The reference's example/tool scripts all start with ``import mxnet as mx``
+(e.g. example/image-classification/train_mnist.py:1); this package lets
+them run unmodified against the TPU-native framework. Every attribute
+resolves to the identical mxnet_tpu object, and submodules are registered
+under both names in ``sys.modules`` so ``import mxnet.io`` and
+``import mxnet_tpu.io`` yield the *same* module object (one op registry,
+one engine — never a double import).
+"""
+import importlib
+import sys
+
+import mxnet_tpu as _base
+
+_PKG = "mxnet_tpu"
+
+
+def _register_aliases():
+    for name, mod in list(sys.modules.items()):
+        if name == _PKG or name.startswith(_PKG + "."):
+            alias = "mxnet" + name[len(_PKG):]
+            if alias != "mxnet":  # never clobber this alias package itself
+                sys.modules.setdefault(alias, mod)
+
+
+_register_aliases()
+
+# Re-export the full top-level surface (classes, functions, submodule
+# aliases like nd/sym/mod/init) by reference.
+for _name in dir(_base):
+    if not _name.startswith("__"):
+        globals()[_name] = getattr(_base, _name)
+__version__ = _base.__version__
+
+
+def __getattr__(name):
+    """Lazily resolve submodules not imported by mxnet_tpu/__init__."""
+    try:
+        mod = importlib.import_module(_PKG + "." + name)
+    except ImportError as e:
+        raise AttributeError("module 'mxnet' has no attribute %r" % name) from e
+    sys.modules.setdefault("mxnet." + name, mod)
+    _register_aliases()
+    return mod

@@ -31,6 +31,7 @@ from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import callback  # noqa: F401
 from . import monitor  # noqa: F401
+from . import monitor as mon  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
 from . import kvstore  # noqa: F401

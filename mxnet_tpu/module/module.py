@@ -299,6 +299,11 @@ class Module(BaseModule):
                 self.logger.warning(
                     "kvstore='tpu': %s; using per-executor update path", e)
                 self._fused = None
+            except Exception as e:  # mesh/device construction failed
+                self.logger.warning(
+                    "kvstore='tpu': fused step unavailable (%r); using "
+                    "per-executor update path", e)
+                self._fused = None
 
         if kvstore:
             if self._compression_params:
@@ -356,6 +361,12 @@ class Module(BaseModule):
         if self._fused is not None:
             # One compiled step: fwd+bwd+optimizer update, batch sharded
             # over the mesh. update() below becomes a no-op.
+            if getattr(self, "_fused_stale", False):
+                # an explicit forward/backward/update() round went through
+                # the per-executor path meanwhile: refresh the device carry
+                self._exec_group.get_params(self._arg_params, self._aux_params)
+                self._fused.set_params(self._arg_params, self._aux_params)
+                self._fused_stale = False
             self._fused.forward_backward_update(data_batch)
             self._params_dirty = True
             self._last_fused = True
@@ -370,7 +381,12 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
         if self._fused is not None:
-            return  # update already applied inside the fused step
+            if getattr(self, "_last_fused", False):
+                return  # update already applied inside the fused step
+            # explicit forward()/backward() round: apply the per-executor
+            # update and mark the fused carry stale so the next fused step
+            # reloads parameters from the executors.
+            self._fused_stale = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
@@ -401,8 +417,14 @@ class Module(BaseModule):
 
     def _sync_params_from_devices(self):
         if self._fused is not None:
-            self._fused.copy_params_to(self._arg_params, self._aux_params)
-            self._exec_group.set_params(self._arg_params, self._aux_params)
+            if getattr(self, "_fused_stale", False):
+                # per-executor update ran last: executors hold the truth
+                self._exec_group.get_params(self._arg_params, self._aux_params)
+                self._fused.set_params(self._arg_params, self._aux_params)
+                self._fused_stale = False
+            else:
+                self._fused.copy_params_to(self._arg_params, self._aux_params)
+                self._exec_group.set_params(self._arg_params, self._aux_params)
             self._params_dirty = False
             return
         self._exec_group.get_params(self._arg_params, self._aux_params)

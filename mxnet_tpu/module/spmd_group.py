@@ -65,6 +65,7 @@ class FusedSPMDGroup:
         self._carry = self._ts.place(params, opt_state, aux)
         self._data_names = list(data_names)
         self._label_names = list(label_names)
+        self._output_names = list(symbol.list_outputs())
         self._key = jax.random.PRNGKey(0)
         self._step_no = 0
         self._loss = None
@@ -99,15 +100,22 @@ class FusedSPMDGroup:
         return list(self._outputs)
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+        # Same name-keyed dispatch as DataParallelExecutorGroup.update_metric
+        # so metrics with output_names/label_names pick the right arrays.
+        labels_ = dict(zip(self._label_names, labels))
+        preds_ = dict(zip(self._output_names, self.get_outputs()))
+        eval_metric.update_dict(labels_, preds_)
 
     # -- host sync -----------------------------------------------------------
     def copy_params_to(self, arg_params, aux_params):
+        import jax
+
         params, _opt, aux, _step = self._carry
+        host_p, host_a = jax.device_get((params, aux))  # one batched D2H
         for k in self.param_names:
-            nd.NDArray(np.asarray(params[k])).copyto(arg_params[k])
+            nd.NDArray(host_p[k]).copyto(arg_params[k])
         for k in self.aux_names:
-            nd.NDArray(np.asarray(aux[k])).copyto(aux_params[k])
+            nd.NDArray(host_a[k]).copyto(aux_params[k])
 
     def _replace(self, params=None, opt_state=None, aux=None, step=None):
         """Re-place the carry, preserving the pieces not overridden."""
@@ -131,14 +139,26 @@ class FusedSPMDGroup:
         self._replace(params=params, aux=aux)
 
     # -- optimizer state -----------------------------------------------------
+    _STATE_FORMAT = "fused-spmd-v1"
+
     def get_states(self):
         import jax
 
         _params, opt_state, _aux, step_no = self._carry
         host = jax.tree_util.tree_map(np.asarray, opt_state)
-        return pickle.dumps({"opt_state": host, "step": int(step_no)})
+        return pickle.dumps({"format": self._STATE_FORMAT,
+                             "opt_state": host, "step": int(step_no)})
 
     def set_states(self, blob):
-        data = pickle.loads(blob)
+        try:
+            data = pickle.loads(blob)
+        except Exception as e:
+            raise MXNetError("fused SPMD step: unreadable optimizer states "
+                             "(%s)" % e)
+        if not isinstance(data, dict) or data.get("format") != self._STATE_FORMAT:
+            raise MXNetError(
+                "fused SPMD step: optimizer-state file was not written by the "
+                "fused (kvstore='tpu') path; resume with the same kvstore "
+                "type it was saved under")
         self._replace(opt_state=data["opt_state"], step=data["step"])
         self._step_no = data["step"]

@@ -102,6 +102,35 @@ def test_fused_score_and_checkpoint(tmp_path):
     mod.load_optimizer_states(prefix + "-0005.states")
 
 
+def test_fused_explicit_forward_backward_update_still_trains():
+    """forward()/backward()/update() (not forward_backward) must go through
+    the per-executor path and actually move the weights, and a following
+    fused step must see them (carry refresh)."""
+    X, y = _data(seed=7)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(4)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None
+    p0 = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    p1 = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    assert any(np.abs(p1[k] - p0[k]).max() > 1e-7 for k in p1), \
+        "explicit update() was a silent no-op under fused mode"
+
+    # now a fused step must start from the exec-updated weights
+    mod.forward_backward(batch)
+    mod.update()
+    p2 = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    assert any(np.abs(p2[k] - p1[k]).max() > 1e-7 for k in p2)
+
+
 def test_fused_falls_back_for_exotic_optimizer():
     X, y = _data(seed=5)
     it = mx.io.NDArrayIter(X, y, batch_size=64)

@@ -1,0 +1,97 @@
+"""Run the reference's own example scripts unmodified against the
+``mxnet`` alias package (SURVEY §7 north star: "example scripts run
+unmodified with import mxnet as mx").
+
+The scripts are taken verbatim from /root/reference at test time (never
+copied into this repo); MNIST is replaced by synthetic idx-format data in
+the script's expected ``data/`` location, so its download_file() calls
+see existing files and read them with its own gzip/struct parser.
+"""
+import gzip
+import os
+import re
+import shutil
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REF = "/root/reference/example/image-classification"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not available")
+
+
+def _write_idx_images(path, images):
+    raw = struct.pack(">IIII", 2051, len(images), 28, 28) + images.astype(np.uint8).tobytes()
+    with gzip.open(path, "wb") as f:
+        f.write(raw)
+
+
+def _write_idx_labels(path, labels):
+    raw = struct.pack(">II", 2049, len(labels)) + labels.astype(np.int8).tobytes()
+    with gzip.open(path, "wb") as f:
+        f.write(raw)
+
+
+def _synth_mnist(n, seed):
+    """Learnable stand-in for MNIST: class = position of a bright block."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.randint(0, 40, (n, 28, 28))
+    for i, l in enumerate(labels):
+        r, c = divmod(int(l), 5)
+        images[i, 3 + r * 12 : 13 + r * 12, 2 + c * 5 : 7 + c * 5] = 255
+    return labels, images
+
+
+def _stage_script(tmp_path):
+    for rel in ("train_mnist.py", "common/__init__.py", "common/fit.py",
+                "common/util.py", "common/find_mxnet.py",
+                "symbols/__init__.py", "symbols/mlp.py", "symbols/lenet.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REF, rel), dst)
+    data = tmp_path / "data"
+    data.mkdir()
+    tl, ti = _synth_mnist(3200, seed=0)
+    vl, vi = _synth_mnist(640, seed=1)
+    _write_idx_labels(data / "train-labels-idx1-ubyte.gz", tl)
+    _write_idx_images(data / "train-images-idx3-ubyte.gz", ti)
+    _write_idx_labels(data / "t10k-labels-idx1-ubyte.gz", vl)
+    _write_idx_images(data / "t10k-images-idx3-ubyte.gz", vi)
+
+
+@pytest.mark.parametrize("network,epochs", [("mlp", 4), ("lenet", 2)])
+def test_reference_train_mnist_runs_unmodified(tmp_path, network, epochs):
+    _stage_script(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "train_mnist.py", "--network", network,
+         "--num-epochs", str(epochs),
+         "--num-examples", "3200", "--batch-size", "64", "--disp-batches", "20"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=480)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, "reference train_mnist.py failed:\n" + out[-4000:]
+    accs = [float(m) for m in re.findall(r"Validation-accuracy=([0-9.]+)", out)]
+    assert accs, "no validation accuracy logged:\n" + out[-4000:]
+    assert max(accs) > 0.95, "reference script accuracy too low: %s" % accs
+
+
+def test_mxnet_alias_is_same_module():
+    import mxnet as mx
+    import mxnet_tpu
+
+    assert mx.nd is mxnet_tpu.nd
+    assert mx.sym.Variable is mxnet_tpu.sym.Variable
+    assert sys.modules.get("mxnet.io") is mxnet_tpu.io
+    # lazy submodule attribute access registers the alias
+    assert mx.recordio is mxnet_tpu.recordio
+    # op registries are one and the same (no double import)
+    a = mx.nd.zeros((2, 2)) + 1
+    assert float(a.sum().asnumpy()) == 4.0
