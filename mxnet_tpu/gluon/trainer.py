@@ -85,22 +85,33 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _allreduce_grads(self):
+        # push all keys before the first pull so a dist kvstore can batch
+        # every gradient into one flattened collective (kvstore._flush)
         if self._kvstore and not self._update_on_kvstore:
+            live = []
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     self._kvstore.push(param.name, param.list_grad(), priority=-i)
-                    self._kvstore.pull(param.name, param.list_grad(), priority=-i)
+                    live.append((i, param))
+            for i, param in live:
+                self._kvstore.pull(param.name, param.list_grad(), priority=-i)
 
     def _update(self, ignore_stale_grad=False):
+        if self._kvstore and self._update_on_kvstore:
+            live = []
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                self._kvstore.push(param.name, param.list_grad(), priority=-i)
+                live.append((i, param))
+            for i, param in live:
+                self._kvstore.pull(param.name, param.data(), priority=-i)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            if self._kvstore and self._update_on_kvstore:
-                self._kvstore.push(param.name, param.list_grad(), priority=-i)
-                self._kvstore.pull(param.name, param.data(), priority=-i)
-            else:
-                for upd, arr, grad in zip(self._updaters, param.list_data(), param.list_grad()):
-                    upd(i, grad, arr)
+            for upd, arr, grad in zip(self._updaters, param.list_data(), param.list_grad()):
+                upd(i, grad, arr)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -78,6 +78,7 @@ class KVStore:
             agg = self._reduce(vlist)
             if self._compression_params is not None:
                 agg = self._compress_decompress(k, agg)
+            agg = self._to_store_device(k, agg)
             if self._updater is not None:
                 self._updater(self._normalize_key(k), agg, self._store[k])
             else:
@@ -119,6 +120,22 @@ class KVStore:
                     dense[rid] = taken
                     dense.copyto(o)
         return
+
+    def _to_store_device(self, k, agg):
+        """Align the reduced gradient with the store value's device — the
+        pushed grads may live on accelerator while the store was init'ed
+        from host-context params (ref: Comm reduce targets the store's
+        pinned ctx, comm.h). Tolerates numpy-backed values (whose .device
+        is absent or a string) by uploading them."""
+        import jax
+
+        dev = getattr(self._store[k]._data(), "device", None)
+        if dev is None or not hasattr(dev, "platform"):
+            return agg  # store itself is host-backed: nothing to align to
+        src = getattr(agg._data(), "device", None)
+        if src is not dev:
+            agg = NDArray(jax.device_put(agg._data(), dev), ctx=self._store[k].ctx)
+        return agg
 
     # -- reduction -----------------------------------------------------------
     @staticmethod
@@ -238,9 +255,11 @@ class DistKVStore(TPUKVStore):
     Reference counterpart: KVStoreDist worker + KVStoreDistServer
     (kvstore_dist.h:49, kvstore_dist_server.h:113). Serverless TPU
     design: every worker joined one jax.distributed job (launched by
-    tools/launch.py); ``push`` reduces locally then all-reduces across
-    workers with one XLA collective over the DCN mesh axis — the
-    server-side merge-buffer aggregation becomes a compiled sum. The
+    tools/launch.py); ``push`` reduces locally and buffers; the first
+    ``pull``/``barrier`` flushes every pending key in ONE flattened XLA
+    collective over the DCN mesh axis — the server-side merge-buffer
+    aggregation becomes a compiled sum, batched like the reference's
+    16-key push aggregation (model.py:106-124). The
     updater then runs identically on every worker (replacing the
     server-side optimizer), so weights stay bit-identical without a
     pull round-trip. dist_async maps to the same synchronous collective
@@ -252,28 +271,72 @@ class DistKVStore(TPUKVStore):
         from . import dist
 
         dist.init_from_env()
+        self._pending = {}
 
     def push(self, key, value, priority=0):
-        from . import dist
-
+        """Local reduce (+ optional 2-bit quantization, worker-side as in
+        kvstore_dist.h:346) then *defer*: pushes buffer until the first
+        pull/barrier, when ALL pending keys cross the wire in ONE
+        flattened XLA collective — the TPU analogue of the reference's
+        16-key push aggregation (model.py:106-124)."""
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("kvstore: key %r not initialized" % (k,))
+            if k in self._pending:
+                # double push of one key before any pull: preserve
+                # accumulate semantics by flushing the first round
+                self._flush()
             agg = self._reduce(vlist)
             if self._compression_params is not None:
                 agg = self._compress_decompress(k, agg)
-            total = dist.allreduce(agg.asnumpy())
-            agg = NDArray(total, ctx=agg.ctx)
-            if self._updater is not None:
-                self._updater(self._normalize_key(k), agg, self._store[k])
-            else:
-                self._store[k] += agg
+            # snapshot the (immutable) array now: the caller may overwrite
+            # its gradient NDArray in place before the flushing pull
+            self._pending[k] = (agg._data(), agg.ctx)
+
+    def _flush(self):
+        """One cross-worker collective for every pending key."""
+        if not self._pending:
+            return
+        from . import dist
+
+        pending, self._pending = self._pending, {}
+        # group by dtype so the flattened concat is bit-exact per key;
+        # concat on host — the collective is host-mediated anyway, so a
+        # device-side concat would only add a round-trip
+        by_dtype = {}
+        for k, (arr, ctx) in pending.items():
+            by_dtype.setdefault(np.dtype(arr.dtype), []).append(k)
+        for dt, keys in by_dtype.items():
+            arrs = [np.asarray(pending[k][0]) for k in keys]
+            flat = (np.concatenate([a.reshape(-1) for a in arrs])
+                    if len(arrs) > 1 else arrs[0].reshape(-1))
+            total = dist.allreduce(flat)
+            off = 0
+            for k, a in zip(keys, arrs):
+                size = int(np.prod(a.shape)) if a.ndim else 1
+                agg = NDArray(total[off:off + size].reshape(a.shape),
+                              ctx=pending[k][1])
+                off += size
+                agg = self._to_store_device(k, agg)
+                if self._updater is not None:
+                    self._updater(self._normalize_key(k), agg, self._store[k])
+                else:
+                    self._store[k] += agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        self._flush()
+        super().pull(key, out=out, priority=priority, ignore_sparse=ignore_sparse)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        self._flush()
+        return super().row_sparse_pull(key, out=out, priority=priority, row_ids=row_ids)
 
     def barrier(self):
         from . import dist
 
+        self._flush()
         nd.waitall()
         dist.barrier()
 

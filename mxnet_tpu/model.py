@@ -54,27 +54,34 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_o
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """Push grads / pull weights (ref: model.py:126 — push priority -idx so
-    comm overlaps backprop; XLA's async dispatch gives the overlap here)."""
+    """Push all grads, then pull all weights (ref: model.py:126 — push
+    priority -idx so comm overlaps backprop; here the push-all phase lets
+    a dist kvstore batch every key into one collective before the first
+    pull flushes it, and XLA's async dispatch gives the overlap)."""
+    live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
         name = param_names[index]
         kvstore.push(name, grad_list, priority=-index)
+        live.append((index, name, arg_list))
+    for index, name, arg_list in live:
         kvstore.pull(name, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
+    live = []
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
-        index = i
         if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
+            kvstore.push(param_names[i], grad_list, priority=-i)
+        live.append((i, arg_list, grad_list))
+    for index, arg_list, grad_list in live:
+        if kvstore:
+            kvstore.pull(param_names[index], grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)

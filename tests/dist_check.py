@@ -45,6 +45,39 @@ def main():
 
     kv.barrier()
 
+    # --- batched push: many keys, one flush ---------------------------
+    keys = ["k%d" % i for i in range(6)]
+    for i, k in enumerate(keys):
+        kv.init(k, nd.zeros((3, 5)))
+        kv.push(k, nd.ones((3, 5)) * (r + 1) * (i + 1))
+    assert len(kv._pending) == len(keys)  # deferred until first pull
+    for i, k in enumerate(keys):
+        out = nd.zeros((3, 5))
+        kv.pull(k, out=out)
+        expect = sum(range(1, n + 1)) * (i + 1)
+        assert np.allclose(out.asnumpy(), expect), (r, k, out.asnumpy()[0, 0], expect)
+    assert not kv._pending
+
+    # --- 2-bit compression through dist push (ref dist_sync_kvstore
+    # verify_residual: each worker quantizes locally, the collective sums
+    # the dequantized values) ------------------------------------------
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init("c", nd.zeros((4, 4)))
+    got = []
+    kvc._set_updater(lambda k, g, w: got.append(g.asnumpy().copy()))
+    # worker r pushes 0.3*(r+1): quantized locally to +0.5 iff >= 0.5
+    kvc.push("c", nd.ones((4, 4)) * 0.3 * (r + 1))
+    kvc.pull("c", out=nd.zeros((4, 4)))
+    expect_sum = sum(0.5 if 0.3 * (g + 1) >= 0.5 else 0.0 for g in range(n))
+    assert np.allclose(got[-1], expect_sum), (r, got[-1][0, 0], expect_sum)
+    # residuals carry: second identical push adds what was withheld
+    kvc.push("c", nd.ones((4, 4)) * 0.3 * (r + 1))
+    kvc.pull("c", out=nd.zeros((4, 4)))
+    res = [0.3 * (g + 1) - (0.5 if 0.3 * (g + 1) >= 0.5 else 0.0) for g in range(n)]
+    expect2 = sum(0.5 if res[g] + 0.3 * (g + 1) >= 0.5 else 0.0 for g in range(n))
+    assert np.allclose(got[-1], expect2), (r, got[-1][0, 0], expect2)
+
     # --- global-mesh fused training step ------------------------------
     from mxnet_tpu.models import transformer as tfm
 

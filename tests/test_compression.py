@@ -1,0 +1,82 @@
+"""2-bit gradient compression golden tests.
+
+Reference math: GradientCompression::Quantize/Dequantize with error
+feedback (src/kvstore/gradient_compression.h:37-133), golden-tested by
+tests/nightly/test_kvstore.py compute_expected_2bit_quantization: each
+element a' = a + residual maps to +threshold (a' >= t), -threshold
+(a' <= -t) or 0, and the residual keeps a' - quantized.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def expected_2bit(arr, residual, threshold):
+    """Reference simulation (tests/nightly/test_kvstore.py:33-66)."""
+    decompr = np.zeros_like(arr)
+    new_res = np.zeros_like(arr)
+    a = arr + residual
+    hi = a >= threshold
+    lo = a <= -threshold
+    decompr[hi] = threshold
+    decompr[lo] = -threshold
+    new_res = a - decompr
+    return decompr, new_res
+
+
+def test_quantize_golden_random():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rng = np.random.RandomState(0)
+    residual = np.zeros((8, 16), np.float32)
+    kv.init("g", nd.zeros((8, 16)))
+    captured = []
+    kv._set_updater(lambda k, g, w: captured.append(g.asnumpy()))
+    for it in range(5):
+        grad = rng.uniform(-1.2, 1.2, (8, 16)).astype(np.float32)
+        expect, residual = expected_2bit(grad, residual, 0.5)
+        kv.push("g", nd.NDArray(grad))
+        np.testing.assert_allclose(captured[-1], expect, atol=1e-7,
+                                   err_msg="iteration %d" % it)
+
+
+def test_quantize_residual_accumulates_to_threshold():
+    """verify_residual pattern (ref test): values below threshold emit 0
+    until the residual accumulates past it."""
+    kv = mx.kv.create("local")
+    threshold = 1.0
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    kv.init("w", nd.zeros((4,)))
+    seen = []
+    kv._set_updater(lambda k, g, w: seen.append(g.asnumpy().copy()))
+    kv.push("w", nd.NDArray(np.full((4,), 0.4, np.float32)))
+    assert np.all(seen[-1] == 0.0)  # 0.4 < 1.0
+    kv.push("w", nd.NDArray(np.full((4,), 0.4, np.float32)))
+    assert np.all(seen[-1] == 0.0)  # 0.8 < 1.0
+    kv.push("w", nd.NDArray(np.full((4,), 0.4, np.float32)))
+    assert np.all(seen[-1] == threshold)  # 1.2 >= 1.0 -> +t, residual 0.2
+    kv.push("w", nd.NDArray(np.full((4,), -2.0, np.float32)))
+    assert np.all(seen[-1] == -threshold)  # 0.2-2.0 <= -1.0 -> -t
+
+
+def test_deferred_push_snapshots_gradient():
+    """Mutating the grad NDArray between push and the flushing pull must
+    not change the pushed value (dist push defers to batch keys)."""
+    kv = mx.kv.create("dist_sync")  # single-process: collective is identity
+    kv.init("w", nd.zeros((4,)))
+    g = nd.ones((4,)) * 3.0
+    kv.push("w", g)
+    g[:] = 0.0  # caller reuses its buffer before pull
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_unsupported_compression_type_rejected():
+    kv = mx.kv.create("local")
+    try:
+        kv.set_gradient_compression({"type": "1bit"})
+    except mx.MXNetError:
+        return
+    raise AssertionError("1bit compression should be rejected")
