@@ -12,6 +12,18 @@ from ..io import DataIter, PrefetchingIter
 from .image import ImageIter
 
 
+def mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b):
+    """(mean_r,g,b)/(std_r,g,b) scalars → optional np arrays (shared by the
+    classification and detection record iterators)."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b])
+    return mean, std
+
+
 class ImageRecordIterImpl(DataIter):
     def __init__(self, path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
@@ -19,12 +31,7 @@ class ImageRecordIterImpl(DataIter):
                  resize=0, dtype="float32", preprocess_threads=4, prefetch_buffer=4,
                  path_imgidx=None, data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
-        mean = None
-        if mean_r or mean_g or mean_b:
-            mean = np.array([mean_r, mean_g, mean_b])
-        std = None
-        if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
-            std = np.array([std_r, std_g, std_b])
+        mean, std = mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b)
         inner = ImageIter(
             batch_size=batch_size, data_shape=tuple(data_shape), label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,

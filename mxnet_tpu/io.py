@@ -518,6 +518,48 @@ def ImageRecordUInt8Iter(**kwargs):
     return ImageRecordIterImpl(dtype="uint8", **kwargs)
 
 
+def ImageDetRecordIter(path_imgrec, data_shape=(3, 300, 300), batch_size=1,
+                       path_imgidx=None, shuffle=False, mean_r=0.0, mean_g=0.0,
+                       mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                       rand_crop_prob=0.0, rand_pad_prob=0.0, rand_mirror_prob=0.0,
+                       min_object_covered=0.1, min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=127, resize_mode="force",
+                       label_pad_width=0, data_name="data", label_name="label",
+                       **kwargs):
+    """Detection RecordIO pipeline (ref: src/io/iter_image_det_recordio.cc:582
+    ImageDetRecordIter + image_det_aug_default.cc): recordio decode →
+    bbox-aware augment → force-resize → padded (batch, max_obj, width)
+    labels, with background prefetch."""
+    import numpy as np
+
+    from .image.detection import ImageDetIter
+    from .image.recordio_iter import mean_std_arrays
+
+    if kwargs:
+        raise MXNetError("ImageDetRecordIter: unknown parameters %r"
+                         % sorted(kwargs))
+    if resize_mode != "force":
+        raise MXNetError("ImageDetRecordIter: only resize_mode='force' is "
+                         "implemented (got %r)" % resize_mode)
+    mean, std = mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b)
+    inner = ImageDetIter(
+        batch_size=batch_size, data_shape=tuple(data_shape),
+        path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+        rand_crop=rand_crop_prob, rand_pad=rand_pad_prob,
+        rand_mirror=rand_mirror_prob, mean=mean, std=std,
+        min_object_covered=min_object_covered,
+        min_eject_coverage=min_eject_coverage, max_attempts=max_attempts,
+        pad_val=(pad_val,) * 3 if np.isscalar(pad_val) else tuple(pad_val),
+        data_name=data_name, label_name=label_name,
+    )
+    if label_pad_width:
+        width = inner.object_width
+        # pad up only; reshape() rejects shrinking below the dataset extent
+        objs = max(inner.max_objects, label_pad_width // width)
+        inner.reshape(label_shape=(objs, width))
+    return PrefetchingIter(inner)
+
+
 class LibSVMIter(DataIter):
     """Sparse libsvm reader (ref: src/io/iter_libsvm.cc:200). Loads to a
     dense batch (TPU has no native sparse); CSR surface comes from

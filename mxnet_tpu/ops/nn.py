@@ -441,19 +441,28 @@ def _regression_out(data, label, grad_scale, kind):
 
 @register(name="make_loss", aliases=("MakeLoss",))
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Loss head: forward identity, backward a constant grad_scale field.
+    normalization: 'batch' divides by batch size, 'valid' by the count of
+    elements > valid_thresh (ref: src/operator/make_loss.cc)."""
     @jax.custom_vjp
     def f(d):
         return d
 
     def ml_fwd(d):
-        return d, (d.shape, d.dtype)
+        if normalization == "valid":
+            nv = jnp.maximum(jnp.sum(d > valid_thresh).astype(jnp.float32), 1.0)
+        else:
+            nv = jnp.ones((), jnp.float32)
+        return d, nv
 
-    def ml_bwd(res, g):
-        shape, dtype = res
+    def ml_bwd(nv, g):
         scale = grad_scale
         if normalization == "batch":
-            scale = scale / shape[0]
-        return (jnp.full(shape, scale, dtype=dtype),)
+            scale = scale / g.shape[0]
+        grad = jnp.full(g.shape, scale, g.dtype)
+        if normalization == "valid":
+            grad = grad / nv.astype(g.dtype)
+        return (grad,)
 
     f.defvjp(ml_fwd, ml_bwd)
     return f(data)

@@ -224,8 +224,8 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys.append(key)
 
 
-# image record header (ref: recordio.py IRHeader — flag, label, id, id2)
-IRHeader = struct.Struct("IfQQ")
+# image record header binary layout (flag, label, id, id2)
+_IR_STRUCT = struct.Struct("IfQQ")
 
 
 class _HeaderTuple(tuple):
@@ -246,6 +246,12 @@ class _HeaderTuple(tuple):
         return self[3]
 
 
+def IRHeader(flag, label, id, id2):  # noqa: A002 — reference signature
+    """Image record header constructor (ref: recordio.py
+    ``IRHeader = namedtuple('HeaderType', ['flag','label','id','id2'])``)."""
+    return _HeaderTuple((flag, label, id, id2))
+
+
 def pack(header, s):
     """Pack a (flag,label,id,id2) header + payload bytes into one record.
 
@@ -253,18 +259,18 @@ def pack(header, s):
     prepended to the payload (same convention as the reference)."""
     flag, label, idx, idx2 = header
     if isinstance(label, numbers.Number):
-        hdr = IRHeader.pack(flag, float(label), int(idx), int(idx2))
+        hdr = _IR_STRUCT.pack(flag, float(label), int(idx), int(idx2))
     else:
         label = np.asarray(label, dtype=np.float32)
-        hdr = IRHeader.pack(len(label), 0.0, int(idx), int(idx2))
+        hdr = _IR_STRUCT.pack(len(label), 0.0, int(idx), int(idx2))
         s = label.tobytes() + s
     return hdr + s
 
 
 def unpack(s):
     """Unpack a record into (header, payload)."""
-    hdr = _HeaderTuple(IRHeader.unpack(s[: IRHeader.size]))
-    s = s[IRHeader.size :]
+    hdr = _HeaderTuple(_IR_STRUCT.unpack(s[: _IR_STRUCT.size]))
+    s = s[_IR_STRUCT.size :]
     if hdr.flag > 0:
         n = hdr.flag
         label = np.frombuffer(s[: 4 * n], dtype=np.float32)

@@ -1,0 +1,160 @@
+"""Detection data pipeline end-to-end.
+
+Covers VERDICT Missing#4/#5: im2rec packing (tools/im2rec.py), the
+detection record iterator (ref src/io/iter_image_det_recordio.cc:582),
+bbox-aware augmenters (ref python/mxnet/image/detection.py), and a few
+real SSD training steps with MultiBoxTarget.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.image.detection import (
+    CreateDetAugmenter,
+    DetHorizontalFlipAug,
+    DetRandomCropAug,
+    DetRandomPadAug,
+    ImageDetIter,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_dataset(root, n=12, size=64):
+    """Synthetic detection set: one colored box per image, class = color.
+    Labels in reference det format [2, 5, cls, x1, y1, x2, y2]."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    os.makedirs(root, exist_ok=True)
+    lines = []
+    for i in range(n):
+        img = np.full((size, size, 3), 220, np.uint8)
+        cls = int(rng.randint(0, 2))
+        w, h = rng.randint(size // 4, size // 2, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        color = (255, 40, 40) if cls == 0 else (40, 40, 255)
+        img[y0:y0 + h, x0:x0 + w] = color
+        fname = "img%02d.png" % i
+        Image.fromarray(img).save(os.path.join(root, fname))
+        label = [2, 5, cls, x0 / size, y0 / size, (x0 + w) / size, (y0 + h) / size]
+        lines.append("%d\t%s\t%s" % (i, "\t".join("%f" % v for v in label), fname))
+    return lines
+
+
+def _pack(tmp_path, lines):
+    root = str(tmp_path / "imgs")
+    prefix = str(tmp_path / "det")
+    with open(prefix + ".lst", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+         prefix, root, "--pack-label"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert os.path.isfile(prefix + ".rec") and os.path.isfile(prefix + ".idx")
+    return prefix
+
+
+@pytest.fixture(scope="module")
+def det_rec(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("detdata")
+    lines = _make_dataset(str(tmp_path / "imgs"))
+    return _pack(tmp_path, lines)
+
+
+def test_im2rec_roundtrip(det_rec):
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(det_rec + ".idx", det_rec + ".rec", "r")
+    hdr, img = recordio.unpack_img(rec.read_idx(0))
+    assert img.shape == (64, 64, 3)
+    label = np.asarray(hdr.label)
+    assert label[0] == 2 and label[1] == 5 and label.size == 7
+
+
+def test_image_det_iter_shapes_and_labels(det_rec):
+    it = ImageDetIter(batch_size=4, data_shape=(3, 96, 96),
+                      path_imgrec=det_rec + ".rec")
+    assert it.provide_label[0].shape == (4, 1, 5)  # one object per image
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 96, 96)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4, 1, 5)
+    # classes valid, coords normalized and ordered
+    assert set(np.unique(lab[:, :, 0])) <= {0.0, 1.0}
+    assert np.all(lab[:, :, 1] < lab[:, :, 3])
+    assert np.all(lab[:, :, 2] < lab[:, :, 4])
+    assert np.all(lab[:, :, 1:] >= 0) and np.all(lab[:, :, 1:] <= 1)
+
+
+def test_det_augmenters_keep_boxes_consistent():
+    rng = np.random.RandomState(0)
+    img = np.zeros((80, 80, 3), np.float32)
+    img[20:60, 30:70] = 200.0  # the object
+    label = np.array([[0, 30 / 80, 20 / 80, 70 / 80, 60 / 80]], np.float32)
+
+    flip = DetHorizontalFlipAug(p=1.0)
+    fimg, flab = flip(img.copy(), label.copy())
+    assert np.allclose(flab[0, 1], 1 - label[0, 3]) and np.allclose(flab[0, 3], 1 - label[0, 1])
+    # flipped pixels follow the flipped box
+    x0, x1 = int(flab[0, 1] * 80), int(flab[0, 3] * 80)
+    assert fimg[40, (x0 + x1) // 2, 0] == 200.0
+
+    crop = DetRandomCropAug(min_object_covered=0.5, max_attempts=50)
+    for _ in range(5):
+        cimg, clab = crop(img.copy(), label.copy())
+        assert clab.shape[1] == 5 and clab.shape[0] >= 1
+        assert np.all(clab[:, 1:] >= -1e-6) and np.all(clab[:, 1:] <= 1 + 1e-6)
+
+    padder = DetRandomPadAug(max_attempts=50)
+    pimg, plab = padder(img.copy(), label.copy())
+    assert pimg.shape[0] >= 80 and pimg.shape[1] >= 80
+    # padded box must still frame bright pixels
+    y0, y1 = int(plab[0, 2] * pimg.shape[0]), int(plab[0, 4] * pimg.shape[0])
+    x0, x1 = int(plab[0, 1] * pimg.shape[1]), int(plab[0, 3] * pimg.shape[1])
+    assert pimg[(y0 + y1) // 2, (x0 + x1) // 2, 0] == 200.0
+
+    augs = CreateDetAugmenter((3, 64, 64), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    a_img, a_lab = img.copy(), label.copy()
+    for aug in augs:
+        a_img, a_lab = aug(a_img, a_lab)
+    assert a_lab.shape[1] == 5
+
+
+def test_image_det_record_iter_and_ssd_training(det_rec):
+    """The VERDICT bar: pack → ImageDetRecordIter with augmentation →
+    a few SSD train steps through MultiBoxTarget."""
+    from mxnet_tpu.models import ssd
+
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=det_rec + ".rec", batch_size=2, data_shape=(3, 300, 300),
+        rand_mirror_prob=0.5, rand_crop_prob=0.3, min_object_covered=0.5,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0)
+    assert it.provide_data[0].shape == (2, 3, 300, 300)
+
+    sym = ssd.get_symbol_train(num_classes=2)
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=("label",),
+                        context=mx.cpu(0))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 1e-3})
+
+    losses = []
+    for i, batch in enumerate(it):
+        if i >= 3:
+            break
+        mod.forward_backward(batch)
+        mod.update()
+        cls_prob, loc_loss, cls_target = [o.asnumpy() for o in mod.get_outputs()]
+        assert np.all(np.isfinite(cls_prob)) and np.all(np.isfinite(loc_loss))
+        # MultiBoxTarget matched at least one positive anchor per image
+        assert np.all((cls_target > 0).sum(axis=1) >= 1)
+        losses.append(float(np.abs(loc_loss).sum()))
+    assert len(losses) == 3

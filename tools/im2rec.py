@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""im2rec — create RecordIO image datasets.
+
+Reference counterpart: ``tools/im2rec.py`` / ``tools/im2rec.cc``. Two
+modes, same CLI shape:
+
+  python tools/im2rec.py --list prefix root     # write prefix.lst
+  python tools/im2rec.py prefix root            # pack prefix.lst -> .rec/.idx
+
+.lst line format (tab-separated, reference parity):
+  index \t label... \t relative/path.jpg
+With --pack-label, all label columns are stored in the record header
+(flat float array — e.g. the detection format
+[header_width, object_width, ..., id, xmin, ymin, xmax, ymax, ...]).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def list_images(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, _dirs, files in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(files):
+                fpath = os.path.join(path, fname)
+                if os.path.splitext(fname)[1].lower() in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and os.path.splitext(fname)[1].lower() in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_images(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    sep_test = int(n * args.test_ratio)
+    sep_train = int(n * (args.test_ratio + args.train_ratio))
+    if args.train_ratio == 1.0:
+        write_list(args.prefix + ".lst", image_list)
+    else:
+        if args.test_ratio:
+            write_list(args.prefix + "_test.lst", image_list[:sep_test])
+        if args.train_ratio + args.test_ratio < 1.0:
+            write_list(args.prefix + "_val.lst", image_list[sep_train:])
+        write_list(args.prefix + "_train.lst", image_list[sep_test:sep_train])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(float(parts[0])), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def pack(args):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import image as img_mod
+
+    lst = args.prefix + ".lst"
+    if not os.path.isfile(lst):
+        raise SystemExit("im2rec: %s not found (run --list first)" % lst)
+    rec = recordio.MXIndexedRecordIO(
+        args.prefix + ".idx", args.prefix + ".rec", "w")
+    count = 0
+    for idx, rel, label in read_list(lst):
+        path = os.path.join(args.root, rel)
+        with open(path, "rb") as f:
+            buf = f.read()
+        if args.resize or args.center_crop or args.quality != 95:
+            img = img_mod.imdecode_bytes(buf, iscolor=args.color)
+            if args.resize:
+                h, w = img.shape[:2]
+                if h > w:
+                    img = np.asarray(img_mod.imresize(
+                        img, args.resize, int(h * args.resize / w)).asnumpy())
+                else:
+                    img = np.asarray(img_mod.imresize(
+                        img, int(w * args.resize / h), args.resize).asnumpy())
+            if args.center_crop:
+                h, w = img.shape[:2]
+                s = min(h, w)
+                y0, x0 = (h - s) // 2, (w - s) // 2
+                img = img[y0:y0 + s, x0:x0 + s]
+            buf = img_mod.imencode_bytes(
+                img.astype(np.uint8), args.encoding, args.quality)
+        if args.pack_label:
+            header = recordio.IRHeader(0, np.asarray(label, np.float32), idx, 0)
+        else:
+            header = recordio.IRHeader(
+                0, label[0] if label else 0.0, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf))
+        count += 1
+        if count % 1000 == 0:
+            print("im2rec: packed %d images" % count)
+    rec.close()
+    print("im2rec: wrote %d records to %s.rec" % (count, args.prefix))
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Create an image list / RecordIO dataset (ref tools/im2rec.py)")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root dir")
+    p.add_argument("--list", action="store_true", help="create list instead of record")
+    p.add_argument("--exts", nargs="+", default=[".jpeg", ".jpg", ".png"])
+    p.add_argument("--recursive", action="store_true",
+                   help="folders become class labels")
+    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--pack-label", action="store_true",
+                   help="store all label columns in the record header")
+    p.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--num-thread", type=int, default=1,
+                   help="accepted for CLI parity; packing is single-thread")
+    args = p.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        pack(args)
+
+
+if __name__ == "__main__":
+    main()
