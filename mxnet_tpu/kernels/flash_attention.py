@@ -31,6 +31,10 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
 def _need_interpret(interpret):
     if interpret is not None:
         return interpret
@@ -281,11 +285,15 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=128,
     sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     interp = _need_interpret(interpret)
-    block_q = min(block_q, max(sq, 1))
-    block_k = min(block_k, max(sk, 1))
+    # Mosaic tiles refs as (8k, 128k) for fp32 / (16k, 128k) for bf16:
+    # clamp to the sequence length but keep blocks tile-aligned (seq is
+    # padded up to the block below, padded keys masked via kv_len).
+    block_q = _round_up(min(block_q, max(sq, 1)), 16)
+    block_k = _round_up(min(block_k, max(sk, 1)), 16)
 
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k
+    pad_d = (-d) % 128          # lane dim: zero lanes add 0 to q·k and out
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -296,7 +304,11 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=128,
         # (kv_len carries the true length), so zero-padding is safe
         kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    if pad_d:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, pad_d)))
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad_d)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad_d)))
     out = _flash(qf, kf, vf, scale, causal, block_q, block_k, interp, sk)
-    if pad_q:
-        out = out[:, :sq]
+    if pad_q or pad_d:
+        out = out[:, :sq, :d]
     return out.reshape(b, h, sq, d)

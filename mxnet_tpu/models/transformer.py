@@ -124,7 +124,9 @@ def _attention(q, k, v, *, axes, causal=True, attn="auto"):
     if attn == "auto":
         attn = "ring" if has_sp else "flash"
     if not has_sp:
-        if attn == "flash" and q.shape[-1] % 128 == 0 and jax.default_backend() == "tpu":
+        # flash_attention pads the head dim to the 128-lane tile internally,
+        # so common head dims (64, 80, ...) all take the O(S)-memory kernel
+        if attn == "flash" and jax.default_backend() == "tpu":
             from ..kernels import flash_attention
             return flash_attention(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
