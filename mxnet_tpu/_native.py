@@ -44,9 +44,31 @@ def _build():
         return None  # installed without sources; need a prebuilt lib
     if _needs_build(lib, srcdir):
         os.makedirs(os.path.dirname(lib), exist_ok=True)
-        # single source of truth for flags: src/Makefile
-        subprocess.run(["make", "-C", srcdir], check=True,
-                       capture_output=True)
+        # Sweep temp files orphaned by builders killed mid-make (their
+        # finally never ran). Only files older than 10 min are removed so
+        # a concurrent live build's temp is never yanked out from under
+        # its os.replace.
+        import glob
+        import time
+
+        for stale in glob.glob(lib + ".tmp.*"):
+            try:
+                if time.time() - os.path.getmtime(stale) > 600:
+                    os.remove(stale)
+            except OSError:
+                pass
+        # Build to a per-process temp name and rename into place atomically:
+        # tools/launch.py spawns N workers that may build concurrently, and
+        # a reader must never dlopen a partially written .so.
+        tmp = "%s.tmp.%d" % (lib, os.getpid())
+        try:
+            # single source of truth for flags: src/Makefile
+            subprocess.run(["make", "-C", srcdir, "OUT=%s" % tmp],
+                           check=True, capture_output=True)
+            os.replace(tmp, lib)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
     return lib
 
 
