@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from . import profiler as _profiler
 from .base import MXNetError, dtype_name, dtype_np
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
@@ -27,16 +28,30 @@ from .symbol.symbol import _AUX_PARAMS, Symbol
 _RNG_SALT = 0x5EED
 
 
-def _graph_closure(symbol: Symbol, is_train: bool):
+def _graph_closure(symbol: Symbol, is_train: bool, placement=None):
     """Build a pure function evaluating the symbol graph.
 
     Returns fn(values: dict[str, jax.Array], key) -> (outputs, aux_updates)
     where aux_updates maps aux var name -> new value (BatchNorm moving
     stats etc., applied by the caller after forward).
+
+    ``placement`` maps a ``ctx_group`` name to a concrete jax.Device: the
+    TPU-native PlaceDevice pass (ref: graph_executor.cc:411). Each node
+    stamped with that group is pinned there via ``jax.device_put`` inside
+    the traced program; XLA inserts the cross-device transfers that the
+    reference realized as explicit ``_CrossDeviceCopy`` nodes, in both the
+    forward and (through the transpose of device_put) the gradient graph.
     """
     nodes = symbol._topo()
     entries = symbol._entries
     node_ids = {id(n): i for i, n in enumerate(nodes)}
+    placement = placement or {}
+
+    def _place(node, out):
+        dev = placement.get(node.attr_dict.get("ctx_group"))
+        if dev is None:
+            return out
+        return tuple(jax.device_put(o, dev) for o in out)
 
     def fn(values, key):
         results = {}  # node id -> tuple of outputs
@@ -45,7 +60,7 @@ def _graph_closure(symbol: Symbol, is_train: bool):
             if node.is_variable():
                 if node.name not in values:
                     raise MXNetError("unbound variable %r" % node.name)
-                results[i] = (values[node.name],)
+                results[i] = _place(node, (values[node.name],))
                 continue
             ins = [results[node_ids[id(inp)]][idx] for inp, idx in node.inputs]
             attrs = dict(node.attrs)
@@ -57,6 +72,7 @@ def _graph_closure(symbol: Symbol, is_train: bool):
             else:
                 out = node.op.fn(*ins, **attrs)
             out = out if isinstance(out, tuple) else (out,)
+            out = _place(node, out)
             results[i] = out
             # aux-state update semantics (BatchNorm moving stats)
             if is_train and node.op.name in _AUX_PARAMS and node._arity:
@@ -364,13 +380,22 @@ class Executor:
         self._last_fwd_train = False
 
     # -- compilation ---------------------------------------------------------
+    def _placement(self):
+        """ctx_group name → jax.Device map from the bind-time group2ctx
+        (ref: symbol.py:1255 group2ctx → PlaceDevice)."""
+        if not self._group2ctx:
+            return None
+        return {g: (Context(c) if not isinstance(c, Context) else c).jax_device()
+                for g, c in self._group2ctx.items()}
+
     def _get_compiled(self, kind):
         fn = self._compiled.get(kind)
         if fn is not None:
             return fn
+        placement = self._placement()
         if kind in ("fwd_infer", "fwd_train"):
             is_train = kind == "fwd_train"
-            graph = _graph_closure(self._symbol, is_train)
+            graph = _graph_closure(self._symbol, is_train, placement)
 
             def run(values, key):
                 outs, aux_updates = graph(values, key)
@@ -378,8 +403,15 @@ class Executor:
 
             fn = jax.jit(run)
         elif kind == "fwd_bwd":
-            graph = _graph_closure(self._symbol, True)
+            graph = _graph_closure(self._symbol, True, placement)
             grad_names = tuple(self._grad_names)
+            # MXNET_BACKWARD_DO_MIRROR: recompute-in-backward (sublinear
+            # memory; ref graph_executor.cc:282-305 mirror predicate →
+            # jax.checkpoint on the whole bound program)
+            from . import config as _cfg
+
+            if _cfg.get_bool("MXNET_BACKWARD_DO_MIRROR"):
+                graph = jax.checkpoint(graph)
 
             def run(values, key, head_grads):
                 def of_grads(gvals):
@@ -427,7 +459,10 @@ class Executor:
         fn = self._get_compiled("fwd_train" if is_train else "fwd_infer")
         key = self._next_key()
         self._last_key = key  # backward() must replay the same PRNG draws
-        outs, aux_updates = fn(self._values(), key)
+        # ref: executor RunOps stamps each push (graph_executor.cc:1461);
+        # one XLA program = one event here
+        with _profiler.maybe_scope(self._symbol.name or "executor", "forward"):
+            outs, aux_updates = fn(self._values(), key)
         self._last_fwd_train = is_train
         self._set_outputs(outs)
         self._aux_applied = False
@@ -457,7 +492,8 @@ class Executor:
         program the fast path; see class docstring)."""
         heads = self._normalize_head_grads(out_grads)
         fn = self._get_compiled("fwd_bwd")
-        outs, grads, aux_updates = fn(self._values(), self._reuse_key(), heads)
+        with _profiler.maybe_scope(self._symbol.name or "executor", "backward"):
+            outs, grads, aux_updates = fn(self._values(), self._reuse_key(), heads)
         self._set_outputs(outs)
         if not getattr(self, "_aux_applied", False):
             self._apply_aux(aux_updates)
@@ -560,7 +596,8 @@ class Executor:
         return self._symbol.debug_str()
 
 
-def simple_bind(symbol, ctx, grad_req="write", type_dict=None, shared_exec=None, **kwargs):
+def simple_bind(symbol, ctx, grad_req="write", type_dict=None, shared_exec=None,
+                group2ctx=None, **kwargs):
     """Allocate arg/grad/aux arrays from inferred shapes and bind
     (ref: symbol.py:1255-1512 simple_bind + memory sharing via shared_exec —
     memory pooling is XLA's job here, so shared_exec only shares buffers)."""
@@ -595,4 +632,5 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, shared_exec=None,
             aux[name] = shared_exec.aux_dict[name]
         else:
             aux[name] = nd_zeros(shape, ctx=ctx, dtype=type_dict.get(name, _np.float32))
-    return Executor(symbol, ctx, args, grads, grad_req_dict, aux)
+    return Executor(symbol, ctx, args, grads, grad_req_dict, aux,
+                    group2ctx=group2ctx)

@@ -28,6 +28,7 @@ class BucketingModule(BaseModule):
         self._state_names = list(state_names or [])
         self._context = context
         self._work_load_list = work_load_list
+        self._group2ctxs = group2ctxs
         self._compression_params = compression_params
         self._buckets = {}
         self._curr_module = None
@@ -135,6 +136,7 @@ class BucketingModule(BaseModule):
             symbol, data_names, label_names, logger=self.logger, context=self._context,
             work_load_list=self._work_load_list, fixed_param_names=self._fixed_param_names,
             state_names=self._state_names, compression_params=self._compression_params,
+            group2ctxs=self._group2ctxs,
         )
         module.bind(
             data_shapes, label_shapes, for_training, inputs_need_grad,

@@ -36,7 +36,8 @@ def _split_input_slice(batch_size, work_load_list):
 class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
-                 logger=None, fixed_param_names=None, grad_req="write", state_names=None):
+                 logger=None, fixed_param_names=None, grad_req="write", state_names=None,
+                 group2ctxs=None):
         self.symbol = symbol
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
@@ -45,6 +46,7 @@ class DataParallelExecutorGroup:
         self.inputs_need_grad = inputs_need_grad
         self.fixed_param_names = set(fixed_param_names or [])
         self.state_names = set(state_names or [])
+        self.group2ctxs = group2ctxs or [None] * len(contexts)
         self.logger = logger
 
         self.arg_names = symbol.list_arguments()
@@ -101,7 +103,8 @@ class DataParallelExecutorGroup:
                 shapes[name] = (n_i,) + tuple(shape[1:])
             shared = shared_group.execs[i] if shared_group is not None else None
             self.execs.append(
-                simple_bind(self.symbol, ctx, grad_req=self.grad_req, shared_exec=shared, **shapes)
+                simple_bind(self.symbol, ctx, grad_req=self.grad_req, shared_exec=shared,
+                            group2ctx=self.group2ctxs[i], **shapes)
             )
         # param arrays: list (per param) of list (per device)
         self.param_arrays = [

@@ -38,6 +38,12 @@ class Module(BaseModule):
         if isinstance(context, ctx_mod.Context):
             context = [context]
         self._context = context
+        # per-device ctx-group maps (ref: module.py group2ctxs — a dict
+        # shared by all devices, or a list of dicts, one per device)
+        if isinstance(group2ctxs, dict) or group2ctxs is None:
+            group2ctxs = [group2ctxs] * len(self._context)
+        assert len(group2ctxs) == len(self._context)
+        self._group2ctxs = group2ctxs
         if work_load_list is None:
             work_load_list = [1] * len(self._context)
         assert len(work_load_list) == len(self._context)
@@ -126,8 +132,18 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        outs = self._exec_group.get_outputs()
-        return list(zip(self._output_names, [tuple(o.shape) for o in outs]))
+        # inferred once per bind/reshape (ref: module.py output_shapes
+        # comes from the bound graph's inferred shapes, not a forward)
+        key = tuple(self._exec_group._total_data_shapes
+                    + self._exec_group._total_label_shapes)
+        cached = getattr(self, "_output_shape_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        _, out_shapes, _ = self._symbol.infer_shape(**dict(key))
+        result = list(zip(self._output_names,
+                          [tuple(s) for s in out_shapes]))
+        self._output_shape_cache = (key, result)
+        return result
 
     def get_params(self):
         assert self.binded and self.params_initialized
@@ -212,6 +228,7 @@ class Module(BaseModule):
             label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group, logger=self.logger, fixed_param_names=self._fixed_param_names,
             grad_req=grad_req, state_names=self._state_names,
+            group2ctxs=self._group2ctxs,
         )
         if shared_module is not None:
             self.params_initialized = True
@@ -222,9 +239,17 @@ class Module(BaseModule):
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
+        # host copies must be refreshed from the *old* executors before
+        # they are replaced
+        if self.params_initialized and self._params_dirty:
+            self._sync_params_from_devices()
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         self._exec_group.reshape(data_shapes, label_shapes)
+        # rebinding allocated fresh (zeroed) arg arrays — restore weights
+        # (ref: reshape shares the original arrays; here buffers are new)
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),), force_init=False):

@@ -257,7 +257,8 @@ class Symbol:
         from ..executor import simple_bind
 
         return simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
-                           shared_exec=shared_exec, **kwargs)
+                           shared_exec=shared_exec, group2ctx=group2ctx,
+                           **kwargs)
 
     def eval(self, ctx=None, **kwargs):
         from ..context import current_context
