@@ -341,10 +341,11 @@ class HybridBlock(Block):
             # run forward with NDArray views over traced values
             wrapped_inputs = [_wrap_raw(v) for v in input_vals]
             holders = {}
+            wrapped = {}
             all_params = self_ref._collect_all_reg_params()
             for name, p in all_params.items():
                 holders[name] = p._data
-                p._data = _wrap_raw(param_vals[name])
+                wrapped[name] = p._data = _wrap_raw(param_vals[name])
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(is_train)
             tok = _rnd.push_trace_key(key)
@@ -356,11 +357,27 @@ class HybridBlock(Block):
                 autograd.set_training(prev_train)
                 for name, p in all_params.items():
                     p._data = holders[name]
+            # stateful-op aux mutation (BatchNorm running stats): the post
+            # hooks rebound the wrapped views in place; surface the updates
+            # as extra outputs so they survive the functional jit boundary
+            # (ref: CachedOp executes ops that mutate aux NDArrays directly,
+            # cached_op.cc:332 — here state must be threaded out explicitly)
+            mutated = {name: w._data() for name, w in wrapped.items()
+                       if w._data() is not param_vals[name]}
             if isinstance(out, (list, tuple)):
-                return [o._data() for o in out]
-            return out._data()
+                return [o._data() for o in out], mutated
+            return out._data(), mutated
 
         jitted = jax.jit(pure_fn)
+
+        def apply_mutated(mutated):
+            if not mutated:
+                return
+            all_params = self_ref._collect_all_reg_params()
+            for name, val in mutated.items():
+                p = all_params.get(name)
+                if p is not None and p._data is not None:
+                    p._data._rebind(val)
 
         def run(call_args, call_params):
             from .. import random as _rnd
@@ -371,8 +388,10 @@ class HybridBlock(Block):
             if autograd.is_recording():
                 return _recorded_apply(jitted, key, input_vals, param_vals,
                                        [a for a in call_args if isinstance(a, NDArray)],
-                                       self_ref._collect_all_reg_params())
-            out = jitted(key, input_vals, param_vals)
+                                       self_ref._collect_all_reg_params(),
+                                       apply_mutated)
+            out, mutated = jitted(key, input_vals, param_vals)
+            apply_mutated(mutated)
             if isinstance(out, list):
                 return [_wrap_raw(o) for o in out]
             return _wrap_raw(out)
@@ -380,16 +399,20 @@ class HybridBlock(Block):
         return run
 
 
-def _recorded_apply(jitted, key, input_vals, param_vals, input_arrays, params_map):
+def _recorded_apply(jitted, key, input_vals, param_vals, input_arrays,
+                    params_map, apply_mutated=None):
     """Run the cached fn under autograd: record one tape node whose vjp is
     the vjp of the whole compiled program (CachedOp::Backward parity)."""
     param_names = list(param_vals.keys())
 
     def fn_of_all(inp_list, pv_list):
         pv = dict(zip(param_names, pv_list))
-        return jitted(key, inp_list, pv)
+        out, _mutated = jitted(key, inp_list, pv)
+        return out
 
-    out = fn_of_all(input_vals, [param_vals[n] for n in param_names])
+    out, mutated = jitted(key, input_vals, param_vals)
+    if apply_mutated is not None:
+        apply_mutated(mutated)
     single = not isinstance(out, list)
     outs_list = [out] if single else list(out)
 

@@ -84,6 +84,8 @@ class Conv2D(_Conv):
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
         super().__init__(channels, kernel_size, strides, padding, dilation, groups,
                          layout, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, **kwargs)
@@ -94,6 +96,8 @@ class Conv3D(_Conv):
                  dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
         super().__init__(channels, kernel_size, strides, padding, dilation, groups,
                          layout, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer, **kwargs)
@@ -106,6 +110,8 @@ class Conv2DTranspose(_Conv):
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(output_padding, int):
             output_padding = (output_padding,) * 2
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
         super().__init__(channels, kernel_size, strides, padding, dilation, groups,
                          layout, in_channels, activation, use_bias,
                          weight_initializer, bias_initializer,

@@ -17,3 +17,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "nightly: slow integration tests (real short trainings with "
+        "accuracy asserts — ref tests/python/train tier)")

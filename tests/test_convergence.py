@@ -1,0 +1,125 @@
+"""Convergence anchors: real short trainings with accuracy asserts.
+
+Reference model: ``tests/python/train/test_conv.py`` (MNIST LeNet to 0.98)
+and ``test_mlp.py``. No network egress exists in CI, so MNIST is replaced
+by a synthetic-but-learnable 10-class image task (class = position of a
+bright block, plus per-image noise) that requires the conv stack, BN, and
+the optimizer to actually work end to end — a broken gradient or BN stat
+aggregation caps accuracy far below the asserted bar.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+def _synth_images(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.randint(0, 60, (n, 1, 28, 28))
+    for i, l in enumerate(labels):
+        r, c = divmod(int(l), 5)
+        images[i, 0, 3 + r * 12: 13 + r * 12, 2 + c * 5: 7 + c * 5] = 255
+    return (images / 255.0).astype(np.float32), labels.astype(np.float32)
+
+
+def _lenet():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data=data, kernel=(5, 5), num_filter=8, name="c1")
+    bn1 = mx.sym.BatchNorm(data=c1, name="bn1")
+    a1 = mx.sym.Activation(data=bn1, act_type="relu")
+    p1 = mx.sym.Pooling(data=a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(data=p1, kernel=(5, 5), num_filter=16, name="c2")
+    a2 = mx.sym.Activation(data=c2, act_type="relu")
+    p2 = mx.sym.Pooling(data=a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = mx.sym.Flatten(data=p2)
+    f1 = mx.sym.FullyConnected(data=fl, num_hidden=64, name="f1")
+    a3 = mx.sym.Activation(data=f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(data=a3, num_hidden=10, name="f2")
+    return mx.sym.SoftmaxOutput(data=f2, name="softmax")
+
+
+@pytest.mark.nightly
+def test_module_conv_converges():
+    """Module.fit on a conv net reaches >=0.99 val accuracy
+    (ref: tests/python/train/test_conv.py accuracy assert)."""
+    xt, yt = _synth_images(2000, seed=0)
+    xv, yv = _synth_images(500, seed=1)
+    train = mx.io.NDArrayIter(xt, yt, batch_size=50, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv, yv, batch_size=50,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_lenet(), context=mx.cpu())
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            num_epoch=3)
+    metric = mx.metric.Accuracy()
+    score = dict(mod.score(val, metric))
+    assert score["accuracy"] >= 0.99, score
+
+
+@pytest.mark.nightly
+def test_gluon_hybrid_conv_converges():
+    """Gluon HybridBlock + Trainer reaches >=0.99 (ref test_conv gluon
+    tier); exercises CachedOp, BN running stats, and Trainer.step."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=5), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Conv2D(16, kernel_size=5),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    xt, yt = _synth_images(2000, seed=2)
+    bs = 50
+    from mxnet_tpu import autograd
+    for epoch in range(3):
+        perm = np.random.RandomState(epoch).permutation(len(xt))
+        for i in range(0, len(xt), bs):
+            idx = perm[i:i + bs]
+            x = nd.array(xt[idx])
+            y = nd.array(yt[idx])
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(bs)
+
+    xv, yv = _synth_images(500, seed=3)
+    pred = np.argmax(net(nd.array(xv)).asnumpy(), axis=1)
+    acc = float((pred == yv).mean())
+    assert acc >= 0.99, acc
+
+
+@pytest.mark.nightly
+def test_module_fit_tpu_kvstore_matches_local():
+    """Data-parallel fused-SPMD fit (kvstore='tpu', 8-device CPU mesh)
+    reaches the same accuracy bar as the single-device path — the
+    dist-convergence-parity claim of BASELINE.md in miniature."""
+    xt, yt = _synth_images(2000, seed=4)
+    xv, yv = _synth_images(400, seed=5)
+    train = mx.io.NDArrayIter(xt, yt, batch_size=64, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv, yv, batch_size=64,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_lenet(), context=[mx.cpu(i) for i in range(8)])
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            kvstore="tpu",
+            num_epoch=3)
+    metric = mx.metric.Accuracy()
+    score = dict(mod.score(val, metric))
+    assert score["accuracy"] >= 0.99, score
