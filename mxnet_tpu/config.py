@@ -1,0 +1,142 @@
+"""The MXNET_* environment-knob surface.
+
+Reference counterpart: the ~31 ``MXNET_*`` env vars read through
+``dmlc::GetEnv`` across the reference runtime (SURVEY §5.6 tier 2).
+Every reference knob is listed here with its TPU-native disposition:
+
+- ``honored``   — changes behavior in this framework (reader cited).
+- ``subsumed``  — the concern is owned by XLA/jax (e.g. stream counts,
+                  memory pools, kernel tuning); setting it is a no-op by
+                  design, not an accident.
+- ``accepted``  — parsed and stored for API compatibility; consumers may
+                  read it via :func:`get`.
+
+``describe()`` returns the full table (the ``mx.runtime``-style
+feature/knob introspection the reference never quite had); ``get``/
+``get_int``/``get_bool`` are the typed accessors used by the framework
+itself.
+"""
+from __future__ import annotations
+
+import os
+
+# name -> (default, status, description)
+KNOBS = {
+    # --- engine (src/engine/) ---
+    "MXNET_ENGINE_TYPE": (
+        "ThreadedEngine", "honored",
+        "host dependency engine implementation (ThreadedEngine|NaiveEngine); "
+        "read by engine.create (engine.py)"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "4", "honored",
+        "native engine worker thread count (engine.py; src/engine.cc)"),
+    "MXNET_CPU_PRIORITY_NTHREADS": (
+        "4", "subsumed",
+        "priority pool size — XLA async dispatch owns device ordering"),
+    "MXNET_GPU_WORKER_NTHREADS": (
+        "2", "subsumed", "per-accelerator worker threads — XLA-owned"),
+    "MXNET_ENGINE_INFO": (
+        "0", "accepted", "verbose engine scheduling logs"),
+    # --- executor (src/executor/) ---
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "1", "subsumed", "op bulking — jit compiles the whole graph anyway"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (
+        "1", "subsumed", "op bulking — as above"),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": (
+        "15", "subsumed", "bulk segment cap — whole-graph jit"),
+    "MXNET_EXEC_NUM_TEMP": (
+        "1", "subsumed", "temp-space arenas — XLA memory planning"),
+    "MXNET_BACKWARD_DO_MIRROR": (
+        "0", "honored",
+        "recompute-in-backward (sublinear memory): wraps the executor's "
+        "fwd+bwd program in jax.checkpoint (executor.py _get_compiled)"),
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": (
+        "8", "subsumed", "gradient-sum inplace cap — XLA buffer planning"),
+    # --- memory (src/storage/) ---
+    "MXNET_GPU_MEM_POOL_RESERVE": (
+        "5", "subsumed", "device pool watermark — XLA/TPU allocator owns HBM"),
+    "MXNET_TPU_HOST_POOL_BYTES": (
+        str(1 << 30), "honored",
+        "native host storage-pool cap in bytes (storage.py)"),
+    # --- kvstore (src/kvstore/) ---
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": (
+        "4", "subsumed", "CPU reduce threads — reductions compile into XLA"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        str(1000 * 1000), "accepted",
+        "big-array server-sharding threshold (serverless design: the DCN "
+        "collective is already key-batched, kvstore.py DistKVStore._flush)"),
+    "MXNET_KVSTORE_SERIAL_PUSH": (
+        "0", "accepted", "serialize push processing"),
+    "MXNET_ENABLE_GPU_P2P": (
+        "1", "subsumed", "peer-to-peer copies — ICI collectives"),
+    # --- cudnn/tuning (disappear into the XLA compiler) ---
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
+        "0", "subsumed", "conv algo autotuning — XLA picks"),
+    "MXNET_USE_OPERATOR_TUNING": (
+        "1", "subsumed", "OMP cost-model tuning — XLA fusion"),
+    "MXNET_USE_NUM_CORES_OPERATOR_TUNING": (
+        "0", "subsumed", "as above"),
+    # --- profiler (src/engine/profiler.cc; profiler.py) ---
+    "MXNET_PROFILER_MODE": (
+        "symbolic", "honored",
+        "profiler mode at autostart (symbolic|all) — profiler.py"),
+    "MXNET_PROFILER_AUTOSTART": (
+        "0", "honored",
+        "start the profiler at import; dump on exit — profiler.py"),
+    "MXNET_TPU_JAX_TRACE_DIR": (
+        "", "honored",
+        "also capture a jax/XPlane device trace into this dir when the "
+        "profiler runs (profiler.py)"),
+    # --- IO ---
+    "MXNET_CPU_TEMP_COPY": (
+        "4", "subsumed", "IO staging copies — host runtime"),
+    # --- distributed roles (dmlc/ps-lite launcher contract) ---
+    "DMLC_ROLE": (
+        "worker", "honored",
+        "worker|server|scheduler — server/scheduler are exit-0 shims in "
+        "the serverless design (kvstore_server.py)"),
+    "DMLC_PS_ROOT_URI": (
+        "", "honored", "coordinator host (dist.py env_spec)"),
+    "DMLC_PS_ROOT_PORT": (
+        "9091", "honored", "coordinator port (dist.py env_spec)"),
+    "DMLC_NUM_WORKER": (
+        "1", "honored", "world size (dist.py env_spec)"),
+    "DMLC_WORKER_ID": (
+        "0", "honored", "worker rank (dist.py env_spec)"),
+    # --- misc ---
+    "MXNET_TPU_NO_NATIVE": (
+        "0", "honored", "force pure-Python fallbacks (_native.py)"),
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (
+        "1", "accepted", "log dense fallbacks of sparse ops"),
+}
+
+
+def get(name, default=None):
+    """Raw string value of a knob (env wins; then registry default)."""
+    if name in os.environ:
+        return os.environ[name]
+    if default is not None:
+        return default
+    if name in KNOBS:
+        return KNOBS[name][0]
+    return None
+
+
+def get_int(name, default=None):
+    v = get(name, None if default is None else str(default))
+    return int(v) if v not in (None, "") else None
+
+
+def get_bool(name, default=False):
+    v = get(name, "1" if default else "0")
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def describe():
+    """[(name, current_value, status, description)] for every knob."""
+    return [(n, get(n), s, d) for n, (_, s, d) in sorted(KNOBS.items())]
+
+
+def print_summary():
+    for name, value, status, desc in describe():
+        print("%-40s %-10s %-8s %s" % (name, value, status, desc))

@@ -142,3 +142,56 @@ def barrier():
     if not _INITIALIZED:
         return
     allreduce(np.zeros((1,), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# failure detection (ref: ps-lite heartbeats behind
+# include/mxnet/kvstore.h:330-340 get_num_dead_node)
+# ---------------------------------------------------------------------------
+def _client():
+    """The jax coordination-service client (heartbeats live there)."""
+    try:
+        from jax._src.distributed import global_state
+
+        return getattr(global_state, "client", None)
+    except Exception:
+        return None
+
+
+def live_workers():
+    """rank → alive? map from the coordination service's own heartbeat
+    tracking (the ps-lite heartbeat equivalent). All-alive when running
+    single-process or when the service is unreachable."""
+    n = num_workers() if _INITIALIZED else 1
+    c = _client() if _INITIALIZED else None
+    if c is None:
+        return {r: True for r in range(n)}
+    try:
+        live = c.get_live_nodes(list(range(n)))
+        return {r: r in live for r in range(n)}
+    except Exception:
+        return {r: True for r in range(n)}
+
+
+def get_num_dead_node(node_id=0, timeout=60):
+    """Number of dead workers (ref: KVStore::get_num_dead_node,
+    kvstore.h:330-340; node_id/timeout kept for API parity — the
+    coordination service already applies its own heartbeat timeout)."""
+    del node_id, timeout
+    return sum(1 for alive in live_workers().values() if not alive)
+
+
+def exit_barrier(timeout_ms=10000):
+    """Best-effort barrier before process exit (ref barrier_before_exit_,
+    kvstore.h:290-297): bounded by a timeout so one dead worker cannot
+    hang the others' shutdown."""
+    if not _INITIALIZED:
+        return True
+    c = _client()
+    if c is None:
+        return True
+    try:
+        c.wait_at_barrier("mxtpu_exit_barrier", timeout_ms)
+        return True
+    except Exception:
+        return False

@@ -141,9 +141,18 @@ class KVStore:
     @staticmethod
     def _reduce(vlist):
         """Tree-sum per-device values onto device 0 (Comm::Reduce parity,
-        src/kvstore/comm.h:56 — the device transfer is jax device_put)."""
+        src/kvstore/comm.h:56 — the device transfer is jax device_put).
+        Row-sparse gradients aggregate sparsely — indices/values concat,
+        never densified (ref: comm.h ReduceRowSparse)."""
         if len(vlist) == 1:
             return vlist[0]
+        from .ndarray import sparse as nd_sparse
+
+        if all(isinstance(v, nd_sparse.RowSparseNDArray) for v in vlist):
+            total = vlist[0]
+            for v in vlist[1:]:
+                total = nd_sparse.add(total, v)
+            return total
         import jax
 
         dev = vlist[0].ctx.jax_device()
@@ -212,6 +221,19 @@ class KVStore:
     def barrier(self):
         nd.waitall()
 
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Count of dead workers (ref: KVStore::get_num_dead_node,
+        include/mxnet/kvstore.h:330-340). Always 0 for in-process
+        stores; DistKVStore consults the coordination-service
+        heartbeats."""
+        del node_id, timeout
+        return 0
+
+    def set_barrier_before_exit(self, barrier_before_exit=True):
+        """ref: barrier_before_exit_, kvstore.h:290-297 — honored by
+        dist stores at interpreter exit (bounded-timeout barrier)."""
+        self._barrier_before_exit = bool(barrier_before_exit)
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("kvstore: no updater to save")
@@ -268,10 +290,31 @@ class DistKVStore(TPUKVStore):
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
+        import atexit
+
         from . import dist
 
         dist.init_from_env()
         self._pending = {}
+        self._barrier_before_exit = True
+        atexit.register(self._exit_barrier)
+
+    def _exit_barrier(self):
+        if getattr(self, "_barrier_before_exit", False):
+            from . import dist
+
+            # bounded barrier FIRST: if a peer is dead it fails within the
+            # timeout and we skip the unbounded collective flush (which
+            # would hang forever waiting for the dead worker). When it
+            # succeeds, every live worker is inside its own exit hook and
+            # will run the matching flush.
+            if dist.exit_barrier():
+                self._flush()
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        from . import dist
+
+        return dist.get_num_dead_node(node_id, timeout)
 
     def push(self, key, value, priority=0):
         """Local reduce (+ optional 2-bit quantization, worker-side as in

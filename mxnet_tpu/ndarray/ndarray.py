@@ -598,7 +598,13 @@ def invoke(op, inputs, attrs, out=None, ctx=None):
     key = _random.next_key(ctx) if op.needs_rng else None
     arrays = ([key] + raw) if op.needs_rng else raw
 
-    results = _reg.apply_op_with_key(op, arrays, parsed) if op.needs_rng else _reg.apply_op(op, raw, parsed)
+    from .. import profiler as _prof
+
+    # kAllOperator mode: stamp every imperative dispatch (ref: profiler
+    # modes, src/engine/profiler.h:97-98)
+    with _prof.maybe_scope(op.name, "operator", mode="all"):
+        results = (_reg.apply_op_with_key(op, arrays, parsed)
+                   if op.needs_rng else _reg.apply_op(op, raw, parsed))
     if not isinstance(results, tuple):
         results = (results,)
 

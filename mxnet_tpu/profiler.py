@@ -94,3 +94,27 @@ def pause():
 
 def resume():
     _STATE["running"] = True
+
+
+def is_running():
+    return _STATE["running"]
+
+
+def maybe_scope(name, category="operator", mode=None):
+    """A trace scope when the profiler runs (and matches ``mode`` if
+    given), else a no-op context — keeps call sites single-expression."""
+    import contextlib
+
+    if _STATE["running"] and (mode is None or _STATE["mode"] == mode):
+        return scope(name, category)
+    return contextlib.nullcontext()
+
+
+# MXNET_PROFILER_AUTOSTART (ref: profiler.cc:65): begin collecting at
+# import, dump to MXNET_PROFILER_MODE's filename at interpreter exit.
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    import atexit
+
+    profiler_set_config(mode=os.environ.get("MXNET_PROFILER_MODE", "symbolic"))
+    profiler_set_state("run")
+    atexit.register(lambda: (profiler_set_state("stop"), dump_profile()))
