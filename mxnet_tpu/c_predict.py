@@ -1,0 +1,163 @@
+"""Python half of the C predict ABI.
+
+Reference counterpart: ``src/c_api/c_predict_api.cc`` (364 LoC) backing
+``include/mxnet/c_predict_api.h``. TPU-native split: the C shared library
+(``src/c_predict.cc`` → libmxtpu_predict.so) owns the ABI surface and
+embeds CPython; this module owns everything behind it — symbol JSON
+parsing, param loading, binding the jitted XLA inference program. A C
+deployment links one .so and never sees Python, while the compiled
+program underneath is the same HloModule the framework trains with.
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+
+import numpy as _np
+
+
+def _as_ndarray_map(param_bytes):
+    """Parse a .params payload (dict save format; arg:/aux: prefixes
+    per reference save_checkpoint, model.py:366)."""
+    from .ndarray.ndarray import array
+
+    arg_params, aux_params = {}, {}
+    with _np.load(io.BytesIO(param_bytes), allow_pickle=False) as npz:
+        for k in npz.keys():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = array(npz[k])
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = array(npz[k])
+            else:
+                arg_params[k] = array(npz[k])
+    return arg_params, aux_params
+
+
+class CPredictor:
+    """One bound inference program (the PredictorHandle's payload)."""
+
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_shapes, output_names=None):
+        from . import context as ctx_mod
+        from . import symbol as sym_mod
+        from .ndarray.ndarray import zeros
+
+        sym = sym_mod.load_json(symbol_json)
+        if output_names:
+            # partial-out picks internal nodes (ref: c_predict_api.cc uses
+            # sym.GetInternals() so any layer can be an output)
+            internals = sym.get_internals()
+            outs = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                want = name if name in outs else name + "_output"
+                if want not in outs:
+                    raise ValueError("unknown output %r (have %s)" % (name, outs))
+                picked.append(internals[outs.index(want)])
+            sym = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
+
+        # dev_type follows the reference enum: 1=cpu, 2=gpu(=accelerator)
+        ctx = ctx_mod.cpu(dev_id) if dev_type == 1 else ctx_mod.gpu(dev_id)
+
+        arg_params, aux_params = _as_ndarray_map(param_bytes)
+        arg_shapes, _, aux_shapes = sym.infer_shape(**dict(input_shapes))
+        args = {}
+        for name, shape in zip(sym.list_arguments(), arg_shapes):
+            if name in input_shapes:
+                args[name] = zeros(input_shapes[name], ctx=ctx)
+            elif name in arg_params:
+                args[name] = arg_params[name].as_in_context(ctx)
+            else:
+                # ref parity: c_predict_api.cc warns and zero-fills args
+                # absent from the params file (loss labels, eval-only args)
+                args[name] = zeros(shape, ctx=ctx)
+        aux = {}
+        for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+            if name in aux_params:
+                aux[name] = aux_params[name].as_in_context(ctx)
+            else:
+                aux[name] = zeros(shape, ctx=ctx)
+
+        self._exe = sym.bind(ctx, args, args_grad=None, grad_req="null",
+                             aux_states=aux)
+        self._ctx = ctx
+        self._input_shapes = dict(input_shapes)
+        self._outputs = None
+
+    # -- ABI backend methods (called from src/c_predict.cc) -----------------
+    def set_input(self, key, ptr, size):
+        if key not in self._input_shapes:
+            raise ValueError("unknown input %r" % key)
+        shape = self._input_shapes[key]
+        n = 1
+        for s in shape:
+            n *= s
+        if size != n:
+            raise ValueError("input %r: expected %d floats, got %d"
+                             % (key, n, size))
+        buf = (ctypes.c_float * size).from_address(ptr)
+        data = _np.frombuffer(buf, dtype=_np.float32).reshape(shape)
+        from .ndarray.ndarray import array
+
+        # allocate on the predictor's device: the default context may
+        # differ (e.g. a CPU-default host feeding a TPU-bound program)
+        self._exe.arg_dict[key][:] = array(data.copy(), ctx=self._ctx)
+
+    def forward(self):
+        self._outputs = [o.asnumpy().astype(_np.float32)
+                         for o in self._exe.forward(is_train=False)]
+
+    def num_outputs(self):
+        return len(self._exe._symbol.list_outputs())
+
+    def output_shape(self, index):
+        if self._outputs is None:
+            self.forward()
+        return tuple(int(s) for s in self._outputs[index].shape)
+
+    def get_output(self, index, ptr, size):
+        if self._outputs is None:
+            raise ValueError("call forward before get_output")
+        flat = _np.ascontiguousarray(self._outputs[index]).reshape(-1)
+        if size != flat.size:
+            raise ValueError("output %d: expected %d floats, got %d"
+                             % (index, flat.size, size))
+        buf = (ctypes.c_float * size).from_address(ptr)
+        _np.frombuffer(buf, dtype=_np.float32)[:] = flat
+
+
+class NDList:
+    """Backing for MXNDListCreate/Get (a loaded .params blob)."""
+
+    def __init__(self, nd_bytes):
+        self.keys = []
+        self.arrays = []
+        with _np.load(io.BytesIO(nd_bytes), allow_pickle=False) as npz:
+            for k in npz.keys():
+                name = k.split(":", 1)[1] if ":" in k else k
+                self.keys.append(name)
+                self.arrays.append(
+                    _np.ascontiguousarray(npz[k]).astype(_np.float32))
+
+    def __len__(self):
+        return len(self.keys)
+
+    def key(self, i):
+        return self.keys[i]
+
+    def shape(self, i):
+        return tuple(int(s) for s in self.arrays[i].shape)
+
+    def data_ptr(self, i):
+        # the ndarray owns the buffer; valid while this NDList lives
+        return self.arrays[i].ctypes.data
+
+
+def create_predictor(symbol_json, param_bytes, dev_type, dev_id,
+                     input_shapes, output_names=None):
+    return CPredictor(symbol_json, param_bytes, dev_type, dev_id,
+                      input_shapes, output_names)
+
+
+def create_ndlist(nd_bytes):
+    return NDList(nd_bytes)

@@ -1,0 +1,82 @@
+/*
+ * C predict API — the minimal deployment ABI.
+ *
+ * Reference counterpart: include/mxnet/c_predict_api.h (364 LoC; the
+ * self-contained inference surface shipped by amalgamation/mobile).
+ * Same function names, arguments, and semantics; the implementation
+ * (c_predict.cc) embeds CPython and runs the jitted XLA inference
+ * program instead of the reference's engine — one .so, plain C ABI.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXNET_DLL __attribute__((visibility("default")))
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/* Last error message (thread-local). ref: c_predict_api.h:57 */
+MXNET_DLL const char *MXGetLastError();
+
+/* Create a predictor from symbol JSON + param blob.
+ * dev_type: 1 cpu, 2 accelerator (tpu). ref: c_predict_api.h:78 */
+MXNET_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+
+/* Same, keeping only the named outputs. ref: c_predict_api.h:111 */
+MXNET_DLL int MXPredCreatePartialOut(const char *symbol_json_str,
+                                     const void *param_bytes, int param_size,
+                                     int dev_type, int dev_id,
+                                     mx_uint num_input_nodes,
+                                     const char **input_keys,
+                                     const mx_uint *input_shape_indptr,
+                                     const mx_uint *input_shape_data,
+                                     mx_uint num_output_nodes,
+                                     const char **output_keys,
+                                     PredictorHandle *out);
+
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data, mx_uint *shape_ndim);
+
+MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size);
+
+MXNET_DLL int MXPredForward(PredictorHandle handle);
+
+/* Stepper parity: executes the whole program on the first step
+ * (ref PartialForward is a debug stepper, graph_executor.cc:85-92). */
+MXNET_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left);
+
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size);
+
+MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+/* NDArray-list loading (a .params blob). ref: c_predict_api.h:198 */
+MXNET_DLL int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out, mx_uint *out_length);
+
+MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim);
+
+MXNET_DLL int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,248 @@
+"""C predict ABI (ref: include/mxnet/c_predict_api.h,
+src/c_api/c_predict_api.cc — the deployment surface).
+
+Two tiers:
+- ctypes in-process: the .so reuses the host interpreter (PyGILState),
+  exactly how a Python-hosted C extension consumer would see it.
+- a real C program: compiled with gcc at test time, linked against
+  libmxtpu_predict.so only, running with its own embedded interpreter —
+  proves the ABI stands alone the way the reference's amalgamation did.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "mxnet_tpu", "lib", "libmxtpu_predict.so")
+
+
+def _build_lib():
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src"), "predict"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("predict lib build failed: " + r.stderr[-500:])
+
+
+def _export_model(tmp_path):
+    """LeNet-ish head exported in the reference two-artifact format."""
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="tanh")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=act, num_hidden=3, name="fc2"),
+        name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 5))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 0)
+    # expected output through the Python path
+    x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+    mod.forward(mx.io.DataBatch(data=[nd.array(x)], label=None),
+                is_train=False)
+    expect = mod.get_outputs()[0].asnumpy()
+    return prefix, x, expect
+
+
+def _load():
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _err(lib):
+    return lib.MXGetLastError().decode()
+
+
+def _create(lib, prefix, batch_shape, partial_out=None):
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read().encode()
+    with open(prefix + "-0000.params", "rb") as f:
+        params = f.read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, len(batch_shape))
+    shape = (ctypes.c_uint * len(batch_shape))(*batch_shape)
+    handle = ctypes.c_void_p()
+    if partial_out is None:
+        rc = lib.MXPredCreate(sym_json, params, len(params), 1, 0, 1, keys,
+                              indptr, shape, ctypes.byref(handle))
+    else:
+        okeys = (ctypes.c_char_p * len(partial_out))(
+            *[o.encode() for o in partial_out])
+        rc = lib.MXPredCreatePartialOut(
+            sym_json, params, len(params), 1, 0, 1, keys, indptr, shape,
+            len(partial_out), okeys, ctypes.byref(handle))
+    assert rc == 0, _err(lib)
+    return handle
+
+
+def test_predict_roundtrip(tmp_path):
+    _build_lib()
+    prefix, x, expect = _export_model(tmp_path)
+    lib = _load()
+    handle = _create(lib, prefix, (2, 5))
+
+    flat = np.ascontiguousarray(x.reshape(-1))
+    rc = lib.MXPredSetInput(handle, b"data",
+                            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            flat.size)
+    assert rc == 0, _err(lib)
+    assert lib.MXPredForward(handle) == 0, _err(lib)
+
+    sdata = ctypes.POINTER(ctypes.c_uint)()
+    sndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                    ctypes.byref(sndim)) == 0, _err(lib)
+    shape = tuple(sdata[i] for i in range(sndim.value))
+    assert shape == expect.shape, (shape, expect.shape)
+
+    out = np.zeros(expect.size, np.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0, _err(lib)
+    np.testing.assert_allclose(out.reshape(expect.shape), expect,
+                               rtol=1e-5, atol=1e-6)
+
+    # partial forward stepper parity
+    left = ctypes.c_int(-1)
+    assert lib.MXPredPartialForward(handle, 0, ctypes.byref(left)) == 0
+    assert left.value == 0
+    assert lib.MXPredFree(handle) == 0
+
+
+def test_predict_partial_out(tmp_path):
+    _build_lib()
+    prefix, x, _ = _export_model(tmp_path)
+    lib = _load()
+    handle = _create(lib, prefix, (2, 5), partial_out=["fc1"])
+    flat = np.ascontiguousarray(x.reshape(-1))
+    lib.MXPredSetInput(handle, b"data",
+                       flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       flat.size)
+    assert lib.MXPredForward(handle) == 0, _err(lib)
+    sdata = ctypes.POINTER(ctypes.c_uint)()
+    sndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                    ctypes.byref(sndim)) == 0, _err(lib)
+    assert tuple(sdata[i] for i in range(sndim.value)) == (2, 8)
+    lib.MXPredFree(handle)
+
+
+def test_ndlist(tmp_path):
+    _build_lib()
+    prefix, _, _ = _export_model(tmp_path)
+    lib = _load()
+    with open(prefix + "-0000.params", "rb") as f:
+        params = f.read()
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(params, len(params), ctypes.byref(handle),
+                            ctypes.byref(length))
+    assert rc == 0, _err(lib)
+    assert length.value >= 4  # fc1/fc2 weight+bias
+    names = set()
+    for i in range(length.value):
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shp = ctypes.POINTER(ctypes.c_uint)()
+        ndim = ctypes.c_uint()
+        assert lib.MXNDListGet(handle, i, ctypes.byref(key),
+                               ctypes.byref(data), ctypes.byref(shp),
+                               ctypes.byref(ndim)) == 0, _err(lib)
+        names.add(key.value.decode())
+        n = 1
+        for j in range(ndim.value):
+            n *= shp[j]
+        vals = np.ctypeslib.as_array(data, shape=(n,))
+        assert np.isfinite(vals).all()
+    assert "fc1_weight" in names and "fc2_bias" in names, names
+    lib.MXNDListFree(handle)
+
+
+C_MAIN = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "c_predict_api.h"
+
+static char *read_file(const char *path, int *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "open %s failed\n", path); exit(2); }
+  fseek(f, 0, SEEK_END); *size = (int)ftell(f); fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+  buf[*size] = 0; fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) return 2;
+  int json_size, param_size;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {2, 5};
+  PredictorHandle h;
+  if (MXPredCreate(json, params, param_size, 1, 0, 1, keys, indptr, shape,
+                   &h) != 0) {
+    fprintf(stderr, "create: %s\n", MXGetLastError());
+    return 1;
+  }
+  float x[10];
+  for (int i = 0; i < 10; ++i) x[i] = 0.1f * (float)i;
+  if (MXPredSetInput(h, "data", x, 10) != 0) return 1;
+  if (MXPredForward(h) != 0) { fprintf(stderr, "fwd: %s\n", MXGetLastError()); return 1; }
+  mx_uint *oshape, ondim;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) return 1;
+  mx_uint n = 1;
+  for (mx_uint i = 0; i < ondim; ++i) n *= oshape[i];
+  float *out = (float *)malloc(n * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, n) != 0) return 1;
+  float rowsum = 0;
+  for (mx_uint i = 0; i < oshape[1]; ++i) rowsum += out[i];
+  printf("C_PREDICT_OK ndim=%u n=%u rowsum=%.4f\n", ondim, n, rowsum);
+  MXPredFree(h);
+  return 0;
+}
+"""
+
+
+def test_pure_c_consumer(tmp_path):
+    """Compile a plain-C main against the ABI and run it standalone —
+    the amalgamation-style deployment check."""
+    _build_lib()
+    prefix, _, _ = _export_model(tmp_path)
+    csrc = tmp_path / "main.c"
+    csrc.write_text(C_MAIN)
+    exe = str(tmp_path / "cpred")
+    r = subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(ROOT, "src"),
+         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"), "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["MXNET_TPU_HOME"] = ROOT
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, site, env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "C_PREDICT_OK" in r.stdout
+    # softmax row sums to 1
+    rowsum = float(r.stdout.split("rowsum=")[1].split()[0])
+    assert abs(rowsum - 1.0) < 1e-3, r.stdout
