@@ -1,0 +1,130 @@
+"""SequentialModule + PythonModule/PythonLossModule
+(ref: python/mxnet/module/sequential_module.py, python_module.py and
+their use in tests/python/unittest/test_module.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _toy_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 10).astype(np.float32)
+    w = rng.randn(10, 3).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    return x, y
+
+
+def test_sequential_module_trains():
+    """FC trunk module + python loss head chained via SequentialModule
+    learns a linearly separable task."""
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    trunk = mx.mod.Module(fc, context=mx.cpu(), label_names=None)
+    loss = mx.mod.PythonLossModule()
+
+    seq = mx.mod.SequentialModule()
+    seq.add(trunk).add(loss, take_labels=True, auto_wiring=True)
+
+    x, y = _toy_data()
+    seq.bind(data_shapes=[("data", (40, 10))],
+             label_shapes=[("softmax_label", (40,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    for epoch in range(8):
+        for i in range(0, len(x), 40):
+            batch = mx.io.DataBatch(data=[nd.array(x[i:i + 40])],
+                                    label=[nd.array(y[i:i + 40])])
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+
+    seq.forward(mx.io.DataBatch(data=[nd.array(x)], label=None),
+                is_train=False)
+    pred = np.argmax(seq.get_outputs()[0].asnumpy(), axis=1)
+    acc = float((pred == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_sequential_matches_monolithic():
+    """Two chained FC modules == the same net in one Module, gradient
+    for gradient (the chain rule through get_input_grads)."""
+    np.random.seed(3)
+    x = np.random.randn(8, 6).astype(np.float32)
+    y = np.random.randint(0, 4, 8).astype(np.float32)
+    w1 = np.random.randn(5, 6).astype(np.float32) * 0.3
+    w2 = np.random.randn(4, 5).astype(np.float32) * 0.3
+
+    # monolithic
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=5, no_bias=True, name="l1")
+    net = mx.sym.Activation(data=net, act_type="tanh")
+    net = mx.sym.FullyConnected(data=net, num_hidden=4, no_bias=True, name="l2")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    mono = mx.mod.Module(net, context=mx.cpu())
+    mono.bind(data_shapes=[("data", (8, 6))],
+              label_shapes=[("softmax_label", (8,))])
+    mono.init_params()
+    mono.set_params({"l1_weight": nd.array(w1), "l2_weight": nd.array(w2)}, {})
+
+    # sequential: trunk + head
+    data = mx.sym.var("data")
+    t = mx.sym.Activation(
+        mx.sym.FullyConnected(data=data, num_hidden=5, no_bias=True, name="l1"),
+        act_type="tanh")
+    trunk = mx.mod.Module(t, context=mx.cpu(), label_names=None)
+    data = mx.sym.var("data")
+    h = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=data, num_hidden=4, no_bias=True, name="l2"),
+        name="softmax")
+    head = mx.mod.Module(h, context=mx.cpu())
+    seq = mx.mod.SequentialModule()
+    seq.add(trunk).add(head, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params()
+    trunk.set_params({"l1_weight": nd.array(w1)}, {}, allow_extra=True)
+    head.set_params({"l2_weight": nd.array(w2)}, {}, allow_extra=True)
+
+    batch = mx.io.DataBatch(data=[nd.array(x)], label=[nd.array(y)])
+    mono.forward(batch, is_train=True)
+    seq.forward(batch, is_train=True)
+    np.testing.assert_allclose(seq.get_outputs()[0].asnumpy(),
+                               mono.get_outputs()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    mono.backward()
+    seq.backward()
+    g_mono = {n: a[0].asnumpy() for n, a in zip(
+        mono._exec_group.param_names, mono._exec_group.grad_arrays)}
+    g_t = {n: a[0].asnumpy() for n, a in zip(
+        trunk._exec_group.param_names, trunk._exec_group.grad_arrays)}
+    g_h = {n: a[0].asnumpy() for n, a in zip(
+        head._exec_group.param_names, head._exec_group.grad_arrays)}
+    np.testing.assert_allclose(g_t["l1_weight"], g_mono["l1_weight"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(g_h["l2_weight"], g_mono["l2_weight"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_python_module_shapes():
+    class Doubler(mx.mod.PythonModule):
+        def __init__(self):
+            super().__init__(["data"], [], ["double_output"])
+
+        def _compute_output_shapes(self):
+            return [("double_output", self._data_shapes[0].shape)]
+
+        def forward(self, data_batch, is_train=None):
+            self._out = [d * 2 for d in data_batch.data]
+
+        def get_outputs(self, merge_multi_context=True):
+            return self._out
+
+    m = Doubler()
+    m.bind(data_shapes=[("data", (2, 3))])
+    m.init_params()
+    assert m.output_shapes == [("double_output", (2, 3))]
+    m.forward(mx.io.DataBatch(data=[nd.ones((2, 3))], label=None))
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), 2.0)
