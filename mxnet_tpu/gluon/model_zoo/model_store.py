@@ -1,0 +1,129 @@
+"""Pretrained model file store (ref:
+python/mxnet/gluon/model_zoo/model_store.py — get_model_file/purge with
+a sha1-named local cache under ~/.mxnet/models).
+
+Zero-egress design: the cache and integrity-check logic is full parity;
+fetching honors ``MXNET_GLUON_REPO`` when it points at a local directory
+or ``file://`` tree (the common air-gapped TPU-pod setup), and raises a
+clear error instead of attempting network I/O otherwise.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import zipfile
+
+from ...base import MXNetError
+
+# name -> sha1 of the released .params — DATA parity with the reference
+# table (model_store.py:31): these identify the official artifacts, so
+# the values must be the published checksums verbatim.
+_model_sha1 = {name: checksum for checksum, name in [
+    ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+    ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+    ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+    ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+    ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+    ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+    ("d2b128fa89477c2e20061607a53a8d9f66ce239d", "resnet101_v1"),
+    ("6562166cd597a6328a32a0ce47bb651df80b3bbb", "resnet152_v1"),
+    ("38d6d423c22828718ec3397924b8e116a03e6ac0", "resnet18_v1"),
+    ("4dc2c2390a7c7990e0ca1e53aeebb1d1a08592d1", "resnet34_v1"),
+    ("2a903ab21260c85673a78fe65037819a843a1f43", "resnet50_v1"),
+    ("8aacf80ff4014c1efa2362a963ac5ec82cf92d5b", "resnet18_v2"),
+    ("0ed3cd06da41932c03dea1de7bc2506ef3fb97b3", "resnet34_v2"),
+    ("eb7a368774aa34a12ed155126b641ae7556dad9d", "resnet50_v2"),
+    ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+    ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+    ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+    ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+    ("6bc5de58a05a5e2e7f493e2d75a580d83efde38c", "vgg13"),
+    ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+    ("649467530119c0f78c4859999e264e7bf14471a9", "vgg16"),
+    ("6b9dbe6194e5bfed30fd7a7c9a71f7e5a276cb14", "vgg16_bn"),
+    ("f713436691eee9a20d70a145ce0d53ed24bf7399", "vgg19"),
+    ("9730961c9cea43fd7eeefb00d792e386c45847d6", "vgg19_bn"),
+]}
+
+apache_repo_url = "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+_url_format = "{repo_url}gluon/models/{file_name}.zip"
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError("Pretrained model for %s is not available." % name)
+    return _model_sha1[name][:8]
+
+
+def check_sha1(filename, sha1_hash):
+    """True when the file's sha1 matches (ref model_store.py check)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def get_model_file(name, root="~/.mxnet/models/"):
+    r"""Return the path of a pretrained .params file, fetching into the
+    cache when a local repo is configured.
+
+    File name: ``{name}-{short_hash}.params`` under ``root`` —
+    byte-parity with the reference cache layout, so a cache populated by
+    the original framework is picked up as-is."""
+    file_name = "{name}-{short_hash}".format(name=name,
+                                             short_hash=short_hash(name))
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, file_name + ".params")
+    sha1_hash = _model_sha1[name]
+    if os.path.exists(file_path):
+        if check_sha1(file_path, sha1_hash):
+            return file_path
+        print("Mismatch in the content of model file detected. Downloading again.")
+    else:
+        print("Model file is not found. Downloading.")
+
+    os.makedirs(root, exist_ok=True)
+
+    repo_url = os.environ.get("MXNET_GLUON_REPO", apache_repo_url)
+    zip_file_path = os.path.join(root, file_name + ".zip")
+    if repo_url.startswith("file://"):
+        repo_url = repo_url[len("file://"):]
+    if os.path.isdir(repo_url):
+        # air-gapped repo: a directory holding {file_name}.zip or .params
+        src_params = os.path.join(repo_url, file_name + ".params")
+        src_zip = os.path.join(repo_url, file_name + ".zip")
+        if os.path.exists(src_params):
+            shutil.copyfile(src_params, file_path)
+        elif os.path.exists(src_zip):
+            shutil.copyfile(src_zip, zip_file_path)
+            with zipfile.ZipFile(zip_file_path) as zf:
+                zf.extractall(root)
+            os.remove(zip_file_path)
+        else:
+            raise MXNetError(
+                "pretrained %r not found in local repo %s" % (name, repo_url))
+    else:
+        raise MXNetError(
+            "no network egress in this environment: place %s.params under "
+            "%s (the reference cache layout), or set MXNET_GLUON_REPO to a "
+            "local directory / file:// tree holding the released artifacts"
+            % (file_name, root))
+
+    if check_sha1(file_path, sha1_hash):
+        return file_path
+    raise MXNetError("Downloaded file has different hash. Please try again.")
+
+
+def purge(root="~/.mxnet/models/"):
+    """Remove every cached .params (ref model_store.py:111)."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

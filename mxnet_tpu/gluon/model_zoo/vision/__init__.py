@@ -9,6 +9,7 @@ from .resnet import get_resnet
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn
 from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201
+from .inception import Inception3, inception_v3
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
@@ -23,6 +24,7 @@ _models = {
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

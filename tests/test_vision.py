@@ -135,3 +135,67 @@ def test_sparse_retain_dense_fallback():
     expect = data.copy()
     expect[[1, 3]] = 0
     np.testing.assert_allclose(out, expect)
+
+
+def test_inception_v3_forward_and_hybrid():
+    """Inception3 (ref: gluon/model_zoo/vision/inception.py:155) — eager
+    and hybridized agree; output head is (N, classes)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("inceptionv3", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(1, 3, 299, 299).astype("float32"))
+    y = net(x)
+    assert y.shape == (1, 7)
+    net.hybridize()
+    y2 = net(x)
+    np.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), atol=1e-4)
+
+
+def test_model_store_cache_layout(tmp_path):
+    """get_model_file finds a correctly-hashed cached file and honors an
+    air-gapped MXNET_GLUON_REPO directory (ref: model_store.py:61)."""
+    import hashlib
+    import os
+
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    # forge a tiny artifact whose sha1 we register temporarily
+    payload = b"not-really-params"
+    sha = hashlib.sha1(payload).hexdigest()
+    old = model_store._model_sha1.get("inceptionv3")
+    model_store._model_sha1["inceptionv3"] = sha
+    try:
+        name = "inceptionv3-%s.params" % sha[:8]
+        # 1) cache hit
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / name).write_bytes(payload)
+        got = model_store.get_model_file("inceptionv3", root=str(cache))
+        assert got == str(cache / name)
+        # 2) air-gapped repo fetch into empty cache
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        (repo / name).write_bytes(payload)
+        os.environ["MXNET_GLUON_REPO"] = str(repo)
+        try:
+            cache2 = tmp_path / "cache2"
+            got = model_store.get_model_file("inceptionv3", root=str(cache2))
+            assert os.path.exists(got)
+        finally:
+            del os.environ["MXNET_GLUON_REPO"]
+        # 3) offline with no artifact: clear error, no hang
+        with pytest.raises(mx.MXNetError):
+            model_store.get_model_file("inceptionv3",
+                                       root=str(tmp_path / "cache3"))
+        model_store.purge(str(cache))
+        assert not list(cache.glob("*.params"))
+    finally:
+        model_store._model_sha1["inceptionv3"] = old
