@@ -397,7 +397,18 @@ class TrainStep:
             return loss, (outs, aux_updates)
 
         if self.remat:
-            loss_of = jax.checkpoint(loss_of, static_argnums=())
+            # remat=True: full recompute (the reference's
+            # MXNET_BACKWARD_DO_MIRROR). remat="conv": save only conv/dot
+            # outputs and recompute the cheap elementwise tail (BN apply,
+            # ReLU, pad) inside backward — on a bandwidth-bound graph this
+            # trades spare MXU FLOPs for HBM traffic (see PROFILE.md).
+            if self.remat == "conv":
+                def _policy(prim, *_, **__):
+                    return prim.name in ("conv_general_dilated", "dot_general")
+
+                loss_of = jax.checkpoint(loss_of, policy=_policy)
+            else:
+                loss_of = jax.checkpoint(loss_of, static_argnums=())
 
         normalize = self.normalize_grads
 
