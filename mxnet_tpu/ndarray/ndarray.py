@@ -407,6 +407,11 @@ class NDArray:
         return invoke("trunc", [self], {})
 
     def dot(self, other, transpose_a=False, transpose_b=False):
+        from . import sparse as _sp
+
+        if isinstance(self, _sp.CSRNDArray) and not transpose_b:
+            # sparse segment-sum kernel, not the dense fallback
+            return _sp.dot(self, other, transpose_a=transpose_a)
         return invoke("dot", [self, other], {"transpose_a": transpose_a, "transpose_b": transpose_b})
 
     def tostype(self, stype):

@@ -66,6 +66,9 @@ class RowSparseNDArray(BaseSparseNDArray):
         vals = self._aux["values"]._jax
         idx = self._aux["indices"]._jax.astype(jnp.int32)
         dense = jnp.zeros(self._full_shape, dtype=vals.dtype)
+        # canonical invariant: indices are unique (aggregation sums
+        # duplicates at creation, see add()), so set == add here — and
+        # row_sparse_pull results with repeated row_ids stay correct
         dense = dense.at[idx].set(vals)
         return NDArray(dense, ctx=self._ctx)
 
@@ -199,6 +202,197 @@ def cast_storage(arr, stype):
             dense.shape, ctx=arr.ctx,
         )
     raise MXNetError("unknown stype %r" % stype)
+
+
+# ---------------------------------------------------------------------------
+# sparse compute kernels (ref: src/operator/tensor/dot-inl.h sparse dot,
+# optimizer_op.cc sparse update variants). TPU-native shape: the CSR
+# structure is lowered to a gather + segment-sum, which XLA tiles onto
+# the MXU/VPU with static (nnz,) shapes — no dense materialization.
+# ---------------------------------------------------------------------------
+def _csr_row_ids(indptr, nnz):
+    """Row id per stored element: repeat(arange(R), diff(indptr))."""
+    import jax.numpy as jnp
+
+    counts = indptr[1:] - indptr[:-1]
+    return jnp.repeat(jnp.arange(counts.shape[0], dtype=jnp.int32),
+                      counts.astype(jnp.int32), total_repeat_length=nnz)
+
+
+def _jit(fn, **kw):
+    """Deferred module-level jit (jax imported lazily, one compile cache
+    per kernel instead of per call)."""
+    import functools
+
+    holder = {}
+
+    @functools.wraps(fn)
+    def call(*args):
+        if "j" not in holder:
+            import jax
+
+            holder["j"] = jax.jit(fn, **kw)
+        return holder["j"](*args)
+
+    return call
+
+
+def _csr_dot_impl(vals, cols, ptr, dense, n_seg, transpose):
+    import jax
+
+    row_ids = _csr_row_ids(ptr, vals.shape[0])
+    if transpose:
+        # out[c] = sum over stored (r, c): val * dense[r]
+        contrib = vals[:, None] * dense[row_ids]
+        return jax.ops.segment_sum(contrib, cols, num_segments=n_seg)
+    contrib = vals[:, None] * dense[cols]                # (nnz, N)
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=n_seg)
+
+
+_csr_dot_kernel = _jit(_csr_dot_impl, static_argnums=(4, 5))
+
+
+def _clip(g, clip):
+    import jax.numpy as jnp
+
+    # clip < 0 means "no clipping"; branchless so clip can stay traced
+    return jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+
+
+def _rsp_sgd_impl(w, vals, idx, lr, wd, rescale, clip):
+    g = _clip(vals * rescale, clip) + wd * w[idx]
+    return w.at[idx].add(-lr * g)
+
+
+def _rsp_sgd_mom_impl(w, mom, vals, idx, lr, wd, rescale, clip, momentum):
+    g = _clip(vals * rescale, clip) + wd * w[idx]
+    m_rows = momentum * mom[idx] - lr * g
+    return w.at[idx].add(m_rows), mom.at[idx].set(m_rows)
+
+
+def _rsp_adam_impl(w, m, v, vals, idx, lr_t, beta1, beta2, eps, wd,
+                   rescale, clip):
+    import jax.numpy as jnp
+
+    g = _clip(vals * rescale, clip) + wd * w[idx]
+    m_rows = beta1 * m[idx] + (1 - beta1) * g
+    v_rows = beta2 * v[idx] + (1 - beta2) * g * g
+    upd = lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+    return w.at[idx].add(-upd), m.at[idx].set(m_rows), v.at[idx].set(v_rows)
+
+
+_rsp_sgd_kernel = _jit(_rsp_sgd_impl)
+_rsp_sgd_mom_kernel = _jit(_rsp_sgd_mom_impl)
+_rsp_adam_kernel = _jit(_rsp_adam_impl)
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """Sparse-aware dot (ref: dot-inl.h dot(csr, dense) forward and the
+    dot(csr.T, dense) path used by sparse embeddings/linear models)."""
+    import jax.numpy as jnp
+
+    if not isinstance(lhs, CSRNDArray):
+        from .ndarray import invoke
+
+        if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+            lhs = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+            rhs = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+        return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a})
+
+    dense = rhs._data() if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    vec = dense.ndim == 1
+    if vec:
+        dense = dense[:, None]
+    vals = lhs.data._data()
+    cols = lhs.indices._data().astype(jnp.int32)
+    ptr = lhs.indptr._data()
+    rows, n_cols = lhs.shape
+    out = _csr_dot_kernel(vals, cols, ptr, dense,
+                          n_cols if transpose_a else rows, bool(transpose_a))
+    if vec:
+        out = out[:, 0]
+    return NDArray(out, ctx=lhs.ctx)
+
+
+def _canonicalize(vals, idx):
+    """(values, indices) with unique sorted indices: duplicates summed.
+
+    Index bookkeeping is host-side numpy (indices are tiny and the
+    kvstore reduce path is host-mediated anyway); the value segment-sum
+    runs on device with a static segment count."""
+    import jax
+    import jax.numpy as jnp
+
+    idx_np = np.asarray(idx)
+    uniq, inverse = np.unique(idx_np, return_inverse=True)
+    if uniq.shape[0] == idx_np.shape[0]:
+        order = np.argsort(idx_np)
+        if (idx_np == uniq).all():
+            return vals, idx
+        return jnp.asarray(np.asarray(vals)[order]), jnp.asarray(uniq)
+    summed = jax.ops.segment_sum(jnp.asarray(vals),
+                                 jnp.asarray(inverse.astype(np.int32)),
+                                 num_segments=int(uniq.shape[0]))
+    return summed, jnp.asarray(uniq)
+
+
+def add(lhs, rhs):
+    """Sparse-preserving add of two RowSparseNDArrays: the kvstore
+    gradient-aggregation primitive (ref: comm.h ReduceRowSparse).
+    Overlapping rows are summed and the result is canonical (unique
+    sorted indices) — the invariant every consumer relies on."""
+    import jax.numpy as jnp
+
+    if not (isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray)):
+        raise MXNetError("sparse.add expects two RowSparseNDArrays")
+    if lhs.shape != rhs.shape:
+        raise MXNetError("shape mismatch %s vs %s" % (lhs.shape, rhs.shape))
+    vals = jnp.concatenate([lhs.data._data(), rhs.data._data()], axis=0)
+    idx = jnp.concatenate([lhs.indices._data(), rhs.indices._data()], axis=0)
+    vals, idx = _canonicalize(vals, idx)
+    return RowSparseNDArray(NDArray(vals, ctx=lhs.ctx), NDArray(idx, ctx=lhs.ctx),
+                            lhs.shape, ctx=lhs.ctx)
+
+
+def sgd_update_rsp(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None, state=None, momentum=0.0):
+    """Lazy row-sparse SGD(+momentum): only rows present in ``grad`` are
+    touched (ref: optimizer_op.cc sparse sgd_update/sgd_mom_update —
+    'lazy update' semantics, momentum decayed only on updated rows)."""
+    vals, idx = _canonicalize(grad.data._data(), grad.indices._data())
+    idx = idx.astype("int32")
+    clip = -1.0 if clip_gradient is None else float(clip_gradient)
+    if state is None:
+        new_w = _rsp_sgd_kernel(weight._data(), vals, idx,
+                                lr, wd, rescale_grad, clip)
+        weight._rebind(new_w)
+    else:
+        new_w, new_mom = _rsp_sgd_mom_kernel(
+            weight._data(), state._data(), vals, idx,
+            lr, wd, rescale_grad, clip, momentum)
+        weight._rebind(new_w)
+        state._rebind(new_mom)
+    return weight
+
+
+def adam_update_rsp(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                    epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=None, t=1):
+    """Lazy row-sparse Adam: moments and weight updated only on rows
+    present in ``grad`` (ref: optimizer_op.cc adam_update FComputeEx)."""
+    vals, idx = _canonicalize(grad.data._data(), grad.indices._data())
+    idx = idx.astype("int32")
+    clip = -1.0 if clip_gradient is None else float(clip_gradient)
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * (coef2 ** 0.5) / coef1
+    new_w, new_m, new_v = _rsp_adam_kernel(
+        weight._data(), mean._data(), var._data(), vals, idx,
+        lr_t, beta1, beta2, epsilon, wd, rescale_grad, clip)
+    weight._rebind(new_w)
+    mean._rebind(new_m)
+    var._rebind(new_v)
+    return weight
 
 
 def zeros(stype, shape, ctx=None, dtype=None):

@@ -142,9 +142,18 @@ class SGD(Optimizer):
         return nd.zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray, sgd_update_rsp
+
         self._update_count(index)
         kw = self._common_kwargs(index)
-        if state is not None:
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy row-sparse update: only rows present in grad change
+            # (ref: optimizer_op.cc sparse sgd_update FComputeEx)
+            sgd_update_rsp(weight, grad, kw["lr"], wd=kw["wd"],
+                           rescale_grad=kw["rescale_grad"],
+                           clip_gradient=kw.get("clip_gradient"),
+                           state=state, momentum=self.momentum)
+        elif state is not None:
             invoke("sgd_mom_update", [weight, grad, state], dict(kw, momentum=self.momentum), out=weight)
         else:
             invoke("sgd_update", [weight, grad], kw, out=weight)
@@ -262,6 +271,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (
@@ -270,13 +280,23 @@ class Adam(Optimizer):
         )
 
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray, adam_update_rsp
+
         self._update_count(index)
         t = self._index_update_count[index]
         kw = self._common_kwargs(index)
+        mean, var = state
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy row-sparse Adam (ref: optimizer_op.cc adam FComputeEx)
+            adam_update_rsp(weight, grad, mean, var, kw["lr"],
+                            beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon, wd=kw["wd"],
+                            rescale_grad=kw["rescale_grad"],
+                            clip_gradient=kw.get("clip_gradient"), t=t)
+            return
         coef1 = 1.0 - self.beta1**t
         coef2 = 1.0 - self.beta2**t
         kw["lr"] *= numpy.sqrt(coef2) / coef1
-        mean, var = state
         invoke(
             "adam_update",
             [weight, grad, mean, var],

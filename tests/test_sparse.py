@@ -93,3 +93,155 @@ def test_sgd_lazy_update_semantics():
     np.testing.assert_allclose(w.asnumpy(), expect, atol=1e-6)
     nd.sgd_update(w2, g, lr=0.1, wd=0.1)
     assert not np.allclose(w2.asnumpy()[0], 1.0)  # wd applied everywhere
+
+
+def test_csr_dot_segment_sum_kernel():
+    """nd.dot(csr, dense) runs the sparse segment-sum kernel (no dense
+    materialization) and matches numpy (ref: dot-inl.h sparse dot)."""
+    rng = np.random.RandomState(0)
+    dense_l = rng.rand(5, 7).astype(np.float32)
+    dense_l[dense_l < 0.6] = 0
+    rhs = rng.rand(7, 3).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense_l)
+    out = nd.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense_l @ rhs,
+                               rtol=1e-5, atol=1e-6)
+    # transpose_a: dot(csr.T, dense)
+    rhs2 = rng.rand(5, 3).astype(np.float32)
+    out_t = nd.dot(csr, nd.array(rhs2), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), dense_l.T @ rhs2,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_row_sparse_aggregate_preserves_sparsity():
+    """kvstore reduce of row-sparse grads concat-aggregates without
+    densifying; duplicate indices sum on densify (comm.h ReduceRowSparse)."""
+    from mxnet_tpu.ndarray import sparse as S
+
+    a = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 2])), shape=(5, 3))
+    b = mx.nd.sparse.row_sparse_array(
+        (2 * np.ones((2, 3), np.float32), np.array([2, 4])), shape=(5, 3))
+    tot = S.add(a, b)
+    assert isinstance(tot, S.RowSparseNDArray)
+    dense = tot.asnumpy()
+    expect = np.zeros((5, 3), np.float32)
+    expect[0] = 1; expect[2] = 3; expect[4] = 2
+    np.testing.assert_allclose(dense, expect)
+
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((5, 3)))
+    kv.push("w", [a, b])
+    out = nd.zeros((5, 3))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_sparse_sgd_update_lazy_rows():
+    """Row-sparse SGD touches only the rows present in grad — including
+    wd decay (ref: sparse sgd 'lazy update', optimizer_op.cc)."""
+    from mxnet_tpu import optimizer as opt
+
+    w = nd.array(np.ones((5, 2), np.float32))
+    g = mx.nd.sparse.row_sparse_array(
+        (np.full((2, 2), 0.5, np.float32), np.array([1, 3])), shape=(5, 2))
+    sgd = opt.SGD(learning_rate=0.1, wd=0.01, rescale_grad=1.0)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)
+    got = w.asnumpy()
+    np.testing.assert_allclose(got[0], 1.0)   # untouched row
+    np.testing.assert_allclose(got[2], 1.0)
+    expect_row = 1.0 - 0.1 * (0.5 + 0.01 * 1.0)
+    np.testing.assert_allclose(got[1], expect_row, rtol=1e-5)
+    np.testing.assert_allclose(got[3], expect_row, rtol=1e-5)
+
+    # momentum state: only updated rows decayed
+    w2 = nd.array(np.ones((5, 2), np.float32))
+    sgd_m = opt.SGD(learning_rate=0.1, momentum=0.9)
+    st = sgd_m.create_state(0, w2)
+    sgd_m.update(0, w2, g, st)
+    sgd_m.update(0, w2, g, st)
+    got2 = w2.asnumpy()
+    np.testing.assert_allclose(got2[0], 1.0)
+    # two momentum steps: -lr*g, then 0.9*(-lr*g) - lr*g
+    step1 = -0.1 * 0.5
+    step2 = 0.9 * step1 - 0.1 * 0.5
+    np.testing.assert_allclose(got2[1], 1.0 + step1 + step2, rtol=1e-5)
+
+
+def test_sparse_adam_update_lazy_rows():
+    from mxnet_tpu import optimizer as opt
+
+    w = nd.array(np.ones((4, 2), np.float32))
+    g = mx.nd.sparse.row_sparse_array(
+        (np.full((1, 2), 0.3, np.float32), np.array([2])), shape=(4, 2))
+    adam = opt.Adam(learning_rate=0.01)
+    state = adam.create_state(0, w)
+    adam.update(0, w, g, state)
+    got = w.asnumpy()
+    np.testing.assert_allclose(got[0], 1.0)
+    np.testing.assert_allclose(got[1], 1.0)
+    assert not np.allclose(got[2], 1.0)
+    # dense-reference math for the touched row at t=1
+    m = 0.1 * 0.3
+    v = 0.001 * 0.3 * 0.3
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expect = 1.0 - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(got[2], expect, rtol=1e-4)
+
+
+def test_row_sparse_canonical_duplicates():
+    """add() canonicalizes overlapping/duplicate rows; lazy optimizer
+    updates on aggregated grads match dense-reference math (review
+    repro: wd was applied per duplicate, momentum rows lost via .set)."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray import sparse as S
+
+    a = mx.nd.sparse.row_sparse_array(
+        (np.full((1, 2), 0.25, np.float32), np.array([1])), shape=(4, 2))
+    b = mx.nd.sparse.row_sparse_array(
+        (np.full((1, 2), 0.25, np.float32), np.array([1])), shape=(4, 2))
+    g = S.add(a, b)
+    assert list(np.asarray(g.indices.asnumpy(), np.int64)) == [1]
+    np.testing.assert_allclose(g.data.asnumpy(), 0.5)
+
+    # dense-reference: w -= lr * (g + wd*w)
+    w = nd.array(np.ones((4, 2), np.float32))
+    sgd = opt.SGD(learning_rate=0.1, wd=0.5)
+    sgd.update(0, w, g, sgd.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy()[1], 1 - 0.1 * (0.5 + 0.5),
+                               rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy()[0], 1.0)
+
+    # momentum state accumulates the full duplicate sum
+    w2 = nd.array(np.ones((4, 2), np.float32))
+    sgd_m = opt.SGD(learning_rate=0.1, momentum=0.9)
+    st = sgd_m.create_state(0, w2)
+    sgd_m.update(0, w2, g, st)
+    np.testing.assert_allclose(st.asnumpy()[1], -0.1 * 0.5, rtol=1e-6)
+
+
+def test_row_sparse_pull_duplicate_row_ids():
+    """row_sparse_pull with repeated row_ids must not double rows on
+    densify (review repro)."""
+    kv = mx.kv.create("local")
+    w = np.arange(6, dtype=np.float32).reshape(3, 2)
+    kv.init("w", nd.array(w))
+    out = mx.nd.sparse.zeros("row_sparse", (3, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array(np.array([2, 2], np.float32)))
+    np.testing.assert_allclose(out.asnumpy()[2], w[2])
+
+
+def test_csr_dot_vector_rhs():
+    """nd.dot(csr, 1-D vector) is the matrix-vector product (review
+    repro: broadcasting produced (rows, nnz))."""
+    dense_l = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense_l)
+    v = nd.array(np.array([1, 2, 3], np.float32))
+    out = nd.dot(csr, v)
+    np.testing.assert_allclose(out.asnumpy(), dense_l @ [1, 2, 3])
+    # method form takes the same sparse kernel
+    out2 = csr.dot(v)
+    np.testing.assert_allclose(out2.asnumpy(), dense_l @ [1, 2, 3])
+    out3 = csr.dot(nd.array(np.array([1., 2.], np.float32)), transpose_a=True)
+    np.testing.assert_allclose(out3.asnumpy(), dense_l.T @ [1, 2])
