@@ -1,0 +1,60 @@
+"""Multi-process data-parallel training with kvstore='dist_sync'.
+
+Counterpart of the reference's nightly dist_lenet.py. Launch with:
+
+    python tools/launch.py -n 2 python examples/distributed/dist_sync.py
+
+Each worker joins one jax.distributed job; gradient sync is a single
+batched XLA collective over the DCN mesh axis per step (the serverless
+replacement for the reference's parameter-server push/pull).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import nd
+
+
+def synth(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.randn(n, 64).astype(np.float32)
+    x[np.arange(n), y] += 3.0
+    return x, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    kv = mx.kv.create("dist_sync")
+    print("worker %d/%d up; dead nodes: %d"
+          % (kv.rank, kv.num_workers, kv.num_dead_node()))
+
+    data = mx.sym.var("data")
+    net = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=64, name="fc1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=10, name="fc2"), name="softmax")
+
+    # each worker trains on its own shard
+    x, y = synth(4000, seed=kv.rank)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            kvstore=kv, num_epoch=args.num_epochs)
+
+    score = dict(mod.score(mx.io.NDArrayIter(x, y, args.batch_size,
+                                             label_name="softmax_label"),
+                           mx.metric.Accuracy()))
+    print("worker %d final accuracy %.4f" % (kv.rank, score["accuracy"]))
+    kv.barrier()
+
+
+if __name__ == "__main__":
+    main()

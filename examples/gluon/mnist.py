@@ -1,0 +1,73 @@
+"""Gluon training loop: HybridBlock + autograd + Trainer.
+
+Counterpart of the reference's example/gluon/mnist.py. hybridize()
+compiles the whole net into one cached XLA program (CachedOp parity).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import autograd, gluon, nd
+
+
+def synth(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.randint(0, 50, (n, 1, 28, 28))
+    for i, l in enumerate(y):
+        r, c = divmod(int(l), 5)
+        x[i, 0, 3 + r * 12:13 + r * 12, 2 + c * 5:7 + c * 5] = 255
+    return (x / 255.0).astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--hybridize", action="store_true", default=True)
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args()
+
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Conv2D(32, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    xt, yt = synth(4000, 0)
+    for epoch in range(args.epochs):
+        metric.reset()
+        perm = np.random.RandomState(epoch).permutation(len(xt))
+        for i in range(0, len(xt), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            x, y = nd.array(xt[idx]), nd.array(yt[idx])
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(len(idx))
+            metric.update([y], [out])
+        print("epoch %d: train %s=%.4f" % ((epoch,) + metric.get()))
+
+    xv, yv = synth(800, 1)
+    pred = np.argmax(net(nd.array(xv)).asnumpy(), axis=1)
+    print("validation accuracy: %.4f" % (pred == yv).mean())
+
+
+if __name__ == "__main__":
+    main()

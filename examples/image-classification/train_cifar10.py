@@ -1,0 +1,61 @@
+"""Train ResNet on CIFAR-10-shaped data with the fused data-parallel step.
+
+Counterpart of the reference's train_cifar10.py; kvstore='tpu' routes
+Module.fit through the fused SPMD TrainStep (fwd+bwd+update in one XLA
+program, batch sharded over the device mesh, psum over ICI).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet_tpu.models import resnet
+
+
+def synth_cifar(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.randint(0, 64, (n, 3, 32, 32)).astype(np.float32)
+    for i, l in enumerate(y):
+        c = int(l)
+        x[i, c % 3, 4 * (c // 3):4 * (c // 3) + 8, :] += 160
+    return x / 255.0, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-layers", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--kv-store", default="tpu")
+    p.add_argument("--num-examples", type=int, default=4096)
+    args = p.parse_args()
+
+    sym = resnet.get_symbol(num_classes=10, num_layers=args.num_layers,
+                            image_shape=(3, 32, 32))
+    xt, yt = synth_cifar(args.num_examples, 0)
+    xv, yv = synth_cifar(args.num_examples // 8, 1)
+    train = mx.io.NDArrayIter(xt, yt, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size,
+                            label_name="softmax_label")
+
+    import jax
+
+    ctxs = [mx.tpu(i) for i in range(len(jax.devices()))]
+    mod = mx.mod.Module(sym, context=ctxs)
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2),
+            kvstore=args.kv_store,
+            batch_end_callback=[mx.callback.Speedometer(args.batch_size, 10)],
+            num_epoch=args.num_epochs)
+    score = dict(mod.score(val, mx.metric.Accuracy()))
+    print("final validation accuracy: %.4f" % score["accuracy"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Train an MLP or LeNet on MNIST through Module.fit.
+
+Counterpart of the reference's example/image-classification/
+train_mnist.py. Reads idx-format MNIST from ./data when present;
+otherwise synthesizes a learnable 10-class stand-in so the script
+always runs end to end (IO -> Module.fit -> checkpoint).
+"""
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+import mxnet as mx
+
+
+def load_or_synth_mnist(data_dir, n_train=6000, n_val=1000):
+    def read_idx(img_path, lbl_path):
+        with gzip.open(lbl_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8)[:n]
+        with gzip.open(img_path, "rb") as f:
+            magic, n, r, c = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, 1, r, c)
+        return images / 255.0, labels.astype(np.float32)
+
+    paths = [os.path.join(data_dir, p) for p in (
+        "train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+        "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")]
+    if all(os.path.exists(p) for p in paths):
+        tr = read_idx(paths[0], paths[1])
+        va = read_idx(paths[2], paths[3])
+        return tr, va
+
+    def synth(n, seed):
+        rng = np.random.RandomState(seed)
+        y = rng.randint(0, 10, n)
+        x = rng.randint(0, 50, (n, 1, 28, 28))
+        for i, l in enumerate(y):
+            r, c = divmod(int(l), 5)
+            x[i, 0, 3 + r * 12:13 + r * 12, 2 + c * 5:7 + c * 5] = 255
+        return x / 255.0, y.astype(np.float32)
+
+    print("MNIST not found under %s — using synthetic stand-in" % data_dir)
+    return synth(n_train, 0), synth(n_val, 1)
+
+
+def get_symbol(network):
+    data = mx.sym.var("data")
+    if network == "mlp":
+        net = mx.sym.Flatten(data=data)
+        net = mx.sym.Activation(mx.sym.FullyConnected(net, num_hidden=128, name="fc1"), act_type="relu")
+        net = mx.sym.Activation(mx.sym.FullyConnected(net, num_hidden=64, name="fc2"), act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    else:  # lenet
+        net = mx.sym.Convolution(data=data, kernel=(5, 5), num_filter=20, name="c1")
+        net = mx.sym.Pooling(mx.sym.Activation(net, act_type="tanh"), pool_type="max", kernel=(2, 2), stride=(2, 2))
+        net = mx.sym.Convolution(data=net, kernel=(5, 5), num_filter=50, name="c2")
+        net = mx.sym.Pooling(mx.sym.Activation(net, act_type="tanh"), pool_type="max", kernel=(2, 2), stride=(2, 2))
+        net = mx.sym.Activation(mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=500, name="f1"), act_type="tanh")
+        net = mx.sym.FullyConnected(net, num_hidden=10, name="f2")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--model-prefix", default=None)
+    p.add_argument("--num-examples", type=int, default=6000)
+    args = p.parse_args()
+
+    (xt, yt), (xv, yv) = load_or_synth_mnist(args.data_dir, args.num_examples)
+    train = mx.io.NDArrayIter(xt.astype(np.float32), yt, args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv.astype(np.float32), yv, args.batch_size,
+                            label_name="softmax_label")
+
+    mod = mx.mod.Module(get_symbol(args.network), context=mx.tpu(0))
+    cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs,
+            num_epoch=args.num_epochs)
+    score = dict(mod.score(val, mx.metric.Accuracy()))
+    print("final validation accuracy: %.4f" % score["accuracy"])
+
+
+if __name__ == "__main__":
+    main()

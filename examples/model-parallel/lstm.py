@@ -1,0 +1,89 @@
+"""Model-parallel LSTM: layers placed on different devices via group2ctx.
+
+Counterpart of the reference's example/model-parallel/lstm/lstm.py. Each
+layer group is stamped with a ctx_group through AttrScope; bind's
+group2ctx pins the groups to devices and XLA inserts the cross-device
+transfers (the reference's PlaceDevice + _CrossDeviceCopy,
+graph_executor.cc:411).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import nd
+
+
+def stacked_lstm_sym(seq_len, vocab, num_hidden, groups):
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    with mx.AttrScope(ctx_group=groups[0]):
+        h = mx.sym.Embedding(data=data, input_dim=vocab, output_dim=num_hidden,
+                             name="embed")
+        h = mx.sym.RNN(data=mx.sym.swapaxes(h, dim1=0, dim2=1),
+                       state_size=num_hidden, num_layers=1, mode="lstm",
+                       name="lstm0")
+    with mx.AttrScope(ctx_group=groups[1]):
+        h = mx.sym.RNN(data=h, state_size=num_hidden, num_layers=1,
+                       mode="lstm", name="lstm1")
+        h = mx.sym.Reshape(mx.sym.swapaxes(h, dim1=0, dim2=1),
+                           shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=h, num_hidden=vocab, name="pred")
+    return mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)),
+                                name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq-len", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=40)
+    p.add_argument("--num-steps", type=int, default=60)
+    args = p.parse_args()
+
+    import jax
+
+    n_dev = len(jax.devices())
+    group2ctx = {"layer0": mx.tpu(0), "layer1": mx.tpu(1 % n_dev)}
+    sym = stacked_lstm_sym(args.seq_len, args.vocab, args.num_hidden,
+                           ["layer0", "layer1"])
+
+    shapes = {"data": (args.batch_size, args.seq_len),
+              "softmax_label": (args.batch_size, args.seq_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    args_map, grads = {}, {}
+    init = mx.init.Xavier()
+    attrs = sym.attr_dict()   # carries the fused-RNN __init__ config
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        arr = nd.zeros(shape)
+        if name not in shapes:
+            init(mx.init.InitDesc(name, attrs.get(name)), arr)
+        args_map[name] = arr
+        grads[name] = nd.zeros(shape)
+    exe = sym.bind(ctx=mx.tpu(0), args=args_map, args_grad=grads,
+                   group2ctx=group2ctx)
+
+    tok = rng.randint(1, args.vocab, (args.batch_size, args.seq_len + 1))
+    args_map["data"][:] = nd.array(tok[:, :-1].astype(np.float32))
+    args_map["softmax_label"][:] = nd.array(tok[:, 1:].astype(np.float32))
+    opt = mx.optimizer.create("adam", learning_rate=0.01,
+                              rescale_grad=1.0 / args.batch_size)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(args.num_steps):
+        out = exe.forward(is_train=True)[0]
+        exe.backward()
+        for i, name in enumerate(sym.list_arguments()):
+            if name not in shapes:
+                updater(i, grads[name], args_map[name])
+        if step % 20 == 0:
+            pred = out.asnumpy().argmax(axis=1)
+            acc = (pred == tok[:, 1:].reshape(-1)).mean()
+            print("step %d: token accuracy %.3f" % (step, acc))
+    print("done: two LSTM layers executed on %s / %s" % (
+        group2ctx["layer0"], group2ctx["layer1"]))
+
+
+if __name__ == "__main__":
+    main()

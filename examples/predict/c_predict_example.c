@@ -1,0 +1,65 @@
+/*
+ * Deploy a trained model from plain C through the predict ABI
+ * (counterpart of the reference's example/image-classification/predict-cpp).
+ *
+ * Build:
+ *   gcc c_predict_example.c -I../../src -L../../mxnet_tpu/lib \
+ *       -lmxtpu_predict -Wl,-rpath,../../mxnet_tpu/lib -o c_predict_example
+ * Run (point the embedded interpreter at the package + site-packages):
+ *   MXNET_TPU_HOME=../.. PYTHONPATH=../..:$SITE_PACKAGES \
+ *       ./c_predict_example model-symbol.json model-0000.params
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "c_predict_api.h"
+
+static char *read_file(const char *path, int *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+  fseek(f, 0, SEEK_END); *size = (int)ftell(f); fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+  buf[*size] = 0; fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s model-symbol.json model-0000.params\n", argv[0]);
+    return 2;
+  }
+  int json_size, param_size;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+
+  const char *input_keys[] = {"data"};
+  mx_uint shape_indptr[] = {0, 2};
+  mx_uint shape_data[] = {1, 8};      /* batch 1, 8 features */
+  PredictorHandle pred;
+  if (MXPredCreate(json, params, param_size, 1 /* cpu; 2 = accelerator */,
+                   0, 1, input_keys, shape_indptr, shape_data, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  float x[8];
+  for (int i = 0; i < 8; ++i) x[i] = 0.125f * (float)i;
+  if (MXPredSetInput(pred, "data", x, 8) != 0 ||
+      MXPredForward(pred) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint *oshape, ondim;
+  MXPredGetOutputShape(pred, 0, &oshape, &ondim);
+  mx_uint n = 1;
+  for (mx_uint i = 0; i < ondim; ++i) n *= oshape[i];
+  float *out = (float *)malloc(n * sizeof(float));
+  MXPredGetOutput(pred, 0, out, n);
+  printf("prediction:");
+  for (mx_uint i = 0; i < n; ++i) printf(" %.4f", out[i]);
+  printf("\n");
+  MXPredFree(pred);
+  return 0;
+}

@@ -1,0 +1,51 @@
+"""Profile a training step and dump a Chrome trace.
+
+Counterpart of the reference's example/profiler/profiler_executor.py.
+Load chrome://tracing (or perfetto.dev) and open profile.json; set
+MXNET_TPU_JAX_TRACE_DIR to additionally capture a device-level
+XPlane/TensorBoard trace.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import nd, profiler
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", default="all", choices=["symbolic", "all"])
+    p.add_argument("--filename", default="profile.json")
+    p.add_argument("--num-steps", type=int, default=20)
+    args = p.parse_args()
+
+    data = mx.sym.var("data")
+    net = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=256, name="fc1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=10, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.bind(data_shapes=[("data", (64, 128))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[nd.array(rng.rand(64, 128).astype(np.float32))],
+        label=[nd.array(rng.randint(0, 10, 64).astype(np.float32))])
+
+    profiler.profiler_set_config(mode=args.mode, filename=args.filename)
+    profiler.profiler_set_state("run")
+    for _ in range(args.num_steps):
+        mod.forward_backward(batch)
+        mod.update()
+        nd.relu(batch.data[0])  # an imperative op (visible in mode=all)
+    nd.waitall()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    print("wrote %s — open in chrome://tracing" % args.filename)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Char-level LSTM language model with BucketingModule.
+
+Counterpart of the reference's example/rnn/lstm_bucketing.py. Each
+sequence-length bucket compiles its own XLA program; parameters are
+shared across buckets through the BucketingModule (SURVEY §5.7).
+Trains on a synthetic grammar when no corpus file is given.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import nd
+
+
+def lstm_lm_sym(seq_len, vocab, num_hidden, num_embed, num_layers):
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    embed = mx.sym.Embedding(data=data, input_dim=vocab,
+                             output_dim=num_embed, name="embed")
+    # fused RNN op: the whole unrolled sequence is one scan-LSTM program
+    rnn = mx.sym.RNN(data=mx.sym.swapaxes(embed, dim1=0, dim2=1),
+                     state_size=num_hidden, num_layers=num_layers,
+                     mode="lstm", name="lstm")
+    hidden = mx.sym.Reshape(mx.sym.swapaxes(rnn, dim1=0, dim2=1),
+                            shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(data=hidden, num_hidden=vocab, name="pred")
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+
+def synth_corpus(n_seq, buckets, vocab, seed=0):
+    """Deterministic grammar: next char = (char + 1) mod vocab with noise."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for i in range(n_seq):
+        L = buckets[i % len(buckets)]
+        start = rng.randint(0, vocab)
+        seq = (start + np.arange(L + 1)) % vocab
+        batches.append(seq)
+    return batches
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=32)
+    p.add_argument("--num-layers", type=int, default=1)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=48)
+    args = p.parse_args()
+    buckets = [8, 16, 24]
+
+    def sym_gen(seq_len):
+        return (lstm_lm_sym(seq_len, args.vocab, args.num_hidden,
+                            args.num_embed, args.num_layers),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(buckets),
+                                 context=mx.tpu(0))
+    mod.bind(data_shapes=[("data", (args.batch_size, max(buckets)))],
+             label_shapes=[("softmax_label", (args.batch_size, max(buckets)))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+
+    seqs = synth_corpus(args.batch_size * 12, buckets, args.vocab)
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for epoch in range(args.num_epochs):
+        metric.reset()
+        for b in range(0, len(seqs), args.batch_size):
+            chunk = seqs[b:b + args.batch_size]
+            L = min(len(s) - 1 for s in chunk)
+            tok = np.stack([s[:L + 1] for s in chunk])
+            batch = mx.io.DataBatch(
+                data=[nd.array(tok[:, :-1].astype(np.float32))],
+                label=[nd.array(tok[:, 1:].astype(np.float32))],
+                bucket_key=L,
+                provide_data=[("data", (len(chunk), L))],
+                provide_label=[("softmax_label", (len(chunk), L))])
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d: train %s=%.3f" % ((epoch,) + metric.get()))
+
+
+if __name__ == "__main__":
+    main()

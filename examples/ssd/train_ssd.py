@@ -1,0 +1,94 @@
+"""Train SSD end to end: im2rec → ImageDetRecordIter → MultiBoxTarget.
+
+Counterpart of the reference's example/ssd/train.py. Given no dataset
+it synthesizes a tiny colored-box detection set, packs it to RecordIO
+with tools/im2rec.py, and trains the SSD graph for a few epochs with
+bbox-aware augmentation (rand-crop/mirror with box clipping).
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet as mx
+from mxnet_tpu.models import ssd
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synth_detection_set(root, n=24, size=128):
+    """One colored rectangle per image; class = color. Reference det
+    label format per line: [header_w, obj_w, cls, x1, y1, x2, y2]."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    os.makedirs(root, exist_ok=True)
+    lines = []
+    for i in range(n):
+        img = np.full((size, size, 3), 210, np.uint8)
+        cls = int(rng.randint(0, 2))
+        w, h = rng.randint(size // 4, size // 2, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        img[y0:y0 + h, x0:x0 + w] = (250, 60, 60) if cls == 0 else (60, 60, 250)
+        fname = "img%03d.png" % i
+        Image.fromarray(img).save(os.path.join(root, fname))
+        label = [2, 5, cls, x0 / size, y0 / size, (x0 + w) / size, (y0 + h) / size]
+        lines.append("%d\t%s\t%s" % (i, "\t".join("%f" % v for v in label), fname))
+    return lines
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-prefix", default=None,
+                   help=".rec prefix; synthesized when absent")
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--work-dir", default="./ssd_data")
+    args = p.parse_args()
+
+    prefix = args.data_prefix
+    if prefix is None or not os.path.isfile(prefix + ".rec"):
+        imgdir = os.path.join(args.work_dir, "imgs")
+        prefix = os.path.join(args.work_dir, "det")
+        lines = synth_detection_set(imgdir)
+        os.makedirs(args.work_dir, exist_ok=True)
+        with open(prefix + ".lst", "w") as f:
+            f.write("\n".join(lines) + "\n")
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+             prefix, imgdir, "--pack-label"], check=True)
+        print("packed synthetic detection set at", prefix + ".rec")
+
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=prefix + ".rec", batch_size=args.batch_size,
+        data_shape=(3, 300, 300), shuffle=True,
+        rand_mirror_prob=0.5, rand_crop_prob=0.3, min_object_covered=0.5,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0)
+
+    sym = ssd.get_symbol_train(num_classes=args.num_classes)
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=("label",),
+                        context=mx.tpu(0))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9, "wd": 5e-4})
+    for epoch in range(args.num_epochs):
+        it.reset()
+        tot, nb = 0.0, 0
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            _, loc_loss, _ = [o.asnumpy() for o in mod.get_outputs()]
+            tot += float(np.abs(loc_loss).sum())
+            nb += 1
+        print("epoch %d: mean |loc loss| %.4f over %d batches"
+              % (epoch, tot / max(nb, 1), nb))
+
+
+if __name__ == "__main__":
+    main()

@@ -520,7 +520,9 @@ class Executor:
         fn = self._get_compiled("fwd_bwd")
         key = self._next_key()
         self._last_key = key
-        outs, grads, aux_updates = fn(self._values(), key, heads)
+        with _profiler.maybe_scope(self._symbol.name or "executor",
+                                   "forward_backward"):
+            outs, grads, aux_updates = fn(self._values(), key, heads)
         self._set_outputs(outs)
         self._apply_aux(aux_updates)
         self._aux_applied = False
