@@ -1,0 +1,291 @@
+"""Python half of the general C API (src/c_api.cc → libmxtpu_c_api.so).
+
+Reference counterpart: ``src/c_api/*.cc`` (3,502 LoC behind
+``include/mxnet/c_api.h``'s 160 MXNET_DLL functions). Design mirrors the
+predict ABI split: the C shared library owns the ABI and embeds CPython;
+this module owns all behavior. Objects cross the boundary as owned
+PyObject pointers; scalars/strings/shape buffers are marshalled by the
+thin C layer.
+
+The op-"creator" handles of the reference (AtomicSymbolCreator) are
+realized as interned op-name strings — the registry is the single
+source of truth, exactly as NNVM's Op* pointers were.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _np
+
+from . import libinfo
+from .base import MXNetError
+from .context import Context
+from .ndarray import ndarray as nd
+from .ops import registry
+
+
+# -- version / ops ----------------------------------------------------------
+def version():
+    return int(libinfo.__version__.replace(".", "")[:5].ljust(5, "0"))
+
+
+def list_all_op_names():
+    return registry.list_ops()
+
+
+# -- NDArray ----------------------------------------------------------------
+def ndarray_create(shape, dev_type, dev_id, delay_alloc, dtype_id):
+    dtype = _DTYPE_FROM_ID[dtype_id]
+    ctx = _ctx(dev_type, dev_id)
+    del delay_alloc  # XLA allocates lazily anyway
+    return nd.zeros(tuple(shape), ctx=ctx, dtype=dtype)
+
+
+def ndarray_create_none():
+    return nd.array(_np.zeros((0,), _np.float32))
+
+
+def ndarray_shape(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def ndarray_dtype_id(arr):
+    return _DTYPE_TO_ID[_np.dtype(arr.dtype).name]
+
+
+def ndarray_context(arr):
+    c = arr.ctx
+    return (_DEV_TYPE_TO_ID.get(c.device_type, 1), c.device_id)
+
+
+def ndarray_sync_copy_from(arr, ptr, size):
+    n = int(_np.prod(arr.shape)) if arr.shape else 1
+    if size != n:
+        raise MXNetError("SyncCopyFromCPU: expected %d elements, got %d"
+                         % (n, size))
+    name = _np.dtype(arr.dtype).name
+    ct = _np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(_CTYPE_FROM_NAME[name])),
+        shape=(n,))
+    data = ct.copy()
+    if name == "float16":
+        # the c_uint16 view carries raw fp16 bits: reinterpret, don't cast
+        data = data.view(_np.float16)
+    arr[:] = nd.array(data.reshape(arr.shape), dtype=arr.dtype)
+
+
+def ndarray_sync_copy_to(arr, ptr, size):
+    n = int(_np.prod(arr.shape)) if arr.shape else 1
+    if size != n:
+        raise MXNetError("SyncCopyToCPU: expected %d elements, got %d"
+                         % (n, size))
+    name = _np.dtype(arr.dtype).name
+    flat = _np.ascontiguousarray(arr.asnumpy()).reshape(-1)
+    if name == "float16":
+        flat = flat.view(_np.uint16)  # hand back raw fp16 bit patterns
+    out = _np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(_CTYPE_FROM_NAME[name])),
+        shape=(n,))
+    out[:] = flat
+
+
+def ndarray_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def ndarray_reshape(arr, shape):
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def ndarray_save(fname, arrays, keys):
+    from .ndarray.utils import save
+
+    if keys:
+        save(fname, dict(zip(keys, arrays)))
+    else:
+        save(fname, list(arrays))
+
+
+def ndarray_load(fname):
+    from .ndarray.utils import load
+
+    data = load(fname)
+    if isinstance(data, dict):
+        return list(data.keys()), list(data.values())
+    return [], list(data)
+
+
+def waitall():
+    nd.waitall()
+
+
+def random_seed(seed):
+    from . import random as _rnd
+
+    _rnd.seed(seed)
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    op = registry.get(op_name)
+    attrs = op.parse_attrs(dict(zip(keys, vals)))
+    out = nd.invoke(op, list(inputs), attrs)
+    return out if isinstance(out, list) else [out]
+
+
+# -- Symbol -----------------------------------------------------------------
+def symbol_create_from_json(json_str):
+    from . import symbol as sym_mod
+
+    return sym_mod.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_create_variable(name):
+    from . import symbol as sym_mod
+
+    return sym_mod.var(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    """Partially-applied op: compose() binds its inputs (ref two-step
+    MXSymbolCreateAtomicSymbol + MXSymbolCompose)."""
+    return ("__atomic__", op_name, dict(zip(keys, vals)))
+
+
+def symbol_compose(atom_or_sym, name, keys, args):
+    if not (isinstance(atom_or_sym, tuple) and atom_or_sym[0] == "__atomic__"):
+        raise MXNetError("compose expects an atomic symbol handle")
+    _, op_name, attrs = atom_or_sym
+    import mxnet_tpu.symbol as S
+
+    op = registry.get(op_name)
+    parsed = op.parse_attrs(attrs)
+    fn = getattr(S, op_name)
+    if keys:
+        kwargs = dict(zip(keys, args))
+        kwargs.update(parsed)
+        return fn(name=name, **kwargs)
+    return fn(*args, name=name, **parsed)
+
+
+def symbol_list_arguments(sym):
+    return sym.list_arguments()
+
+
+def symbol_list_outputs(sym):
+    return sym.list_outputs()
+
+
+def symbol_list_aux(sym):
+    return sym.list_auxiliary_states()
+
+
+def symbol_copy(sym):
+    import copy
+
+    return copy.deepcopy(sym)
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(key)
+    return v
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+
+
+def symbol_infer_shape(sym, keys, ndims, data):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete)."""
+    kwargs = {}
+    off = 0
+    for k, nd_ in zip(keys, ndims):
+        kwargs[k] = tuple(int(x) for x in data[off:off + nd_])
+        off += nd_
+    try:
+        arg, out, aux = sym.infer_shape(**kwargs)
+    except MXNetError:
+        return None, None, None, 0
+    if arg is None:
+        return None, None, None, 0
+    return ([tuple(s) for s in arg], [tuple(s) for s in out],
+            [tuple(s) for s in aux], 1)
+
+
+# -- Executor ---------------------------------------------------------------
+def executor_bind(sym, dev_type, dev_id, args, grads, req_ids, aux):
+    ctx = _ctx(dev_type, dev_id)
+    arg_names = sym.list_arguments()
+    req_names = {0: "null", 1: "write", 3: "add"}
+    grad_dict = {n: g for n, g in zip(arg_names, grads) if g is not None}
+    grad_req = {n: req_names.get(int(r), "write")
+                for n, r in zip(arg_names, req_ids)}
+    return sym.bind(ctx, list(args), args_grad=grad_dict or None,
+                    grad_req=grad_req, aux_states=list(aux))
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+# -- KVStore ----------------------------------------------------------------
+def kvstore_create(kv_type):
+    from . import kvstore as kv_mod
+
+    return kv_mod.create(kv_type or "local")
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+
+
+def kvstore_type(kv):
+    return kv.type
+
+
+# -- marshalling tables -----------------------------------------------------
+_DTYPE_FROM_ID = {0: _np.float32, 1: _np.float64, 2: _np.float16,
+                  3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64}
+_DTYPE_TO_ID = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 2}
+_CTYPE_FROM_NAME = {"float32": ctypes.c_float, "float64": ctypes.c_double,
+                    "float16": ctypes.c_uint16, "uint8": ctypes.c_uint8,
+                    "int32": ctypes.c_int32, "int8": ctypes.c_int8,
+                    "int64": ctypes.c_int64}
+_DEV_TYPE_TO_ID = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3}
+
+
+def _ctx(dev_type, dev_id):
+    name = {1: "cpu", 2: "tpu", 3: "cpu_pinned"}.get(int(dev_type), "cpu")
+    return Context(name, int(dev_id))

@@ -1,0 +1,733 @@
+/*
+ * General C API over the embedded-Python runtime.
+ *
+ * Reference counterpart: src/c_api/{c_api.cc,c_api_ndarray.cc,
+ * c_api_symbolic.cc,c_api_executor.cc}. Thin marshalling layer: every
+ * entry takes the GIL, forwards into mxnet_tpu.c_api_backend, and
+ * converts results to C types. Handles are owned PyObject pointers
+ * wrapped with per-handle scratch buffers for the pointer-returning
+ * calls (shape arrays, string lists) — same ownership discipline the
+ * reference implemented with thread-local ret stores.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "embed_common.h"
+
+namespace {
+
+using mxtpu_embed::Gil;
+using mxtpu_embed::capture_py_error;
+using mxtpu_embed::g_last_error;
+using mxtpu_embed::set_error;
+
+PyObject *backend() {
+  static PyObject *mod = nullptr;
+  if (mod == nullptr) {
+    mod = mxtpu_embed::import_backend("mxnet_tpu.c_api_backend");
+  }
+  return mod;
+}
+
+/* A handle: the python object + scratch buffers whose lifetime the
+ * reference ties to the handle (shape/string returns). */
+struct Handle {
+  PyObject *obj = nullptr;
+  std::vector<mx_uint> shape_buf;
+  std::vector<std::string> str_store;
+  std::vector<const char *> str_ptrs;
+  /* infer-shape scratch */
+  std::vector<std::vector<mx_uint>> shapes3[3];
+  std::vector<mx_uint> ndims[3];
+  std::vector<const mx_uint *> pdata[3];
+  std::string json;
+
+  ~Handle() {
+    if (obj != nullptr) {
+      Gil gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+Handle *wrap(PyObject *obj) {
+  auto *h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+PyObject *obj(void *handle) { return static_cast<Handle *>(handle)->obj; }
+
+/* call backend fn, returning new ref or nullptr (+error captured) */
+PyObject *call(const char *fn, const char *fmt, ...) {
+  PyObject *mod = backend();
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  if (args == nullptr) {
+    Py_DECREF(f);
+    capture_py_error();
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  if (r == nullptr) capture_py_error();
+  return r;
+}
+
+PyObject *str_list(const char **items, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(items[i]));
+  }
+  return lst;
+}
+
+PyObject *handle_list(void **handles, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = handles[i] ? obj(handles[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  return lst;
+}
+
+PyObject *uint_list(const mx_uint *items, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyLong_FromUnsignedLong(items[i]));
+  }
+  return lst;
+}
+
+/* fill a handle's string store from a python list of str and expose it */
+int export_strings(Handle *h, PyObject *lst, mx_uint *out_size,
+                   const char ***out_array) {
+  Py_ssize_t n = PyList_Size(lst);
+  h->str_store.clear();
+  h->str_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->str_store.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i)));
+  }
+  for (auto &s : h->str_store) h->str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = h->str_ptrs.data();
+  return 0;
+}
+
+/* op-name interning: filled once, never cleared — creator handles and
+ * the MXListAllOpNames array alias these strings for the process
+ * lifetime (the reference kept NNVM Op* pointers alive the same way) */
+std::vector<std::string> g_op_name_store;
+std::vector<const char *> g_op_name_ptrs;
+
+/* scratch for MXNDArrayLoad's name list (per-call, caller copies) */
+Handle g_load_store;
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  Gil gil;
+  PyObject *r = call("version", "()");
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject *r = call("random_seed", "(i)", seed);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject *r = call("waitall", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  Gil gil;
+  if (g_op_name_ptrs.empty()) {
+    PyObject *r = call("list_all_op_names", "()");
+    if (r == nullptr) return -1;
+    Py_ssize_t n = PyList_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      g_op_name_store.emplace_back(
+          PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+    }
+    for (auto &sname : g_op_name_store) {
+      g_op_name_ptrs.push_back(sname.c_str());
+    }
+    Py_DECREF(r);
+  }
+  *out_size = static_cast<mx_uint>(g_op_name_ptrs.size());
+  *out_array = g_op_name_ptrs.data();
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  /* creators are the interned op-name strings themselves */
+  const char **names;
+  int rc = MXListAllOpNames(out_size, &names);
+  if (rc != 0) return rc;
+  *out_array = reinterpret_cast<AtomicSymbolCreator *>(names);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<const char *>(creator);
+  return 0;
+}
+
+/* ---------------- NDArray ---------------- */
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle *out) {
+  Gil gil;
+  PyObject *shp = uint_list(shape, ndim);
+  PyObject *r = call("ndarray_create", "(Oiiii)", shp, dev_type, dev_id,
+                     delay_alloc, dtype);
+  Py_DECREF(shp);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_create_none", "()");
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("ndarray_shape", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  h->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = h->shape_buf.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  Gil gil;
+  PyObject *r = call("ndarray_dtype_id", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  Gil gil;
+  PyObject *r = call("ndarray_context", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  Gil gil;
+  PyObject *r = call("ndarray_sync_copy_from", "(OKn)", obj(handle),
+                     (unsigned long long)(uintptr_t)data,
+                     (Py_ssize_t)size);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  Gil gil;
+  PyObject *r = call("ndarray_sync_copy_to", "(OKn)", obj(handle),
+                     (unsigned long long)(uintptr_t)data,
+                     (Py_ssize_t)size);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_slice", "(OII)", obj(handle), begin, end);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  Gil gil;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *r = call("ndarray_reshape", "(OO)", obj(handle), shp);
+  Py_DECREF(shp);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  Gil gil;
+  PyObject *arrs = handle_list(args, num_args);
+  PyObject *ks = keys ? str_list(keys, num_args) : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = call("ndarray_save", "(sOO)", fname, arrs, ks);
+  Py_DECREF(arrs);
+  Py_DECREF(ks);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  Gil gil;
+  PyObject *r = call("ndarray_load", "(s)", fname);
+  if (r == nullptr) return -1;
+  PyObject *names = PyTuple_GET_ITEM(r, 0);
+  PyObject *arrs = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  static thread_local std::vector<NDArrayHandle> handles;
+  handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);
+    handles.push_back(wrap(o));
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = handles.data();
+  export_strings(&g_load_store, names, out_name_size, out_names);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  Gil gil;
+  PyObject *ins = handle_list(inputs, num_inputs);
+  PyObject *ks = str_list(param_keys, num_params);
+  PyObject *vs = str_list(param_vals, num_params);
+  PyObject *r = call("imperative_invoke", "(sOOO)",
+                     static_cast<const char *>(creator), ins, ks, vs);
+  Py_DECREF(ins);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  static thread_local std::vector<NDArrayHandle> outs;
+  outs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outs.push_back(wrap(o));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = outs.data();
+  return 0;
+}
+
+/* ---------------- Symbol ---------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_create_from_json", "(s)", json);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *r = call("symbol_to_json", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  h->json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = h->json.c_str();
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_create_variable", "(s)", name);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num_param);
+  PyObject *vs = str_list(vals, num_param);
+  PyObject *r = call("symbol_create_atomic", "(sOO)",
+                     static_cast<const char *>(creator), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *ks = keys ? str_list(keys, num_args)
+                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *as = handle_list(args, num_args);
+  PyObject *r = call("symbol_compose", "(OsOO)", h->obj, name, ks, as);
+  Py_DECREF(ks);
+  Py_DECREF(as);
+  if (r == nullptr) return -1;
+  /* compose mutates the handle in place (reference semantics) */
+  Py_DECREF(h->obj);
+  h->obj = r;
+  return 0;
+}
+
+static int export_sym_strings(SymbolHandle sym, const char *fn,
+                              mx_uint *out_size, const char ***out_array) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *r = call(fn, "(O)", h->obj);
+  if (r == nullptr) return -1;
+  int rc = export_strings(h, r, out_size, out_array);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array) {
+  return export_sym_strings(sym, "symbol_list_arguments", out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array) {
+  return export_sym_strings(sym, "symbol_list_outputs", out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array) {
+  return export_sym_strings(sym, "symbol_list_aux", out_size, out_array);
+}
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_copy", "(O)", obj(sym));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  delete static_cast<Handle *>(sym);
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *r = call("symbol_get_attr", "(Os)", h->obj, key);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    h->json = PyUnicode_AsUTF8(r);
+    *out = h->json.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
+  Gil gil;
+  PyObject *r = call("symbol_set_attr", "(Oss)", obj(sym), key, value);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *ks = str_list(keys, num_args);
+  PyObject *nds = PyList_New(num_args);
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(nds, i, PyLong_FromUnsignedLong(
+        arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
+  }
+  PyObject *flat = uint_list(arg_shape_data, total);
+  PyObject *r = call("symbol_infer_shape", "(OOOO)", h->obj, ks, nds, flat);
+  Py_DECREF(ks);
+  Py_DECREF(nds);
+  Py_DECREF(flat);
+  if (r == nullptr) return -1;
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims_out[3] = {in_shape_ndim, out_shape_ndim,
+                                  aux_shape_ndim};
+  const mx_uint ***data_out[3] = {in_shape_data, out_shape_data,
+                                  aux_shape_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyTuple_GET_ITEM(r, g);
+    h->shapes3[g].clear();
+    h->ndims[g].clear();
+    h->pdata[g].clear();
+    if (lst == Py_None) {
+      *sizes[g] = 0;
+      *ndims_out[g] = nullptr;
+      *data_out[g] = nullptr;
+      continue;
+    }
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *tup = PyList_GET_ITEM(lst, i);
+      std::vector<mx_uint> shp;
+      for (Py_ssize_t j = 0; j < PyTuple_Size(tup); ++j) {
+        shp.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(tup, j))));
+      }
+      h->ndims[g].push_back(static_cast<mx_uint>(shp.size()));
+      h->shapes3[g].push_back(std::move(shp));
+    }
+    for (auto &s : h->shapes3[g]) h->pdata[g].push_back(s.data());
+    *sizes[g] = static_cast<mx_uint>(n);
+    *ndims_out[g] = h->ndims[g].data();
+    *data_out[g] = h->pdata[g].data();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- Executor ---------------- */
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                   mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out) {
+  Gil gil;
+  PyObject *args = handle_list(in_args, len);
+  PyObject *grads = handle_list(arg_grad_store, len);
+  PyObject *reqs = uint_list(grad_req_type, len);
+  PyObject *aux = handle_list(aux_states, aux_states_len);
+  PyObject *r = call("executor_bind", "(OiiOOOO)", obj(sym), dev_type,
+                     dev_id, args, grads, reqs, aux);
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  Py_DECREF(aux);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle exe, int is_train) {
+  Gil gil;
+  PyObject *r = call("executor_forward", "(Oi)", obj(exe), is_train);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle exe, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  Gil gil;
+  PyObject *grads = handle_list(head_grads, len);
+  PyObject *r = call("executor_backward", "(OO)", obj(exe), grads);
+  Py_DECREF(grads);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle exe, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  auto *h = static_cast<Handle *>(exe);
+  Gil gil;
+  PyObject *r = call("executor_outputs", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  /* caller owns the returned handles (frees via MXNDArrayFree) — the
+   * reference convention; the pointer array itself is thread-local and
+   * valid until the next Outputs call */
+  static thread_local std::vector<NDArrayHandle> outs;
+  outs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outs.push_back(wrap(o));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out = outs.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle exe) {
+  delete static_cast<Handle *>(exe);
+  return 0;
+}
+
+/* ---------------- KVStore ---------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  Gil gil;
+  PyObject *r = call("kvstore_create", "(s)", type ? type : "local");
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle kv) {
+  delete static_cast<Handle *>(kv);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num);
+  PyObject *vs = handle_list(vals, num);
+  PyObject *r = call("kvstore_init", "(OOO)", obj(kv), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num);
+  PyObject *vs = handle_list(vals, num);
+  PyObject *r = call("kvstore_push", "(OOOi)", obj(kv), ks, vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *outs, int priority) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num);
+  PyObject *vs = handle_list(outs, num);
+  PyObject *r = call("kvstore_pull", "(OOOi)", obj(kv), ks, vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int *out) {
+  Gil gil;
+  PyObject *r = call("kvstore_rank", "(O)", obj(kv));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out) {
+  Gil gil;
+  PyObject *r = call("kvstore_size", "(O)", obj(kv));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  Gil gil;
+  PyObject *r = call("kvstore_barrier", "(O)", obj(kv));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle kv, const char **out) {
+  auto *h = static_cast<Handle *>(kv);
+  Gil gil;
+  PyObject *r = call("kvstore_type", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  h->json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out = h->json.c_str();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+/*
+ * General C API — NDArray / op invoke / Symbol / Executor / KVStore.
+ *
+ * Reference counterpart: include/mxnet/c_api.h (160 MXNET_DLL functions
+ * over src/c_api/, 3,502 LoC). This is the load-bearing subset every
+ * reference language binding is built from: create/inspect/copy
+ * NDArrays, invoke any registered operator imperatively, build/parse
+ * symbols, bind + run executors, and drive a KVStore. Same names and
+ * calling conventions; AtomicSymbolCreator handles are interned op-name
+ * strings (the registry replaces NNVM's Op*).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXNET_DLL __attribute__((visibility("default")))
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef const void *AtomicSymbolCreator;
+
+MXNET_DLL const char *MXGetLastError();
+MXNET_DLL int MXGetVersion(int *out);
+MXNET_DLL int MXRandomSeed(int seed);
+MXNET_DLL int MXNDArrayWaitAll();
+
+/* op discovery (ref: MXListAllOpNames / MXSymbolListAtomicSymbolCreators) */
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out_array);
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
+
+/* NDArray */
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size);
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin,
+                             mx_uint end, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                               NDArrayHandle *out);
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* imperative invoke (ref: MXImperativeInvoke, c_api_ndarray.cc:117) */
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+
+/* Symbol */
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals, SymbolHandle *out);
+MXNET_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                                    const char ***out_array);
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                                  const char ***out_array);
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                          const char ***out_array);
+MXNET_DLL int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+MXNET_DLL int MXSymbolFree(SymbolHandle sym);
+MXNET_DLL int MXSymbolGetAttr(SymbolHandle sym, const char *key,
+                              const char **out, int *success);
+MXNET_DLL int MXSymbolSetAttr(SymbolHandle sym, const char *key,
+                              const char *value);
+MXNET_DLL int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                                 const char **keys,
+                                 const mx_uint *arg_ind_ptr,
+                                 const mx_uint *arg_shape_data,
+                                 mx_uint *in_shape_size,
+                                 const mx_uint **in_shape_ndim,
+                                 const mx_uint ***in_shape_data,
+                                 mx_uint *out_shape_size,
+                                 const mx_uint **out_shape_ndim,
+                                 const mx_uint ***out_shape_data,
+                                 mx_uint *aux_shape_size,
+                                 const mx_uint **aux_shape_ndim,
+                                 const mx_uint ***aux_shape_data,
+                                 int *complete);
+
+/* Executor (ref: MXExecutorBind, c_api_executor.cc) */
+MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorForward(ExecutorHandle exe, int is_train);
+MXNET_DLL int MXExecutorBackward(ExecutorHandle exe, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle exe, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXNET_DLL int MXExecutorFree(ExecutorHandle exe);
+
+/* KVStore (ref: MXKVStore*, c_api.cc) */
+MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXNET_DLL int MXKVStoreFree(KVStoreHandle kv);
+MXNET_DLL int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num,
+                              const char **keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePushEx(KVStoreHandle kv, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+MXNET_DLL int MXKVStorePullEx(KVStoreHandle kv, mx_uint num,
+                              const char **keys, NDArrayHandle *outs,
+                              int priority);
+MXNET_DLL int MXKVStoreGetRank(KVStoreHandle kv, int *out);
+MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
+MXNET_DLL int MXKVStoreBarrier(KVStoreHandle kv);
+MXNET_DLL int MXKVStoreGetType(KVStoreHandle kv, const char **out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

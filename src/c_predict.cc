@@ -20,58 +20,22 @@
 #include <vector>
 
 #include "c_predict_api.h"
+#include "embed_common.h"
 
 namespace {
 
-thread_local std::string g_last_error;
-
-void set_error(const std::string &msg) { g_last_error = msg; }
-
-/* Capture the pending Python exception into the error slot. */
-void capture_py_error() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *c = PyUnicode_AsUTF8(s);
-      set_error(c != nullptr ? c : "unknown python error");
-      Py_DECREF(s);
-    }
-  } else {
-    set_error("unknown python error");
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
+using mxtpu_embed::Gil;
+using mxtpu_embed::capture_py_error;
+using mxtpu_embed::g_last_error;
+using mxtpu_embed::set_error;
 
 /* Initialize the interpreter (no-op when hosted inside Python already,
  * e.g. a ctypes consumer) and import mxnet_tpu.c_predict. */
 PyObject *predict_module() {
   static PyObject *mod = nullptr;
-  if (mod != nullptr) return mod;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    /* Release the GIL the init left on this thread; from here on every
-     * entry point balances it via PyGILState_Ensure/Release, so other
-     * threads can call in without deadlocking. */
-    (void)PyEval_SaveThread();
+  if (mod == nullptr) {
+    mod = mxtpu_embed::import_backend("mxnet_tpu.c_predict");
   }
-  PyGILState_STATE gil = PyGILState_Ensure();
-  /* MXNET_TPU_HOME lets a pure-C process point at the package root. */
-  const char *home = std::getenv("MXNET_TPU_HOME");
-  if (home != nullptr) {
-    PyObject *sys_path = PySys_GetObject("path");  /* borrowed */
-    if (sys_path != nullptr) {
-      PyObject *p = PyUnicode_FromString(home);
-      PyList_Insert(sys_path, 0, p);
-      Py_DECREF(p);
-    }
-  }
-  mod = PyImport_ImportModule("mxnet_tpu.c_predict");
-  if (mod == nullptr) capture_py_error();
-  PyGILState_Release(gil);
   return mod;
 }
 
@@ -84,15 +48,6 @@ struct NDList {
   PyObject *obj;                            /* NDList instance */
   std::vector<std::string> keys;
   std::vector<std::vector<mx_uint>> shapes; /* storage behind Get */
-};
-
-class Gil {
- public:
-  Gil() : state_(PyGILState_Ensure()) {}
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
 };
 
 int create_impl(const char *symbol_json_str, const void *param_bytes,

@@ -1,0 +1,86 @@
+/*
+ * Shared CPython-embedding plumbing for the C ABI shared libraries
+ * (c_api.cc, c_predict.cc): interpreter bootstrap, GIL guard, and
+ * python-exception capture into a thread-local error slot (the
+ * MXGetLastError contract). Header-only so each .so stays a single
+ * translation unit; the statics are per-TU by design (each library
+ * owns its error slot, the process-wide interpreter state is Python's).
+ */
+#ifndef MXTPU_EMBED_COMMON_H_
+#define MXTPU_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <string>
+
+namespace mxtpu_embed {
+
+inline thread_local std::string g_last_error;
+
+inline void set_error(const std::string &msg) { g_last_error = msg; }
+
+/* Capture the pending Python exception into the error slot. */
+inline void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      set_error(c != nullptr ? c : "unknown python error");
+      Py_DECREF(s);
+    }
+  } else {
+    set_error("unknown python error");
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+/* Interpreter bring-up. Must run before any PyGILState_Ensure: the init
+ * leaves the GIL held on the calling thread, so it is released right
+ * away and every entry point balances it via the Gil guard below. */
+inline void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    (void)PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() {
+    ensure_python();
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+  Gil(const Gil &) = delete;
+  Gil &operator=(const Gil &) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
+
+/* Import the backing python module once, prepending MXNET_TPU_HOME to
+ * sys.path so a pure-C process can point at the package root. */
+inline PyObject *import_backend(const char *module_name) {
+  ensure_python();
+  Gil gil;
+  const char *home = std::getenv("MXNET_TPU_HOME");
+  if (home != nullptr) {
+    PyObject *sys_path = PySys_GetObject("path");
+    if (sys_path != nullptr) {
+      PyObject *p = PyUnicode_FromString(home);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+  }
+  PyObject *mod = PyImport_ImportModule(module_name);
+  if (mod == nullptr) capture_py_error();
+  return mod;
+}
+
+}  // namespace mxtpu_embed
+
+#endif  /* MXTPU_EMBED_COMMON_H_ */

@@ -1,0 +1,404 @@
+"""General C API (ref: include/mxnet/c_api.h, src/c_api/*.cc).
+
+Exercises the binding-builder surface end to end through ctypes: op
+discovery, NDArray lifecycle + data movement, imperative invoke, symbol
+compose/infer/JSON, executor fwd/bwd, KVStore — then compiles a pure-C
+consumer that trains one gradient step with no Python in sight.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "mxnet_tpu", "lib", "libmxtpu_c_api.so")
+
+u = ctypes.c_uint
+up = ctypes.POINTER(u)
+h = ctypes.c_void_p
+
+
+def V(x):
+    """Re-wrap a handle read from a POINTER(c_void_p): a bare Python int
+    would be truncated to 32 bits by ctypes' default int conversion."""
+    return x if isinstance(x, h) else h(x)
+
+
+def _lib():
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src"), "capi"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("c_api build failed: " + r.stderr[-400:])
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _err(lib):
+    return lib.MXGetLastError().decode()
+
+
+def _make_nd(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = (u * arr.ndim)(*arr.shape)
+    out = h()
+    assert lib.MXNDArrayCreate(shape, arr.ndim, 1, 0, 0, 0,
+                               ctypes.byref(out)) == 0, _err(lib)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        out, arr.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(arr.size)) == 0, _err(lib)
+    return out
+
+
+def _to_np(lib, handle):
+    handle = V(handle)
+    ndim = u()
+    pdata = up()
+    assert lib.MXNDArrayGetShape(handle, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0, _err(lib)
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.zeros(shape, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        handle, out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(out.size)) == 0, _err(lib)
+    return out
+
+
+def test_version_and_op_discovery():
+    lib = _lib()
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0 and v.value > 0
+    n = u()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(names)) == 0
+    all_names = {names[i].decode() for i in range(n.value)}
+    assert n.value >= 300
+    assert {"Convolution", "FullyConnected", "dot", "relu"} <= all_names
+    # creator handles round-trip to names
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0
+    nm = ctypes.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolName(ctypes.c_void_p(creators[0]),
+                                           ctypes.byref(nm)) == 0
+    assert nm.value.decode() in all_names
+
+
+def test_ndarray_lifecycle_and_invoke():
+    lib = _lib()
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    a = _make_nd(lib, x)
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(a, ctypes.byref(dt)) == 0 and dt.value == 0
+    devt, devid = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXNDArrayGetContext(a, ctypes.byref(devt),
+                                   ctypes.byref(devid)) == 0
+    np.testing.assert_allclose(_to_np(lib, a), x, rtol=1e-6)
+
+    # imperative invoke: exp
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(h)()
+    ins = (h * 1)(a)
+    assert lib.MXImperativeInvoke(
+        ctypes.c_char_p(b"exp"), 1, ins, ctypes.byref(n_out),
+        ctypes.byref(outs), 0, None, None) == 0, _err(lib)
+    assert n_out.value == 1
+    np.testing.assert_allclose(_to_np(lib, outs[0]), np.exp(x), rtol=1e-5)
+    lib.MXNDArrayFree(V(outs[0]))
+
+    # invoke with string attrs: sum over axis 1
+    outs2 = ctypes.POINTER(h)()
+    keys = (ctypes.c_char_p * 1)(b"axis")
+    vals = (ctypes.c_char_p * 1)(b"1")
+    assert lib.MXImperativeInvoke(
+        ctypes.c_char_p(b"sum"), 1, ins, ctypes.byref(n_out),
+        ctypes.byref(outs2), 1, keys, vals) == 0, _err(lib)
+    np.testing.assert_allclose(_to_np(lib, outs2[0]), x.sum(1), rtol=1e-5)
+    lib.MXNDArrayFree(V(outs2[0]))
+
+    # slice + reshape
+    sl = h()
+    assert lib.MXNDArraySlice(a, 1, 3, ctypes.byref(sl)) == 0
+    np.testing.assert_allclose(_to_np(lib, sl), x[1:3], rtol=1e-6)
+    rs = h()
+    dims = (ctypes.c_int * 2)(4, 3)
+    assert lib.MXNDArrayReshape(a, 2, dims, ctypes.byref(rs)) == 0
+    np.testing.assert_allclose(_to_np(lib, rs), x.reshape(4, 3), rtol=1e-6)
+    for x_ in (sl, rs, a):
+        lib.MXNDArrayFree(x_)
+
+
+def test_ndarray_save_load(tmp_path):
+    lib = _lib()
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a = _make_nd(lib, x)
+    fname = str(tmp_path / "t.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"arg:w")
+    arrs = (h * 1)(a)
+    assert lib.MXNDArraySave(fname, 1, arrs, keys) == 0, _err(lib)
+    n, nn = u(), u()
+    got = ctypes.POINTER(h)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(n), ctypes.byref(got),
+                             ctypes.byref(nn), ctypes.byref(names)) == 0
+    assert n.value == 1 and nn.value == 1
+    assert names[0].decode() == "arg:w"
+    np.testing.assert_allclose(_to_np(lib, got[0]), x)
+    lib.MXNDArrayFree(V(got[0]))
+    lib.MXNDArrayFree(a)
+
+
+def test_symbol_compose_infer_executor():
+    lib = _lib()
+    # data variable
+    data = h()
+    assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    # atomic FullyConnected(num_hidden=4) composed with data
+    fc = h()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"4")
+    assert lib.MXSymbolCreateAtomicSymbol(
+        ctypes.c_char_p(b"FullyConnected"), 1, keys, vals,
+        ctypes.byref(fc)) == 0, _err(lib)
+    ckeys = (ctypes.c_char_p * 1)(b"data")
+    cargs = (h * 1)(data)
+    assert lib.MXSymbolCompose(fc, b"fc1", 1, ckeys, cargs) == 0, _err(lib)
+
+    nsz = u()
+    sarr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(fc, ctypes.byref(nsz),
+                                     ctypes.byref(sarr)) == 0
+    args = [sarr[i].decode() for i in range(nsz.value)]
+    assert args == ["data", "fc1_weight", "fc1_bias"]
+
+    # infer shapes from data=(2,3)
+    ikeys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    sdata = (u * 2)(2, 3)
+    in_sz, out_sz, aux_sz = u(), u(), u()
+    in_nd, out_nd, aux_nd = up(), up(), up()
+    in_d = ctypes.POINTER(up)()
+    out_d = ctypes.POINTER(up)()
+    aux_d = ctypes.POINTER(up)()
+    comp = ctypes.c_int()
+    assert lib.MXSymbolInferShape(
+        fc, 1, ikeys, indptr, sdata,
+        ctypes.byref(in_sz), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_sz), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_sz), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(comp)) == 0, _err(lib)
+    assert comp.value == 1
+    shapes = [tuple(in_d[i][j] for j in range(in_nd[i]))
+              for i in range(in_sz.value)]
+    assert shapes == [(2, 3), (4, 3), (4,)]
+    assert tuple(out_d[0][j] for j in range(out_nd[0])) == (2, 4)
+
+    # JSON round trip
+    js = ctypes.c_char_p()
+    assert lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)) == 0
+    sym2 = h()
+    assert lib.MXSymbolCreateFromJSON(js.value, ctypes.byref(sym2)) == 0
+
+    # bind + forward + backward
+    rng = np.random.RandomState(1)
+    arrs = [_make_nd(lib, rng.rand(*s)) for s in shapes]
+    grads = [_make_nd(lib, np.zeros(s, np.float32)) for s in shapes]
+    reqs = (u * 3)(1, 1, 1)
+    exe = h()
+    assert lib.MXExecutorBind(fc, 1, 0, 3, (h * 3)(*arrs), (h * 3)(*grads),
+                              reqs, 0, None, ctypes.byref(exe)) == 0, _err(lib)
+    assert lib.MXExecutorForward(exe, 1) == 0, _err(lib)
+    osz = u()
+    outs = ctypes.POINTER(h)()
+    assert lib.MXExecutorOutputs(exe, ctypes.byref(osz),
+                                 ctypes.byref(outs)) == 0
+    out_np = _to_np(lib, outs[0])
+    x, w, b = [_to_np(lib, a) for a in arrs]
+    np.testing.assert_allclose(out_np, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    lib.MXNDArrayFree(V(outs[0]))
+    head = _make_nd(lib, np.ones((2, 4), np.float32))
+    assert lib.MXExecutorBackward(exe, 1, (h * 1)(head)) == 0, _err(lib)
+    gw = _to_np(lib, grads[1])
+    np.testing.assert_allclose(gw, np.ones((2, 4)).T @ x, rtol=1e-4,
+                               atol=1e-5)
+    lib.MXExecutorFree(exe)
+    for a in arrs + grads + [head]:
+        lib.MXNDArrayFree(a)
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(sym2)
+    lib.MXSymbolFree(data)
+
+
+def test_kvstore_c_surface():
+    lib = _lib()
+    kv = h()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    t = ctypes.c_char_p()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    rank, size = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value >= 1
+
+    w = _make_nd(lib, np.zeros((2, 2), np.float32))
+    g = _make_nd(lib, np.ones((2, 2), np.float32))
+    keys = (ctypes.c_char_p * 1)(b"w")
+    assert lib.MXKVStoreInitEx(kv, 1, keys, (h * 1)(w)) == 0, _err(lib)
+    assert lib.MXKVStorePushEx(kv, 1, keys, (h * 1)(g), 0) == 0, _err(lib)
+    out = _make_nd(lib, np.zeros((2, 2), np.float32))
+    assert lib.MXKVStorePullEx(kv, 1, keys, (h * 1)(out), 0) == 0, _err(lib)
+    np.testing.assert_allclose(_to_np(lib, out), 1.0)
+    assert lib.MXKVStoreBarrier(kv) == 0
+    for a in (w, g, out):
+        lib.MXNDArrayFree(a)
+    lib.MXKVStoreFree(kv)
+
+
+def test_error_surface():
+    lib = _lib()
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(h)()
+    rc = lib.MXImperativeInvoke(ctypes.c_char_p(b"not_a_real_op"), 0, None,
+                                ctypes.byref(n_out), ctypes.byref(outs),
+                                0, None, None)
+    assert rc != 0
+    assert "not_a_real_op" in _err(lib)
+
+
+C_MAIN = r"""
+/* one SGD step on w for loss=sum(relu(x@w.T)) — pure C, no Python */
+#include <stdio.h>
+#include "c_api.h"
+
+int main(void) {
+  mx_uint n; const char **names;
+  if (MXListAllOpNames(&n, &names) != 0) return 1;
+  if (n < 300) return 1;
+
+  SymbolHandle data, fc;
+  MXSymbolCreateVariable("data", &data);
+  const char *k[] = {"num_hidden"}, *v[] = {"2"};
+  if (MXSymbolCreateAtomicSymbol("FullyConnected", 1, k, v, &fc) != 0) {
+    fprintf(stderr, "%s\n", MXGetLastError()); return 1;
+  }
+  const char *ck[] = {"data"};
+  SymbolHandle ca[] = {data};
+  if (MXSymbolCompose(fc, "fc", 1, ck, ca) != 0) return 1;
+
+  mx_uint shp_x[] = {4, 3}, shp_w[] = {2, 3}, shp_b[] = {2};
+  NDArrayHandle x, w, b, gx, gw, gb;
+  MXNDArrayCreate(shp_x, 2, 1, 0, 0, 0, &x);
+  MXNDArrayCreate(shp_w, 2, 1, 0, 0, 0, &w);
+  MXNDArrayCreate(shp_b, 1, 1, 0, 0, 0, &b);
+  MXNDArrayCreate(shp_x, 2, 1, 0, 0, 0, &gx);
+  MXNDArrayCreate(shp_w, 2, 1, 0, 0, 0, &gw);
+  MXNDArrayCreate(shp_b, 1, 1, 0, 0, 0, &gb);
+  float xv[12], wv[6] = {0.1f, -0.2f, 0.3f, 0.2f, 0.1f, -0.1f};
+  for (int i = 0; i < 12; ++i) xv[i] = 0.1f * (float)(i - 6);
+  MXNDArraySyncCopyFromCPU(x, xv, 12);
+  MXNDArraySyncCopyFromCPU(w, wv, 6);
+
+  NDArrayHandle args[] = {x, w, b}, grads[] = {gx, gw, gb};
+  mx_uint reqs[] = {1, 1, 1};
+  ExecutorHandle exe;
+  if (MXExecutorBind(fc, 1, 0, 3, args, grads, reqs, 0, NULL, &exe) != 0) {
+    fprintf(stderr, "bind: %s\n", MXGetLastError()); return 1;
+  }
+  if (MXExecutorForward(exe, 1) != 0) return 1;
+  mx_uint osz; NDArrayHandle *outs;
+  MXExecutorOutputs(exe, &osz, &outs);
+  if (MXExecutorBackward(exe, 0, NULL) != 0) {
+    fprintf(stderr, "bwd: %s\n", MXGetLastError()); return 1;
+  }
+  float gwv[6];
+  MXNDArraySyncCopyToCPU(gw, gwv, 6);
+  /* head grad defaults to ones: dW = ones(4,2)^T @ x; column sums of x */
+  float col0 = xv[0] + xv[3] + xv[6] + xv[9];
+  if (gwv[0] < col0 - 1e-4 || gwv[0] > col0 + 1e-4) {
+    fprintf(stderr, "unexpected grad %f vs %f\n", gwv[0], col0); return 1;
+  }
+  printf("C_API_OK grad=%f\n", gwv[0]);
+  MXExecutorFree(exe);
+  MXNDArrayFree(outs[0]);
+  return 0;
+}
+"""
+
+
+def test_pure_c_consumer(tmp_path):
+    _lib()
+    csrc = tmp_path / "main.c"
+    csrc.write_text(C_MAIN)
+    exe = str(tmp_path / "capimain")
+    r = subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(ROOT, "src"),
+         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_c_api",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"), "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "C_API_OK" in r.stdout
+
+
+def test_ndarray_fp16_bit_roundtrip():
+    """fp16 Sync copies carry raw bit patterns (review repro: the
+    c_uint16 view was numerically cast, corrupting all fp16 data)."""
+    lib = _lib()
+    x16 = np.array([[1.0, -2.5], [0.25, 65504.0]], np.float16)
+    shape = (u * 2)(2, 2)
+    a = h()
+    assert lib.MXNDArrayCreate(shape, 2, 1, 0, 0, 2, ctypes.byref(a)) == 0
+    bits = x16.view(np.uint16)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        a, bits.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(x16.size)) == 0, _err(lib)
+    dt = ctypes.c_int()
+    lib.MXNDArrayGetDType(a, ctypes.byref(dt))
+    assert dt.value == 2
+    out_bits = np.zeros(4, np.uint16)
+    assert lib.MXNDArraySyncCopyToCPU(
+        a, out_bits.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(4)) == 0, _err(lib)
+    np.testing.assert_array_equal(out_bits.view(np.float16),
+                                  x16.reshape(-1))
+    lib.MXNDArrayFree(a)
+
+
+def test_op_names_stable_across_load(tmp_path):
+    """Creator handles stay valid after MXNDArrayLoad (review repro:
+    the shared scratch store dangled them)."""
+    lib = _lib()
+    n = u()
+    creators = ctypes.POINTER(h)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0
+    first = ctypes.cast(ctypes.c_void_p(creators[0]), ctypes.c_char_p).value
+    # exercise the load path (previously clobbered the name store)
+    a = _make_nd(lib, np.ones((2, 2), np.float32))
+    fname = str(tmp_path / "x.params").encode()
+    lib.MXNDArraySave(fname, 1, (h * 1)(a), (ctypes.c_char_p * 1)(b"w"))
+    nn, nsz = u(), u()
+    got = ctypes.POINTER(h)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(nn), ctypes.byref(got),
+                             ctypes.byref(nsz), ctypes.byref(names)) == 0
+    again = ctypes.cast(ctypes.c_void_p(creators[0]), ctypes.c_char_p).value
+    assert again == first, (again, first)
+    nm = ctypes.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolName(ctypes.c_void_p(creators[0]),
+                                           ctypes.byref(nm)) == 0
+    assert nm.value == first
+    lib.MXNDArrayFree(V(got[0]))
+    lib.MXNDArrayFree(a)
