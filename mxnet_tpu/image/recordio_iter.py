@@ -1,15 +1,148 @@
 """ImageRecordIter implementation backing mx.io.ImageRecordIter.
 
 Reference counterpart: ``src/io/iter_image_recordio_2.cc:724`` (OMP-parallel
-JPEG decode + augment into pinned batches). Here: the python ImageIter
-pipeline wrapped with background-thread prefetch (iter_prefetcher.h parity).
+JPEG decode + augment into pinned batches). Two tiers here:
+
+- fast path (the common training config — resize / rand_crop /
+  rand_mirror / mean / std): raw records are read serially (cheap
+  native recordio), then ``preprocess_threads`` pool workers decode and
+  augment each record straight into the preallocated batch buffer in
+  pure numpy; PIL's JPEG decoder drops the GIL, so decode scales with
+  cores exactly like the reference's OMP loop.
+- general path: the composable python ImageIter augmenter zoo.
+
+Both are wrapped with background-thread prefetch (iter_prefetcher.h
+parity) so decode overlaps device compute.
 """
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
+
 import numpy as np
 
-from ..io import DataIter, PrefetchingIter
-from .image import ImageIter
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter, PrefetchingIter
+from .image import ImageIter, imdecode_bytes
+
+
+class _FastRecordIter(DataIter):
+    """Thread-pool decode+augment of a packed RecordIO image dataset."""
+
+    def __init__(self, path_imgrec, path_imgidx, data_shape, batch_size,
+                 label_width, shuffle, resize, rand_crop, rand_mirror,
+                 mean, std, preprocess_threads, data_name, label_name,
+                 seed=0):
+        super().__init__(batch_size)
+        from .. import recordio
+
+        if not path_imgidx:
+            raise MXNetError("fast record iter requires path_imgidx")
+        self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        self._keys = list(self._rec.keys)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.resize = resize
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = None if mean is None else mean.astype(np.float32)
+        self.std = None if std is None else std.astype(np.float32)
+        self._rng = np.random.RandomState(seed)
+        self._pool = (ThreadPoolExecutor(preprocess_threads)
+                      if preprocess_threads > 1 else None)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self._order = list(self._keys)
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cur = 0
+
+    def _process(self, raw, out, i, crop_xy, mirror):
+        """decode → resize → crop → mirror → normalize, all numpy
+        (runs on a pool thread; PIL decode releases the GIL)."""
+        from PIL import Image
+
+        from .. import recordio
+
+        header, img_bytes = recordio.unpack(raw)
+        # imdecode_bytes handles JPEG/PNG and the repo's .npy payloads
+        # alike (same decode support as the general path)
+        arr = np.asarray(imdecode_bytes(img_bytes), dtype=np.uint8)
+        _, th, tw = self.data_shape
+        if self.resize:
+            h, w = arr.shape[:2]
+            if w < h:
+                size = (self.resize, int(h * self.resize / w))
+            else:
+                size = (int(w * self.resize / h), self.resize)
+            arr = np.asarray(Image.fromarray(arr).resize(size, Image.BILINEAR),
+                             dtype=np.uint8)
+        hh, ww = arr.shape[:2]
+        if hh < th or ww < tw:
+            im2 = Image.fromarray(arr).resize((max(tw, ww), max(th, hh)),
+                                              Image.BILINEAR)
+            arr = np.asarray(im2, dtype=np.uint8)
+            hh, ww = arr.shape[:2]
+        y0 = int(crop_xy[0] * (hh - th)) if self.rand_crop else (hh - th) // 2
+        x0 = int(crop_xy[1] * (ww - tw)) if self.rand_crop else (ww - tw) // 2
+        arr = arr[y0:y0 + th, x0:x0 + tw]
+        if mirror:
+            arr = arr[:, ::-1]
+        f = arr.astype(np.float32)
+        if self.mean is not None:
+            f -= self.mean
+        if self.std is not None:
+            f /= self.std
+        out[i] = f.transpose(2, 0, 1)
+        label = header.label
+        return (float(label) if np.isscalar(label) or np.ndim(label) == 0
+                else np.asarray(label, np.float32)[:self.label_width])
+
+    def next(self):
+        if self._cur >= len(self._order):
+            raise StopIteration
+        idx = self._order[self._cur:self._cur + self.batch_size]
+        self._cur += self.batch_size
+        pad = self.batch_size - len(idx)
+        if pad:
+            idx = idx + self._order[:pad]
+        raws = [self._rec.read_idx(k) for k in idx]   # serial IO: cheap
+        out = np.zeros((self.batch_size,) + self.data_shape, np.float32)
+        crops = self._rng.rand(self.batch_size, 2)
+        mirrors = (self._rng.rand(self.batch_size) < 0.5
+                   if self.rand_mirror else np.zeros(self.batch_size, bool))
+        if self._pool is not None:
+            labels = list(self._pool.map(
+                self._process, raws, [out] * len(raws), range(len(raws)),
+                crops, mirrors))
+        else:
+            labels = [self._process(r, out, i, crops[i], mirrors[i])
+                      for i, r in enumerate(raws)]
+        if self.label_width == 1:
+            blabel = np.asarray([l if np.isscalar(l) else l[0]
+                                 for l in labels], np.float32)
+        else:
+            blabel = np.stack([np.resize(np.asarray(l, np.float32),
+                                         self.label_width) for l in labels])
+        from ..ndarray.ndarray import array
+
+        return DataBatch(data=[array(out)], label=[array(blabel)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
 
 def mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b):
@@ -32,12 +165,22 @@ class ImageRecordIterImpl(DataIter):
                  path_imgidx=None, data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
         mean, std = mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b)
-        inner = ImageIter(
-            batch_size=batch_size, data_shape=tuple(data_shape), label_width=label_width,
-            path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
-            rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
-            mean=mean, std=std, data_name=data_name, label_name=label_name,
-        )
+        if path_imgidx and not kwargs:
+            # common training config: the threaded numpy fast path
+            inner = _FastRecordIter(
+                path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                data_shape=tuple(data_shape), batch_size=batch_size,
+                label_width=label_width, shuffle=shuffle, resize=resize,
+                rand_crop=rand_crop, rand_mirror=rand_mirror,
+                mean=mean, std=std, preprocess_threads=preprocess_threads,
+                data_name=data_name, label_name=label_name)
+        else:
+            inner = ImageIter(
+                batch_size=batch_size, data_shape=tuple(data_shape), label_width=label_width,
+                path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+                rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
+                mean=mean, std=std, data_name=data_name, label_name=label_name,
+            )
         self._iter = PrefetchingIter(inner)
 
     @property

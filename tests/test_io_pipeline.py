@@ -1,0 +1,109 @@
+"""ImageRecordIter pipeline: threaded fast path vs general augmenter path
+(ref: src/io/iter_image_recordio_2.cc OMP decode; SURVEY §7 hard-part #4 —
+the input pipeline must be able to feed the device).
+"""
+import io as _io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def _pack(tmp_path, n=12, edge=40):
+    from PIL import Image
+
+    prefix = str(tmp_path / "imgs")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (edge, edge, 3), np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")  # lossless: exact checks
+        header = recordio.IRHeader(0, float(i % 5), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.getvalue()))
+    rec.close()
+    return prefix
+
+
+def test_fast_path_shapes_and_labels(tmp_path):
+    prefix = _pack(tmp_path)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        batch_size=4, data_shape=(3, 32, 32), preprocess_threads=2)
+    from mxnet_tpu.image.recordio_iter import _FastRecordIter
+
+    assert isinstance(it._iter.iters[0], _FastRecordIter)
+    assert it.provide_data[0].shape == (4, 3, 32, 32)
+    seen = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        seen += 4 - batch.pad
+    assert seen == 12
+    assert sorted(labels[:12]) == sorted([float(i % 5) for i in range(12)])
+
+
+def test_fast_path_matches_general_path(tmp_path):
+    """Deterministic config (no random augment): the threaded numpy fast
+    path and the composable ImageIter path produce identical batches."""
+    prefix = _pack(tmp_path)
+    kw = dict(path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+              batch_size=3, data_shape=(3, 32, 32),
+              mean_r=123.0, mean_g=117.0, mean_b=104.0,
+              std_r=58.0, std_g=57.0, std_b=57.0)
+    fast = mx.io.ImageRecordIter(preprocess_threads=2, **kw)
+    slow = mx.io.ImageRecordIter(force_general_path=True, **kw)
+    from mxnet_tpu.image.recordio_iter import _FastRecordIter
+
+    assert isinstance(fast._iter.iters[0], _FastRecordIter)
+    assert not isinstance(slow._iter.iters[0], _FastRecordIter)
+    for bf, bs in zip(fast, slow):
+        np.testing.assert_allclose(bf.data[0].asnumpy(),
+                                   bs.data[0].asnumpy(), atol=1e-3)
+        np.testing.assert_allclose(bf.label[0].asnumpy(),
+                                   bs.label[0].asnumpy())
+
+
+def test_fast_path_augment_bounds(tmp_path):
+    """rand_crop/rand_mirror keep values within the normalized range and
+    change across epochs (stochastic augmentation is live)."""
+    prefix = _pack(tmp_path, edge=48)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        batch_size=4, data_shape=(3, 32, 32), rand_crop=True,
+        rand_mirror=True, shuffle=True, preprocess_threads=2)
+    b1 = next(iter(it)).data[0].asnumpy().copy()
+    it.reset()
+    b2 = next(iter(it)).data[0].asnumpy().copy()
+    assert b1.min() >= 0.0 and b1.max() <= 255.0
+    assert not np.allclose(b1, b2)   # different crop/order draw
+
+
+def test_bench_io_runs(tmp_path):
+    """The IO benchmark tool produces its three JSON lines (the SURVEY
+    hard-part-#4 evidence artifact; absolute rate is host-dependent)."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_io.py"),
+         "--num-images", "48", "--epochs", "1", "--batch-size", "16",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    metrics = {l["metric"] for l in lines}
+    assert {"io_pipeline_decode", "io_pipeline_feed",
+            "io_pipeline_overlap_conv"} <= metrics
+    for l in lines:
+        assert l["value"] > 0
