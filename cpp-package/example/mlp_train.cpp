@@ -1,0 +1,110 @@
+/*
+ * Train a small MLP from C++ through the header-only API
+ * (counterpart of the reference's cpp-package/example/mlp.cpp).
+ *
+ * Build:
+ *   g++ -std=c++17 mlp_train.cpp -I.. -L../../mxnet_tpu/lib \
+ *       -lmxtpu_c_api -Wl,-rpath,../../mxnet_tpu/lib -o mlp_train
+ * Run with MXNET_TPU_HOME/PYTHONPATH pointing at the repo + site-packages.
+ */
+#include <cmath>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+#include "include/mxnet-cpp/MxNetCpp.h"
+
+using namespace mxnet::cpp;
+
+int main() {
+  const int kBatch = 32, kFeat = 10, kHidden = 16, kClasses = 4;
+  auto ctx = Context::cpu();
+
+  /* net: data -> FC -> relu -> FC -> SoftmaxOutput */
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol fc1 = Operator("FullyConnected")
+                   .SetParam("num_hidden", kHidden)
+                   .SetInput("data", data)
+                   .CreateSymbol("fc1");
+  Symbol act = Operator("Activation")
+                   .SetParam("act_type", "relu")
+                   .SetInput("data", fc1)
+                   .CreateSymbol("act1");
+  Symbol fc2 = Operator("FullyConnected")
+                   .SetParam("num_hidden", kClasses)
+                   .SetInput("data", act)
+                   .CreateSymbol("fc2");
+  Symbol net = Operator("SoftmaxOutput")
+                   .SetInput("data", fc2)
+                   .SetInput("label", label)
+                   .CreateSymbol("softmax");
+
+  auto arg_shapes = net.InferArgShapes(
+      {{"data", {kBatch, kFeat}}, {"softmax_label", {kBatch}}});
+  auto arg_names = net.ListArguments();
+
+  /* synthetic linearly separable task */
+  std::mt19937 rng(7);
+  std::normal_distribution<float> norm(0.f, 1.f);
+  std::vector<float> xs(kBatch * kFeat), ys(kBatch);
+  for (int i = 0; i < kBatch; ++i) {
+    int cls = i % kClasses;
+    ys[i] = static_cast<float>(cls);
+    for (int j = 0; j < kFeat; ++j) {
+      xs[i * kFeat + j] = norm(rng) * 0.3f + (j == cls ? 2.5f : 0.f);
+    }
+  }
+
+  std::vector<NDArray> args, grads;
+  std::vector<OpReqType> reqs;
+  for (size_t i = 0; i < arg_names.size(); ++i) {
+    std::vector<float> init;
+    size_t n = 1;
+    for (mx_uint s : arg_shapes[i]) n *= s;
+    init.resize(n);
+    if (arg_names[i] == "data") {
+      init = xs;
+    } else if (arg_names[i] == "softmax_label") {
+      init = ys;
+    } else {
+      for (auto &v : init) v = norm(rng) * 0.1f;
+    }
+    args.emplace_back(init, arg_shapes[i], ctx);
+    grads.emplace_back(arg_shapes[i], ctx);
+    bool is_input = arg_names[i] == "data" || arg_names[i] == "softmax_label";
+    reqs.push_back(is_input ? kNullOp : kWriteTo);
+  }
+
+  Executor exe(net, ctx, args, grads, reqs);
+  const float lr = 0.5f;
+  float acc = 0.f;
+  for (int step = 0; step < 60; ++step) {
+    exe.Forward(true);
+    exe.Backward();
+    for (size_t i = 0; i < arg_names.size(); ++i) {
+      if (reqs[i] != kWriteTo) continue;
+      /* in-place sgd_update through the out= convention */
+      Operator op("sgd_update");
+      op.SetParam("lr", lr / kBatch);
+      op.SetInput("weight", args[i]).SetInput("grad", grads[i]);
+      std::vector<NDArray> outs = {args[i]};
+      op.Invoke(&outs);
+    }
+    if (step == 59) {
+      auto probs = exe.outputs[0].CopyToVector();
+      int correct = 0;
+      for (int i = 0; i < kBatch; ++i) {
+        int best = 0;
+        for (int c = 1; c < kClasses; ++c) {
+          if (probs[i * kClasses + c] > probs[i * kClasses + best]) best = c;
+        }
+        correct += (best == static_cast<int>(ys[i]));
+      }
+      acc = static_cast<float>(correct) / kBatch;
+    }
+  }
+  NDArray::WaitAll();
+  std::printf("CPP_MLP_OK accuracy=%.3f\n", acc);
+  return acc > 0.9f ? 0 : 1;
+}

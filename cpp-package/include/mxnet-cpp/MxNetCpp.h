@@ -1,0 +1,366 @@
+/*
+ * Header-only C++ API over the general C ABI.
+ *
+ * Reference counterpart: cpp-package/include/mxnet-cpp (8.5k LoC of
+ * generated op wrappers + hand-written NDArray/Symbol/Executor/KVStore
+ * classes over include/mxnet/c_api.h). Same idea, one header: RAII
+ * wrappers, exceptions from MXGetLastError, an Operator builder that
+ * reaches every registered op by name (the generated-wrapper surface
+ * collapses to one dynamic builder, since the op registry is already
+ * string-keyed end to end).
+ *
+ * Link against libmxtpu_c_api.so; see examples/predict and
+ * tests/test_cpp_package.py for a full build line.
+ */
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../src/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    throw std::runtime_error(MXGetLastError());
+  }
+}
+
+class Context {
+ public:
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context tpu(int id = 0) { return Context(2, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }  /* accel alias */
+  int dev_type;
+  int dev_id;
+
+ private:
+  Context(int type, int id) : dev_type(type), dev_id(id) {}
+};
+
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr, &NDArray::Release) {}
+  NDArray(const std::vector<mx_uint> &shape, const Context &ctx)
+      : handle_(nullptr, &NDArray::Release) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(), static_cast<mx_uint>(shape.size()),
+                          ctx.dev_type, ctx.dev_id, 0, 0, &h));
+    handle_ = std::shared_ptr<void>(h, &Release);
+  }
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          const Context &ctx)
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+  explicit NDArray(NDArrayHandle owned)
+      : handle_(owned, &NDArray::Release) {}
+
+  NDArrayHandle GetHandle() const { return handle_.get(); }
+
+  void SyncCopyFromCPU(const float *data, size_t size) {
+    Check(MXNDArraySyncCopyFromCPU(handle_.get(), data, size));
+  }
+  void SyncCopyToCPU(float *data, size_t size) const {
+    Check(MXNDArraySyncCopyToCPU(handle_.get(), data, size));
+  }
+  std::vector<mx_uint> GetShape() const {
+    mx_uint dim;
+    const mx_uint *pdata;
+    Check(MXNDArrayGetShape(handle_.get(), &dim, &pdata));
+    return std::vector<mx_uint>(pdata, pdata + dim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint s : GetShape()) n *= s;
+    return n;
+  }
+  std::vector<float> CopyToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+ private:
+  static void Release(NDArrayHandle h) {
+    if (h != nullptr) MXNDArrayFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+class Symbol {
+ public:
+  Symbol() : handle_(nullptr, &Symbol::Release) {}
+  explicit Symbol(SymbolHandle owned) : handle_(owned, &Symbol::Release) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  std::string ToJSON() const {
+    const char *json = nullptr;
+    Check(MXSymbolSaveToJSON(handle_.get(), &json));
+    return json;
+  }
+  std::vector<std::string> ListArguments() const {
+    return ListStrings(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return ListStrings(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return ListStrings(&MXSymbolListAuxiliaryStates);
+  }
+  /* Shape inference from named input shapes; returns arg shapes. */
+  std::vector<std::vector<mx_uint>> InferArgShapes(
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint s : kv.second) data.push_back(s);
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_sz, out_sz, aux_sz;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_d, **out_d, **aux_d;
+    int complete = 0;
+    Check(MXSymbolInferShape(
+        handle_.get(), static_cast<mx_uint>(keys.size()), keys.data(),
+        indptr.data(), data.data(), &in_sz, &in_nd, &in_d, &out_sz, &out_nd,
+        &out_d, &aux_sz, &aux_nd, &aux_d, &complete));
+    if (!complete) throw std::runtime_error("InferShape incomplete");
+    std::vector<std::vector<mx_uint>> shapes;
+    for (mx_uint i = 0; i < in_sz; ++i) {
+      shapes.emplace_back(in_d[i], in_d[i] + in_nd[i]);
+    }
+    return shapes;
+  }
+  SymbolHandle GetHandle() const { return handle_.get(); }
+
+ private:
+  template <typename F>
+  std::vector<std::string> ListStrings(F fn) const {
+    mx_uint size;
+    const char **arr;
+    Check(fn(handle_.get(), &size, &arr));
+    std::vector<std::string> out;
+    for (mx_uint i = 0; i < size; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+  static void Release(SymbolHandle h) {
+    if (h != nullptr) MXSymbolFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+/* Dynamic op builder: Operator("FullyConnected")
+ *    .SetParam("num_hidden", 4).SetInput("data", x).CreateSymbol("fc1")
+ * — the cpp-package's generated per-op wrappers, collapsed to one class
+ * (OpWrapperGenerator.py parity without codegen). */
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_name_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    keys_.push_back(key);
+    vals_.push_back(ToString(value));
+    return *this;
+  }
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_keys_.push_back(name);
+    input_syms_.push_back(sym);
+    return *this;
+  }
+  Operator &SetInput(const std::string &name, const NDArray &arr) {
+    nd_input_keys_.push_back(name);
+    nd_inputs_.push_back(arr);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name) {
+    std::vector<const char *> ks, vs;
+    for (auto &k : keys_) ks.push_back(k.c_str());
+    for (auto &v : vals_) vs.push_back(v.c_str());
+    SymbolHandle atom = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(op_name_.c_str(),
+                                     static_cast<mx_uint>(ks.size()),
+                                     ks.data(), vs.data(), &atom));
+    std::vector<const char *> iks;
+    std::vector<SymbolHandle> ias;
+    for (size_t i = 0; i < input_syms_.size(); ++i) {
+      iks.push_back(input_keys_[i].c_str());
+      ias.push_back(input_syms_[i].GetHandle());
+    }
+    Check(MXSymbolCompose(atom, name.c_str(),
+                          static_cast<mx_uint>(ias.size()), iks.data(),
+                          ias.data()));
+    return Symbol(atom);
+  }
+
+  /* imperative form: run the op on NDArray inputs right now */
+  std::vector<NDArray> Invoke() {
+    int num_out = 0;
+    NDArrayHandle *outs = nullptr;
+    DoInvoke(&num_out, &outs);
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  /* out= form: write results into existing arrays (in-place ops like
+   * sgd_update mutate their target without any host round-trip) */
+  void Invoke(std::vector<NDArray> *outputs) {
+    std::vector<NDArrayHandle> oh;
+    for (auto &a : *outputs) oh.push_back(a.GetHandle());
+    int num_out = static_cast<int>(oh.size());
+    NDArrayHandle *op_ptr = oh.data();
+    DoInvoke(&num_out, &op_ptr);
+  }
+
+ private:
+  void DoInvoke(int *num_out, NDArrayHandle **outs) {
+    std::vector<const char *> ks, vs;
+    for (auto &k : keys_) ks.push_back(k.c_str());
+    for (auto &v : vals_) vs.push_back(v.c_str());
+    std::vector<NDArrayHandle> ins;
+    for (auto &a : nd_inputs_) ins.push_back(a.GetHandle());
+    Check(MXImperativeInvoke(op_name_.c_str(),
+                             static_cast<int>(ins.size()), ins.data(),
+                             num_out, outs,
+                             static_cast<int>(ks.size()), ks.data(),
+                             vs.data()));
+  }
+
+  template <typename T>
+  static std::string ToString(const T &v) {
+    return std::to_string(v);
+  }
+  static std::string ToString(const std::string &v) { return v; }
+  static std::string ToString(const char *v) { return v; }
+
+  std::string op_name_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> input_syms_;
+  std::vector<std::string> nd_input_keys_;
+  std::vector<NDArray> nd_inputs_;
+};
+
+enum OpReqType { kNullOp = 0, kWriteTo = 1, kAddTo = 3 };
+
+class Executor {
+ public:
+  Executor(const Symbol &sym, const Context &ctx,
+           const std::vector<NDArray> &args,
+           const std::vector<NDArray> &arg_grads,
+           const std::vector<OpReqType> &grad_reqs,
+           const std::vector<NDArray> &aux = {})
+      : handle_(nullptr, &Executor::Release), args_(args),
+        arg_grads_(arg_grads) {
+    std::vector<NDArrayHandle> a, g;
+    std::vector<mx_uint> r;
+    for (auto &x : args) a.push_back(x.GetHandle());
+    for (auto &x : arg_grads) g.push_back(x.GetHandle());
+    for (auto q : grad_reqs) r.push_back(static_cast<mx_uint>(q));
+    std::vector<NDArrayHandle> ax;
+    for (auto &x : aux) ax.push_back(x.GetHandle());
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorBind(sym.GetHandle(), ctx.dev_type, ctx.dev_id,
+                         static_cast<mx_uint>(a.size()), a.data(), g.data(),
+                         r.data(), static_cast<mx_uint>(ax.size()),
+                         ax.data(), &h));
+    handle_ = std::shared_ptr<void>(h, &Release);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_.get(), is_train ? 1 : 0));
+    RefreshOutputs();
+  }
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (auto &x : head_grads) hg.push_back(x.GetHandle());
+    Check(MXExecutorBackward(handle_.get(),
+                             static_cast<mx_uint>(hg.size()),
+                             hg.empty() ? nullptr : hg.data()));
+  }
+  std::vector<NDArray> outputs;
+  const std::vector<NDArray> &arg_arrays() const { return args_; }
+  const std::vector<NDArray> &grad_arrays() const { return arg_grads_; }
+
+ private:
+  void RefreshOutputs() {
+    mx_uint n;
+    NDArrayHandle *outs;
+    Check(MXExecutorOutputs(handle_.get(), &n, &outs));
+    outputs.clear();
+    for (mx_uint i = 0; i < n; ++i) outputs.emplace_back(outs[i]);
+  }
+  static void Release(ExecutorHandle h) {
+    if (h != nullptr) MXExecutorFree(h);
+  }
+  std::shared_ptr<void> handle_;
+  std::vector<NDArray> args_, arg_grads_;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local")
+      : handle_(nullptr, &KVStore::Release) {
+    KVStoreHandle h = nullptr;
+    Check(MXKVStoreCreate(type.c_str(), &h));
+    handle_ = std::shared_ptr<void>(h, &Release);
+  }
+  void Init(const std::string &key, const NDArray &val) {
+    const char *k = key.c_str();
+    NDArrayHandle v = val.GetHandle();
+    Check(MXKVStoreInitEx(handle_.get(), 1, &k, &v));
+  }
+  void Push(const std::string &key, const NDArray &val, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle v = val.GetHandle();
+    Check(MXKVStorePushEx(handle_.get(), 1, &k, &v, priority));
+  }
+  void Pull(const std::string &key, NDArray *out, int priority = 0) {
+    const char *k = key.c_str();
+    NDArrayHandle v = out->GetHandle();
+    Check(MXKVStorePullEx(handle_.get(), 1, &k, &v, priority));
+  }
+  int GetRank() const {
+    int r;
+    Check(MXKVStoreGetRank(handle_.get(), &r));
+    return r;
+  }
+  int GetNumWorkers() const {
+    int n;
+    Check(MXKVStoreGetGroupSize(handle_.get(), &n));
+    return n;
+  }
+  void Barrier() { Check(MXKVStoreBarrier(handle_.get())); }
+
+ private:
+  static void Release(KVStoreHandle h) {
+    if (h != nullptr) MXKVStoreFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  /* MXNET_CPP_MXNETCPP_H_ */

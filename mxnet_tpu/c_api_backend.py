@@ -63,7 +63,14 @@ def ndarray_sync_copy_from(arr, ptr, size):
     if size != n:
         raise MXNetError("SyncCopyFromCPU: expected %d elements, got %d"
                          % (n, size))
-    name = _np.dtype(arr.dtype).name
+    name = _np.dtype(arr.dtype).name if arr.dtype != "bfloat16" else "bfloat16"
+    if name == "bfloat16":
+        # bf16 is reported to C as dtype id 2 (fp16): accept fp16 bits
+        bits = _np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint16)), shape=(n,))
+        data = bits.copy().view(_np.float16).astype(_np.float32)
+        arr[:] = nd.array(data.reshape(arr.shape), dtype="bfloat16")
+        return
     ct = _np.ctypeslib.as_array(
         ctypes.cast(ptr, ctypes.POINTER(_CTYPE_FROM_NAME[name])),
         shape=(n,))
@@ -79,7 +86,14 @@ def ndarray_sync_copy_to(arr, ptr, size):
     if size != n:
         raise MXNetError("SyncCopyToCPU: expected %d elements, got %d"
                          % (n, size))
-    name = _np.dtype(arr.dtype).name
+    name = _np.dtype(arr.dtype).name if arr.dtype != "bfloat16" else "bfloat16"
+    if name == "bfloat16":
+        # deliver fp16 bit patterns, matching the reported dtype id 2
+        flat = _np.asarray(arr.asnumpy(), _np.float32).reshape(-1)
+        out = _np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint16)), shape=(n,))
+        out[:] = flat.astype(_np.float16).view(_np.uint16)
+        return
     flat = _np.ascontiguousarray(arr.asnumpy()).reshape(-1)
     if name == "float16":
         flat = flat.view(_np.uint16)  # hand back raw fp16 bit patterns
@@ -125,10 +139,10 @@ def random_seed(seed):
     _rnd.seed(seed)
 
 
-def imperative_invoke(op_name, inputs, keys, vals):
+def imperative_invoke(op_name, inputs, keys, vals, outs=None):
     op = registry.get(op_name)
     attrs = op.parse_attrs(dict(zip(keys, vals)))
-    out = nd.invoke(op, list(inputs), attrs)
+    out = nd.invoke(op, list(inputs), attrs, out=outs or None)
     return out if isinstance(out, list) else [out]
 
 

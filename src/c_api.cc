@@ -139,8 +139,10 @@ int export_strings(Handle *h, PyObject *lst, mx_uint *out_size,
 std::vector<std::string> g_op_name_store;
 std::vector<const char *> g_op_name_ptrs;
 
-/* scratch for MXNDArrayLoad's name list (per-call, caller copies) */
-Handle g_load_store;
+/* scratch for MXNDArrayLoad's name list (per-call per-thread; the
+ * caller copies before its next Load, same contract as the handle
+ * array below) */
+thread_local Handle g_load_store;
 
 }  // namespace
 
@@ -360,16 +362,29 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle **outputs, int num_params,
                        const char **param_keys, const char **param_vals) {
   Gil gil;
+  /* reference convention (c_api_ndarray.cc:117): a non-null *outputs
+   * with *num_outputs > 0 means "write into these existing NDArrays"
+   * (how frontends implement out=); otherwise the library allocates. */
+  bool caller_out = (*outputs != nullptr && *num_outputs > 0);
   PyObject *ins = handle_list(inputs, num_inputs);
   PyObject *ks = str_list(param_keys, num_params);
   PyObject *vs = str_list(param_vals, num_params);
-  PyObject *r = call("imperative_invoke", "(sOOO)",
-                     static_cast<const char *>(creator), ins, ks, vs);
+  PyObject *given = caller_out ? handle_list(*outputs, *num_outputs)
+                               : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = call("imperative_invoke", "(sOOOO)",
+                     static_cast<const char *>(creator), ins, ks, vs, given);
   Py_DECREF(ins);
   Py_DECREF(ks);
   Py_DECREF(vs);
+  Py_DECREF(given);
   if (r == nullptr) return -1;
   Py_ssize_t n = PyList_Size(r);
+  if (caller_out) {
+    /* results were written into the caller's arrays in place */
+    *num_outputs = static_cast<int>(n);
+    Py_DECREF(r);
+    return 0;
+  }
   static thread_local std::vector<NDArrayHandle> outs;
   outs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {

@@ -402,3 +402,22 @@ def test_op_names_stable_across_load(tmp_path):
     assert nm.value == first
     lib.MXNDArrayFree(V(got[0]))
     lib.MXNDArrayFree(a)
+
+
+def test_imperative_invoke_out_convention():
+    """Caller-provided outputs are written in place (reference out=
+    convention, c_api_ndarray.cc:117) — no reallocation."""
+    lib = _lib()
+    w = _make_nd(lib, np.ones((4,), np.float32))
+    g = _make_nd(lib, np.full((4,), 0.5, np.float32))
+    keys = (ctypes.c_char_p * 1)(b"lr")
+    vals = (ctypes.c_char_p * 1)(b"0.1")
+    n_out = ctypes.c_int(1)
+    out_arr = (h * 1)(w)
+    outs = ctypes.cast(out_arr, ctypes.POINTER(h))
+    assert lib.MXImperativeInvoke(
+        ctypes.c_char_p(b"sgd_update"), 2, (h * 2)(w, g),
+        ctypes.byref(n_out), ctypes.byref(outs), 1, keys, vals) == 0, _err(lib)
+    np.testing.assert_allclose(_to_np(lib, w), 1.0 - 0.1 * 0.5, rtol=1e-6)
+    for a in (w, g):
+        lib.MXNDArrayFree(a)

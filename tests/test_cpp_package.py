@@ -1,0 +1,39 @@
+"""Header-only C++ API (ref: cpp-package/ — NDArray/Symbol/Operator/
+Executor/KVStore wrappers over the C ABI). Compiles and runs the C++
+MLP training example; it must actually learn."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cpp_mlp_trains(tmp_path):
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src"), "capi"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("c_api build failed: " + r.stderr[-400:])
+    exe = str(tmp_path / "mlp_train")
+    r = subprocess.run(
+        ["g++", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example", "mlp_train.cpp"),
+         "-I", os.path.join(ROOT, "cpp-package"),
+         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_c_api",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"),
+         "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2500:]
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=420)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2500:]
+    assert "CPP_MLP_OK" in r.stdout
+    acc = float(r.stdout.split("accuracy=")[1].split()[0])
+    assert acc > 0.9, r.stdout
