@@ -1,0 +1,137 @@
+"""TensorBoard metric-logging callback (ref:
+python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+The event-file writer is pluggable: tensorboardX / torch.utils.
+tensorboard when available, else a built-in minimal writer that emits
+genuine TF-format event files (record framing + scalar summary protos
+hand-encoded — no TF dependency), so ``tensorboard --logdir`` works in
+this image too.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+
+def _masked_crc32c(data: bytes) -> int:
+    """CRC32C with the TFRecord masking (the event-file framing checksum)."""
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+_CRC_TABLE = []
+
+
+def _crc32c(buf: bytes) -> int:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    crc = 0xFFFFFFFF
+    for b in buf:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    # two's-complement 64-bit encode: negative steps (common sentinel -1)
+    # must terminate, matching protobuf int64 varint semantics
+    n &= (1 << 64) - 1
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _proto_field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _scalar_event(tag: str, value: float, step: int, wall: float) -> bytes:
+    """Hand-encoded Event{wall_time, step, summary{value{tag, simple_value}}}."""
+    tag_b = tag.encode()
+    sv = _proto_field(1, 2) + _varint(len(tag_b)) + tag_b
+    sv += _proto_field(2, 5) + struct.pack("<f", float(value))
+    summary_value = _proto_field(1, 2) + _varint(len(sv)) + sv
+    event = _proto_field(1, 1) + struct.pack("<d", wall)
+    event += _proto_field(2, 0) + _varint(int(step))
+    event += _proto_field(5, 2) + _varint(len(summary_value)) + summary_value
+    return event
+
+
+class _MiniEventWriter:
+    """Minimal TF event-file writer (record framing per TFRecord spec)."""
+
+    _seq = 0
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        # timestamp alone collides when two writers start within a second
+        # (train+eval callbacks on one logdir): disambiguate by pid+seq
+        _MiniEventWriter._seq += 1
+        fname = "events.out.tfevents.%d.%d.%d.mxtpu" % (
+            int(time.time()), os.getpid(), _MiniEventWriter._seq)
+        self._f = open(os.path.join(logdir, fname), "ab")
+        self._write_event(_proto_field(1, 1) + struct.pack("<d", time.time()))
+
+    def _write_event(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc32c(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc32c(payload)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event(_scalar_event(tag, value, global_step, time.time()))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logdir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(logdir)
+    except Exception:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(logdir)
+    except Exception:
+        pass
+    return _MiniEventWriter(logdir)
+
+
+class LogMetricsCallback:
+    """Batch-end callback writing eval metrics as TensorBoard scalars
+    (ref: contrib/tensorboard.py LogMetricsCallback.__call__)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

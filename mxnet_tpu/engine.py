@@ -124,7 +124,16 @@ def create(kind=None, num_threads=None):
     if kind in ("ThreadedEngine", "ThreadedEnginePerDevice"):
         try:
             return ThreadedEngine(num_threads)
-        except MXNetError:
+        except MXNetError as e:
+            # a broken native build must be loud, not a silent slowdown
+            # (opting out via MXNET_TPU_NO_NATIVE=1 is intentional: quiet)
+            if os.environ.get("MXNET_TPU_NO_NATIVE", "0") != "1":
+                import logging
+
+                logging.getLogger("mxnet_tpu").warning(
+                    "native ThreadedEngine unavailable (%s); falling back "
+                    "to NaiveEngine — rebuild src/ (make -C src) or set "
+                    "MXNET_TPU_NO_NATIVE=1 to opt out explicitly", e)
             return NaiveEngine(num_threads)
     if kind == "NaiveEngine":
         return NaiveEngine(num_threads)

@@ -12,6 +12,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Strip the axon TPU plugin path from PYTHONPATH: the CPU-only suite never
+# needs the remote device, and the plugin's connection loop can stall every
+# spawned subprocess for minutes when the tunnel is congested.
+_pp = os.environ.get("PYTHONPATH", "")
+if "axon" in _pp:
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in _pp.split(os.pathsep) if "axon" not in p)
+
 # The axon TPU plugin (sitecustomize in /root/.axon_site) force-registers
 # itself ahead of the env var; config.update is the authoritative override.
 import jax  # noqa: E402

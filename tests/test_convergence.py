@@ -44,6 +44,7 @@ def _lenet():
 def test_module_conv_converges():
     """Module.fit on a conv net reaches >=0.99 val accuracy
     (ref: tests/python/train/test_conv.py accuracy assert)."""
+    np.random.seed(11)   # Xavier draws from global state: keep it fixed
     xt, yt = _synth_images(2000, seed=0)
     xv, yv = _synth_images(500, seed=1)
     train = mx.io.NDArrayIter(xt, yt, batch_size=50, shuffle=True,
@@ -75,10 +76,11 @@ def test_gluon_hybrid_conv_converges():
             gluon.nn.Flatten(),
             gluon.nn.Dense(64, activation="relu"),
             gluon.nn.Dense(10))
+    np.random.seed(12)
     net.initialize(mx.init.Xavier())
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1, "momentum": 0.9})
+                            {"learning_rate": 0.05, "momentum": 0.9})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
     xt, yt = _synth_images(2000, seed=2)
@@ -107,6 +109,7 @@ def test_module_fit_tpu_kvstore_matches_local():
     """Data-parallel fused-SPMD fit (kvstore='tpu', 8-device CPU mesh)
     reaches the same accuracy bar as the single-device path — the
     dist-convergence-parity claim of BASELINE.md in miniature."""
+    np.random.seed(13)
     xt, yt = _synth_images(2000, seed=4)
     xv, yv = _synth_images(400, seed=5)
     train = mx.io.NDArrayIter(xt, yt, batch_size=64, shuffle=True,

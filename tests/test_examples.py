@@ -27,7 +27,7 @@ def _run(script, *argv, timeout=420):
 
 def test_train_mnist_mlp():
     out = _run("image-classification/train_mnist.py",
-               "--num-epochs", "2", "--num-examples", "1500")
+               "--num-epochs", "2", "--num-examples", "1000")
     acc = float(re.search(r"final validation accuracy: ([0-9.]+)", out).group(1))
     assert acc > 0.9, out[-1500:]
 
@@ -39,7 +39,7 @@ def test_gluon_mnist():
 
 
 def test_lstm_bucketing():
-    out = _run("rnn/lstm_bucketing.py", "--num-epochs", "3")
+    out = _run("rnn/lstm_bucketing.py", "--num-epochs", "2")
     ppl = [float(m) for m in re.findall(r"perplexity=([0-9.]+)", out)]
     assert len(ppl) >= 2 and ppl[-1] < ppl[0], out[-1500:]
 
@@ -53,7 +53,7 @@ def test_model_parallel_lstm():
 
 def test_sparse_linear():
     out = _run("sparse/linear_classification.py",
-               "--epochs", "5", "--num-examples", "600", "--dim", "1000")
+               "--epochs", "4", "--num-examples", "500", "--dim", "800")
     accs = [float(m) for m in re.findall(r"train accuracy ([0-9.]+)", out)]
     assert accs[-1] > 0.8, out[-1500:]
 
