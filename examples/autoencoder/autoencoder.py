@@ -1,0 +1,121 @@
+"""Deep autoencoder with layerwise pretraining then fine-tuning
+(counterpart: example/autoencoder/). Demonstrates unsupervised training
+through the symbolic API: each layer pretrains as a one-layer
+autoencoder on the previous layer's codes, then the stacked model
+fine-tunes end to end (the reference's model.py two-phase recipe).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import nd
+
+
+def synth_data(n, dim=64, k=8, seed=0):
+    """Data on a k-dimensional linear manifold + noise — reconstructable
+    exactly iff the bottleneck learns the manifold."""
+    rng = np.random.RandomState(seed)
+    basis = rng.randn(k, dim).astype(np.float32)
+    codes = rng.randn(n, k).astype(np.float32)
+    return codes @ basis / np.sqrt(k) + 0.01 * rng.randn(n, dim).astype(np.float32)
+
+
+def make_ae(in_dim, hidden):
+    data = mx.sym.var("data")
+    enc = mx.sym.FullyConnected(data, num_hidden=hidden, name="enc")
+    enc = mx.sym.Activation(enc, act_type="tanh")
+    dec = mx.sym.FullyConnected(enc, num_hidden=in_dim, name="dec")
+    return mx.sym.LinearRegressionOutput(dec, mx.sym.var("label"),
+                                         name="recon")
+
+
+def train_module(sym, x, y, epochs, lr, batch, arg_params=None):
+    it = mx.io.NDArrayIter(x, y, batch, shuffle=True, label_name="label")
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=("label",),
+                        context=mx.tpu(0))
+    mod.fit(it, optimizer="adam", optimizer_params={"learning_rate": lr},
+            initializer=mx.init.Xavier(), arg_params=arg_params,
+            allow_missing=arg_params is not None, num_epoch=epochs)
+    return mod
+
+
+def encode(mod, x):
+    """Run just the encoder half of a trained AE module (the activation
+    right after the 'enc' FullyConnected, picked from get_internals —
+    the reference extract_feature pattern)."""
+    internals = mod.symbol.get_internals()
+    outs = internals.list_outputs()
+    name = next(n for n in outs if "activation" in n and n.endswith("_output"))
+    enc_sym = internals[outs.index(name)]
+    args, _ = mod.get_params()
+    exe_args = {k: v for k, v in args.items() if k in enc_sym.list_arguments()}
+    exe_args["data"] = nd.array(x)
+    exe = enc_sym.bind(mx.tpu(0), exe_args)
+    return exe.forward()[0].asnumpy()
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--layers", type=int, nargs="+", default=[32, 8])
+    p.add_argument("--pretrain-epochs", type=int, default=8)
+    p.add_argument("--finetune-epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-examples", type=int, default=1536)
+    args = p.parse_args()
+    np.random.seed(0)
+
+    x = synth_data(args.num_examples, args.dim)
+    baseline = float((x ** 2).mean())
+
+    # --- layerwise pretraining ---
+    codes = x
+    weights = []
+    for i, hidden in enumerate(args.layers):
+        mod = train_module(make_ae(codes.shape[1], hidden), codes, codes,
+                           args.pretrain_epochs, 3e-3, args.batch_size)
+        arg_params, _ = mod.get_params()
+        weights.append(arg_params)
+        codes = encode(mod, codes)
+        print("pretrained layer %d: code dim %d" % (i, codes.shape[1]))
+
+    # --- stacked fine-tune ---
+    data = mx.sym.var("data")
+    h = data
+    for i, hidden in enumerate(args.layers):
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(h, num_hidden=hidden, name="enc%d" % i),
+            act_type="tanh")
+    for i, hidden in enumerate(reversed(args.layers[:-1])):
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(h, num_hidden=hidden, name="dec%d" % i),
+            act_type="tanh")
+    h = mx.sym.FullyConnected(h, num_hidden=args.dim, name="out")
+    stacked = mx.sym.LinearRegressionOutput(h, mx.sym.var("label"),
+                                            name="recon")
+    # warm-start the stack from the layerwise-pretrained weights: encoder
+    # i from layer i's encoder; the mirrored decoders walk back down
+    pretrained = {}
+    n_layers = len(weights)
+    for i, w in enumerate(weights):
+        pretrained["enc%d_weight" % i] = w["enc_weight"]
+        pretrained["enc%d_bias" % i] = w["enc_bias"]
+    for j in range(n_layers - 1):
+        src = weights[n_layers - 1 - j]
+        pretrained["dec%d_weight" % j] = src["dec_weight"]
+        pretrained["dec%d_bias" % j] = src["dec_bias"]
+    pretrained["out_weight"] = weights[0]["dec_weight"]
+    pretrained["out_bias"] = weights[0]["dec_bias"]
+    mod = train_module(stacked, x, x, args.finetune_epochs, 3e-3,
+                       args.batch_size, arg_params=pretrained)
+
+    it = mx.io.NDArrayIter(x, x, args.batch_size, label_name="label")
+    mse = dict(mod.score(it, mx.metric.MSE()))["mse"]
+    print("reconstruction mse %.5f (data power %.3f, ratio %.4f)"
+          % (mse, baseline, mse / baseline))
+    print("AE_%s" % ("OK" if mse / baseline < 0.15 else "WEAK"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""DCGAN on synthetic images (counterpart: example/gan/dcgan.py).
+
+Generator = Deconvolution stack, discriminator = Convolution stack,
+alternating gluon/autograd updates — exercises transposed-conv
+gradients and two-optimizer adversarial training end to end. The data
+distribution is a bright centered square; success = the generator's
+mean image concentrates energy in the center region.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import autograd, gluon, nd
+
+
+def real_batch(n, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, size, size).astype(np.float32) * 0.1
+    x[:, :, size // 4: 3 * size // 4, size // 4: 3 * size // 4] += 0.8
+    return x * 2 - 1  # tanh range
+
+
+def build_nets(ngf=16, ndf=16, nz=16):
+    gen = gluon.nn.HybridSequential()
+    # 1x1 -> 4x4 -> 8x8 -> 16x16
+    gen.add(gluon.nn.Conv2DTranspose(ngf * 2, 4, strides=1, padding=0,
+                                     use_bias=False),
+            gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+            gluon.nn.Conv2DTranspose(ngf, 4, strides=2, padding=1,
+                                     use_bias=False),
+            gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+            gluon.nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                     use_bias=False),
+            gluon.nn.Activation("tanh"))
+    disc = gluon.nn.HybridSequential()
+    disc.add(gluon.nn.Conv2D(ndf, 4, strides=2, padding=1),
+             gluon.nn.LeakyReLU(0.2),
+             gluon.nn.Conv2D(ndf * 2, 4, strides=2, padding=1),
+             gluon.nn.BatchNorm(), gluon.nn.LeakyReLU(0.2),
+             gluon.nn.Flatten(), gluon.nn.Dense(1))
+    return gen, disc
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-steps", type=int, default=120)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--nz", type=int, default=16)
+    p.add_argument("--seed", type=int, default=3)
+    args = p.parse_args()
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
+
+    gen, disc = build_nets(nz=args.nz)
+    gen.initialize(mx.init.Normal(0.05))
+    disc.initialize(mx.init.Normal(0.05))
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    bs = args.batch_size
+    ones, zeros = nd.ones((bs,)), nd.zeros((bs,))
+    for step in range(args.num_steps):
+        x = nd.array(real_batch(bs, seed=args.seed + step))
+        z = nd.array(np.random.randn(bs, args.nz, 1, 1).astype(np.float32))
+        # update D on real + fake
+        with autograd.record():
+            fake = gen(z)
+            d_loss = loss_fn(disc(x), ones) + loss_fn(disc(fake.detach()), zeros)
+        d_loss.backward()
+        d_tr.step(bs)
+        # update G to fool D
+        with autograd.record():
+            g_loss = loss_fn(disc(gen(z)), ones)
+        g_loss.backward()
+        g_tr.step(bs)
+        if step % 40 == 0:
+            print("step %d: d_loss %.3f g_loss %.3f"
+                  % (step, float(d_loss.mean().asnumpy()),
+                     float(g_loss.mean().asnumpy())))
+
+    z = nd.array(np.random.randn(64, args.nz, 1, 1).astype(np.float32))
+    imgs = gen(z).asnumpy()
+    center = imgs[:, :, 4:12, 4:12].mean()
+    border = (imgs.sum() - imgs[:, :, 4:12, 4:12].sum()) / (
+        imgs.size - imgs[:, :, 4:12, 4:12].size)
+    print("generated center mean %.3f vs border mean %.3f" % (center, border))
+    print("GAN_STRUCTURE_%s" % ("OK" if center > border else "WEAK"))
+
+
+if __name__ == "__main__":
+    main()

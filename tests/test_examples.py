@@ -88,3 +88,14 @@ def test_c_predict_example_compiles():
         capture_output=True, text=True)
     assert r.returncode == 0, r.stderr[-2000:]
     os.remove(exe)
+
+
+def test_dcgan():
+    out = _run("gan/dcgan.py", "--num-steps", "100")
+    assert "GAN_STRUCTURE_OK" in out, out[-1500:]
+
+
+def test_autoencoder():
+    out = _run("autoencoder/autoencoder.py", "--pretrain-epochs", "4",
+               "--finetune-epochs", "10", "--num-examples", "1024")
+    assert "AE_OK" in out, out[-1500:]
