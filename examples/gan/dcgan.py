@@ -89,7 +89,8 @@ def main():
     border = (imgs.sum() - imgs[:, :, 4:12, 4:12].sum()) / (
         imgs.size - imgs[:, :, 4:12, 4:12].size)
     print("generated center mean %.3f vs border mean %.3f" % (center, border))
-    print("GAN_STRUCTURE_%s" % ("OK" if center > border else "WEAK"))
+    # an untrained generator gives a near-zero margin; a trained one >1.5
+    print("GAN_STRUCTURE_%s" % ("OK" if center - border > 0.5 else "WEAK"))
 
 
 if __name__ == "__main__":
