@@ -83,9 +83,8 @@ def test_fast_path_augment_bounds(tmp_path):
     assert not np.allclose(b1, b2)   # different crop/order draw
 
 
-def test_bench_io_runs(tmp_path):
-    """The IO benchmark tool produces its three JSON lines (the SURVEY
-    hard-part-#4 evidence artifact; absolute rate is host-dependent)."""
+def _run_tool(script, *argv, timeout=420, clear_xla_flags=False):
+    """Run a tools/ script on the CPU platform; return parsed JSON lines."""
     import json
     import subprocess
     import sys
@@ -94,16 +93,33 @@ def test_bench_io_runs(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    if clear_xla_flags:
+        env.pop("XLA_FLAGS", None)
     r = subprocess.run(
-        [sys.executable, os.path.join(root, "tools", "bench_io.py"),
-         "--num-images", "48", "--epochs", "1", "--batch-size", "16",
-         "--workdir", str(tmp_path)],
-        capture_output=True, text=True, timeout=420, env=env)
+        [sys.executable, os.path.join(root, "tools", script)] + list(argv),
+        capture_output=True, text=True, timeout=timeout, env=env)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
-    lines = [json.loads(l) for l in r.stdout.splitlines()
-             if l.startswith("{")]
+    return [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
+
+
+def test_bench_io_runs(tmp_path):
+    """The IO benchmark tool produces its three JSON lines (the SURVEY
+    hard-part-#4 evidence artifact; absolute rate is host-dependent)."""
+    lines = _run_tool("bench_io.py", "--num-images", "48", "--epochs", "1",
+                      "--batch-size", "16", "--workdir", str(tmp_path))
     metrics = {l["metric"] for l in lines}
     assert {"io_pipeline_decode", "io_pipeline_feed",
             "io_pipeline_overlap_conv"} <= metrics
     for l in lines:
         assert l["value"] > 0
+
+
+def test_bandwidth_tool_runs():
+    """tools/bandwidth.py (ref: tools/bandwidth measure.py) reports all
+    four collectives over a virtual mesh."""
+    lines = _run_tool("bandwidth.py", "--devices", "2", "--size-mb", "1",
+                      "--iters", "3", timeout=300, clear_xla_flags=True)
+    metrics = {l["metric"] for l in lines}
+    assert metrics == {"collective_psum", "collective_all_gather",
+                       "collective_reduce_scatter", "collective_ppermute"}
+    assert all(l["value"] > 0 for l in lines)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Collective-bandwidth microbenchmark over the device mesh.
+
+Reference counterpart: ``tools/bandwidth/measure.py`` (kvstore push/pull
+bandwidth across GPUs/machines). TPU-native: times the XLA collectives
+the framework's gradient sync actually compiles to — psum (allreduce),
+all_gather, reduce_scatter, ppermute (the ring-attention primitive) —
+over the active mesh, and reports algorithmic bandwidth per collective.
+
+On the CPU test mesh the numbers are memcpy-bound but exercise the same
+programs; on a real slice they measure ICI.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size-mb", type=float, default=16.0,
+                   help="payload per device, MiB (fp32)")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--devices", type=int, default=0,
+                   help="0 = all visible devices")
+    args = p.parse_args()
+
+    if args.devices:
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            "--xla_force_host_platform_device_count=%d" % args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = args.devices or len(devs)
+    devs = devs[:n]
+    if n < 2:
+        print(json.dumps({"error": "need >=2 devices (got %d); set "
+                          "--devices with JAX_PLATFORMS=cpu" % n}))
+        return
+    mesh = Mesh(np.asarray(devs), ("x",))
+    elems = int(args.size_mb * (1 << 20) // 4)
+    elems -= elems % n
+    x = jax.device_put(
+        jnp.arange(n * elems, dtype=jnp.float32).reshape(n, elems),
+        NamedSharding(mesh, P("x", None)))
+
+    from jax.experimental.shard_map import shard_map
+
+    def timed(name, fn, bytes_moved):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(x))  # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = f(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        print(json.dumps({
+            "metric": "collective_%s" % name, "unit": "GB/s",
+            "value": round(bytes_moved / dt / 1e9, 2),
+            "payload_mb": round(elems * 4 / (1 << 20), 1),
+            "devices": n, "ms": round(dt * 1e3, 3)}))
+
+    sm = lambda fn: shard_map(fn, mesh=mesh, in_specs=P("x", None),
+                              out_specs=P("x", None))
+    smr = lambda fn: shard_map(fn, mesh=mesh, in_specs=P("x", None),
+                               out_specs=P(None))
+    payload = elems * 4  # per-device bytes
+
+    # allreduce: ring moves 2(n-1)/n of the payload per device
+    timed("psum", smr(lambda a: jax.lax.psum(a, "x")),
+          2 * (n - 1) / n * payload)
+    # all_gather: (n-1)/n per device
+    timed("all_gather",
+          shard_map(lambda a: jax.lax.all_gather(a, "x", tiled=True),
+                    mesh=mesh, in_specs=P("x", None), out_specs=P(None),
+                    check_rep=False),
+          (n - 1) / n * payload * n)
+    # reduce_scatter
+    timed("reduce_scatter",
+          shard_map(lambda a: jax.lax.psum_scatter(
+              a, "x", scatter_dimension=1, tiled=True),
+              mesh=mesh, in_specs=P("x", None), out_specs=P("x", None)),
+          (n - 1) / n * payload)
+    # ppermute ring step (the ring-attention primitive)
+    timed("ppermute",
+          sm(lambda a: jax.lax.ppermute(
+              a, "x", [(i, (i + 1) % n) for i in range(n)])),
+          payload)
+
+
+if __name__ == "__main__":
+    main()
