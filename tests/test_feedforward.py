@@ -1,0 +1,104 @@
+"""FeedForward legacy estimator (ref: python/mxnet/model.py:434) —
+numpy-in/numpy-out fit/predict/score and the two-artifact save/load."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _problem(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 10).astype(np.float32)
+    w = rng.randn(10, 3).astype(np.float32)
+    return x, np.argmax(x @ w, 1).astype(np.float32)
+
+
+def _net():
+    data = mx.sym.var("data")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3, name="fc"), name="softmax")
+
+
+def test_feedforward_fit_score_predict_roundtrip(tmp_path):
+    x, y = _problem()
+    model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=8,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=40)
+    model.fit(x, y)
+
+    it = mx.io.NDArrayIter(x, y, 40, label_name="softmax_label")
+    acc = model.score(it)
+    assert acc > 0.9, acc
+
+    pred = model.predict(x[:40])
+    assert pred.shape == (40, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu())
+    it.reset()
+    acc2 = loaded.score(it)
+    assert abs(acc - acc2) < 1e-6
+
+
+def test_feedforward_create_with_iter():
+    x, y = _problem(seed=1)
+    it = mx.io.NDArrayIter(x, y, 50, shuffle=True,
+                           label_name="softmax_label")
+    model = mx.model.FeedForward.create(_net(), it, ctx=mx.cpu(),
+                                        num_epoch=6, optimizer="sgd",
+                                        learning_rate=0.5,
+                                        initializer=mx.init.Xavier())
+    val = mx.io.NDArrayIter(x, y, 50, label_name="softmax_label")
+    assert model.score(val) > 0.9
+
+
+def test_feedforward_fit_after_score(tmp_path):
+    """fit() after predict/score must rebind for training (review repro:
+    the cached inference-bound module made fit a no-op/crash)."""
+    x, y = _problem(seed=2)
+    model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=2,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=40)
+    model.fit(x, y)
+    prefix = str(tmp_path / "ff2")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 2, ctx=mx.cpu(), num_epoch=6,
+                                       optimizer="sgd", learning_rate=0.5)
+    loaded.begin_epoch = 0
+    it = mx.io.NDArrayIter(x, y, 40, label_name="softmax_label")
+    before = loaded.score(it)
+    loaded.fit(x, y)            # must actually train, not no-op
+    it.reset()
+    after = loaded.score(it)
+    assert after >= before - 1e-6
+    w0 = model.arg_params["fc_weight"].asnumpy()
+    w1 = loaded.arg_params["fc_weight"].asnumpy()
+    assert not np.allclose(w0, w1)   # params moved
+
+
+def test_feedforward_num_epoch_required():
+    import pytest
+
+    x, y = _problem(seed=3)
+    model = mx.model.FeedForward(_net(), ctx=mx.cpu())
+    with pytest.raises(mx.MXNetError):
+        model.fit(x, y)
+
+
+def test_feedforward_return_data_and_composite_metric():
+    x, y = _problem(seed=4)
+    model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=4,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=50)
+    model.fit(x, y)
+    it = mx.io.NDArrayIter(x, y, 50, label_name="softmax_label")
+    outs, datas, labels = model.predict(it, return_data=True)
+    assert outs.shape == (400, 3) and datas.shape == (400, 10)
+    assert labels.shape == (400,)
+    it.reset()
+    values = model.score(it, eval_metric=["acc", "mse"])
+    assert isinstance(values, list) and len(values) == 2
