@@ -83,8 +83,9 @@ def test_fast_path_augment_bounds(tmp_path):
     assert not np.allclose(b1, b2)   # different crop/order draw
 
 
-def _run_tool(script, *argv, timeout=420, clear_xla_flags=False):
-    """Run a tools/ script on the CPU platform; return parsed JSON lines."""
+def _run_tool(script, *argv, timeout=420, clear_xla_flags=False, raw=False):
+    """Run a tools/ script on the CPU platform; return parsed JSON lines
+    (or raw stdout with raw=True)."""
     import json
     import subprocess
     import sys
@@ -99,6 +100,8 @@ def _run_tool(script, *argv, timeout=420, clear_xla_flags=False):
         [sys.executable, os.path.join(root, "tools", script)] + list(argv),
         capture_output=True, text=True, timeout=timeout, env=env)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    if raw:
+        return r.stdout
     return [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
 
 
@@ -123,3 +126,17 @@ def test_bandwidth_tool_runs():
     assert metrics == {"collective_psum", "collective_all_gather",
                        "collective_reduce_scatter", "collective_ppermute"}
     assert all(l["value"] > 0 for l in lines)
+
+
+def test_parse_log_tool(tmp_path):
+    """tools/parse_log.py (ref: tools/parse_log.py) turns Module.fit log
+    lines into the markdown table."""
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.612300\n"
+        "INFO:root:Epoch[0] Time cost=12.345\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.701000\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.812300\n")
+    out = _run_tool("parse_log.py", str(log), timeout=60, raw=True)
+    assert "| 0 | 0.6123 | 0.7010 | 12.3 |" in out
+    assert "| 1 | 0.8123 | - | - |" in out

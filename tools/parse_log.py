@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table.
+
+Reference counterpart: ``tools/parse_log.py``. Works on the log lines
+``Module.fit`` emits (``Epoch[N] Train-<metric>=V``,
+``Epoch[N] Validation-<metric>=V``, ``Epoch[N] Time cost=S``).
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    """-> {epoch: {"train": v, "valid": v, "time": s}} (last value wins)."""
+    pats = {
+        "train": re.compile(r".*Epoch\[(\d+)\] Train-[^=]+=([.\d]+)"),
+        "valid": re.compile(r".*Epoch\[(\d+)\] Validation-[^=]+=([.\d]+)"),
+        "time": re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)"),
+    }
+    table = {}
+    for line in lines:
+        for kind, pat in pats.items():
+            m = pat.match(line)
+            if m:
+                epoch = int(m.group(1))
+                table.setdefault(epoch, {})[kind] = float(m.group(2))
+    return table
+
+
+def render_markdown(table):
+    out = ["| epoch | train | valid | time/epoch (s) |",
+           "| --- | --- | --- | --- |"]
+    for epoch in sorted(table):
+        row = table[epoch]
+
+        def cell(k, fmt="%.4f"):
+            return fmt % row[k] if k in row else "-"
+
+        out.append("| %d | %s | %s | %s |" % (
+            epoch, cell("train"), cell("valid"), cell("time", "%.1f")))
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile", nargs=1, help="training log to parse")
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "none"])
+    args = p.parse_args()
+    with open(args.logfile[0]) as f:
+        table = parse(f.readlines())
+    if not table:
+        sys.exit("no epoch lines found")
+    if args.format == "markdown":
+        print(render_markdown(table))
+
+
+if __name__ == "__main__":
+    main()
