@@ -303,3 +303,52 @@ _DEV_TYPE_TO_ID = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3}
 def _ctx(dev_type, dev_id):
     name = {1: "cpu", 2: "tpu", 3: "cpu_pinned"}.get(int(dev_type), "cpu")
     return Context(name, int(dev_id))
+
+
+# -- autograd (ref: MXAutograd*, c_api_ndarray.cc) ---------------------------
+def autograd_set_is_recording(flag):
+    from . import autograd
+
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_is_training(flag):
+    from . import autograd
+
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_is_recording():
+    from . import autograd
+
+    return int(autograd.is_recording())
+
+
+def autograd_is_training():
+    from . import autograd
+
+    return int(autograd.is_training())
+
+
+def autograd_mark_variables(variables, gradients, grad_reqs):
+    from . import autograd
+
+    autograd.mark_variables(list(variables), list(gradients),
+                            [{0: "null", 1: "write", 3: "add"}.get(int(r), "write")
+                             for r in grad_reqs])
+
+
+def autograd_backward(heads, head_grads, retain_graph, train_mode):
+    from . import autograd
+
+    hg = list(head_grads) if head_grads else None
+    autograd.backward(list(heads), head_grads=hg,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def ndarray_get_grad(arr):
+    g = getattr(arr, "grad", None)
+    if g is None:
+        raise MXNetError("array has no gradient buffer (mark_variables first)")
+    return g

@@ -654,6 +654,84 @@ int MXExecutorFree(ExecutorHandle exe) {
   return 0;
 }
 
+/* ---------------- Autograd ---------------- */
+
+static int flag_call(const char *fn, int value, int *prev) {
+  Gil gil;
+  PyObject *r = call(fn, "(i)", value);
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return flag_call("autograd_set_is_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return flag_call("autograd_set_is_training", is_training, prev);
+}
+
+int MXAutogradIsRecording(bool *curr) {
+  Gil gil;
+  PyObject *r = call("autograd_is_recording", "()");
+  if (r == nullptr) return -1;
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsTraining(bool *curr) {
+  Gil gil;
+  PyObject *r = call("autograd_is_training", "()");
+  if (r == nullptr) return -1;
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  Gil gil;
+  PyObject *vars = handle_list(var_handles, num_var);
+  PyObject *grads = handle_list(grad_handles, num_var);
+  PyObject *reqs = uint_list(reqs_array, num_var);
+  PyObject *r = call("autograd_mark_variables", "(OOO)", vars, grads, reqs);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int train_mode) {
+  Gil gil;
+  PyObject *heads = handle_list(output_handles, num_output);
+  PyObject *ogs = ograd_handles != nullptr
+                      ? handle_list(ograd_handles, num_output)
+                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = call("autograd_backward", "(OOii)", heads, ogs,
+                     retain_graph, train_mode);
+  Py_DECREF(heads);
+  Py_DECREF(ogs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_grad", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
 /* ---------------- KVStore ---------------- */
 
 int MXKVStoreCreate(const char *type, KVStoreHandle *out) {

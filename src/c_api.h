@@ -14,6 +14,8 @@
 
 #ifdef __cplusplus
 extern "C" {
+#else
+#include <stdbool.h>
 #endif
 
 #define MXNET_DLL __attribute__((visibility("default")))
@@ -119,6 +121,21 @@ MXNET_DLL int MXExecutorBackward(ExecutorHandle exe, mx_uint len,
 MXNET_DLL int MXExecutorOutputs(ExecutorHandle exe, mx_uint *out_size,
                                 NDArrayHandle **out);
 MXNET_DLL int MXExecutorFree(ExecutorHandle exe);
+
+/* Autograd (ref: MXAutograd*, c_api_ndarray.cc) */
+MXNET_DLL int MXAutogradSetIsRecording(int is_recording, int *prev);
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int *prev);
+MXNET_DLL int MXAutogradIsRecording(bool *curr);
+MXNET_DLL int MXAutogradIsTraining(bool *curr);
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles,
+                                      mx_uint *reqs_array,
+                                      NDArrayHandle *grad_handles);
+MXNET_DLL int MXAutogradBackwardEx(mx_uint num_output,
+                                   NDArrayHandle *output_handles,
+                                   NDArrayHandle *ograd_handles,
+                                   int retain_graph, int train_mode);
+MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
 
 /* KVStore (ref: MXKVStore*, c_api.cc) */
 MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);

@@ -421,3 +421,36 @@ def test_imperative_invoke_out_convention():
     np.testing.assert_allclose(_to_np(lib, w), 1.0 - 0.1 * 0.5, rtol=1e-6)
     for a in (w, g):
         lib.MXNDArrayFree(a)
+
+
+def test_autograd_c_surface():
+    """MXAutograd* (ref: c_api_ndarray.cc): record an imperative op from
+    C, backward, and read the gradient — d(sum(x*x))/dx == 2x."""
+    lib = _lib()
+    x_np = np.array([1.0, 2.0, 3.0], np.float32)
+    x = _make_nd(lib, x_np)
+    g = _make_nd(lib, np.zeros(3, np.float32))
+
+    reqs = (u * 1)(1)  # write
+    assert lib.MXAutogradMarkVariables(1, (h * 1)(x), reqs,
+                                       (h * 1)(g)) == 0, _err(lib)
+    prev = ctypes.c_int(-1)
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    rec = ctypes.c_bool()
+    assert lib.MXAutogradIsRecording(ctypes.byref(rec)) == 0 and rec.value
+
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(h)()
+    assert lib.MXImperativeInvoke(
+        ctypes.c_char_p(b"elemwise_mul"), 2, (h * 2)(x, x),
+        ctypes.byref(n_out), ctypes.byref(outs), 0, None, None) == 0, _err(lib)
+    y = V(outs[0])
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert prev.value == 1
+
+    assert lib.MXAutogradBackwardEx(1, (h * 1)(y), None, 0, 1) == 0, _err(lib)
+    gh = h()
+    assert lib.MXNDArrayGetGrad(x, ctypes.byref(gh)) == 0, _err(lib)
+    np.testing.assert_allclose(_to_np(lib, gh), 2 * x_np, rtol=1e-6)
+    for a in (x, g, y, gh):
+        lib.MXNDArrayFree(a)
