@@ -12,13 +12,38 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
 """
 import json
+import os
 import sys
 import time
 
 BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md)
 
 
+def _device_probe_watchdog(seconds=300):
+    """Emit a diagnostic JSON line instead of hanging forever when the
+    remote TPU backend is unreachable (a wedged tunnel blocks the first
+    device touch inside a C call that never returns to the interpreter,
+    so this must be a timer *thread*, not a signal handler; normal init
+    is <60 s). Returns a cancel() callable."""
+    import threading
+
+    def _fire():
+        sys.stdout.write(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput", "value": 0.0,
+            "unit": "img/s", "vs_baseline": 0.0,
+            "error": "TPU backend initialization exceeded %ds "
+                     "(tunnel unreachable?)" % seconds}) + "\n")
+        sys.stdout.flush()
+        os._exit(3)
+
+    timer = threading.Timer(seconds, _fire)
+    timer.daemon = True
+    timer.start()
+    return timer.cancel
+
+
 def main():
+    cancel_watchdog = _device_probe_watchdog()
     import jax
     import numpy as np
 
@@ -29,6 +54,7 @@ def main():
     from mxnet_tpu.parallel.spmd import TrainStep, functional_optimizer
 
     n_dev = len(jax.devices())
+    cancel_watchdog()  # backend is up; compile/run own their time
     sym = resnet.get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224))
 
     for per_dev_batch in (256, 128, 64, 32):
