@@ -26,7 +26,13 @@ from .ops import registry
 
 # -- version / ops ----------------------------------------------------------
 def version():
-    return int(libinfo.__version__.replace(".", "")[:5].ljust(5, "0"))
+    # MXNET_VERSION convention: major*10000 + minor*100 + patch
+    # (ref include/mxnet/base.h:112-116), so C consumers' threshold
+    # checks against reference-style version numbers stay meaningful
+    parts = (libinfo.__version__.split("-")[0].split(".") + ["0", "0"])[:3]
+    major, minor, patch = (int("".join(ch for ch in p if ch.isdigit()) or 0)
+                           for p in parts)
+    return major * 10000 + minor * 100 + patch
 
 
 def list_all_op_names():

@@ -31,14 +31,16 @@ class _FastRecordIter(DataIter):
     def __init__(self, path_imgrec, path_imgidx, data_shape, batch_size,
                  label_width, shuffle, resize, rand_crop, rand_mirror,
                  mean, std, preprocess_threads, data_name, label_name,
-                 seed=0):
+                 seed=0, part_index=0, num_parts=1):
         super().__init__(batch_size)
         from .. import recordio
 
         if not path_imgidx:
             raise MXNetError("fast record iter requires path_imgidx")
         self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-        self._keys = list(self._rec.keys)
+        # DP sharding: worker k of N sees every Nth record (ref
+        # iter_image_recordio_2.cc partition by part_index/num_parts)
+        self._keys = list(self._rec.keys)[part_index::num_parts]
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -47,7 +49,10 @@ class _FastRecordIter(DataIter):
         self.rand_mirror = rand_mirror
         self.mean = None if mean is None else mean.astype(np.float32)
         self.std = None if std is None else std.astype(np.float32)
-        self._rng = np.random.RandomState(seed)
+        # mix the partition into the stream so data-parallel workers get
+        # distinct shuffle/augmentation randomness even with one seed
+        self._rng = np.random.RandomState(
+            (int(seed) * 1000003 + part_index * 8191) % (2 ** 31 - 1))
         self._pool = (ThreadPoolExecutor(preprocess_threads)
                       if preprocess_threads > 1 else None)
         self.data_name = data_name
@@ -103,10 +108,22 @@ class _FastRecordIter(DataIter):
         if mirror:
             arr = arr[:, ::-1]
         f = arr.astype(np.float32)
+        # grayscale/odd-channel decodes: coerce to data_shape's channel
+        # count instead of raising in the transpose below
+        ch = self.data_shape[0]
+        if f.ndim == 2:
+            f = f[:, :, None]
+        if f.shape[2] != ch:
+            if ch == 1:
+                f = f.mean(axis=2, keepdims=True)
+            elif f.shape[2] == 1:
+                f = np.repeat(f, ch, axis=2)
+            else:
+                f = f[:, :, :ch]
         if self.mean is not None:
-            f -= self.mean
+            f -= self.mean[:ch]
         if self.std is not None:
-            f /= self.std
+            f /= self.std[:ch]
         out[i] = f.transpose(2, 0, 1)
         label = header.label
         return (float(label) if np.isscalar(label) or np.ndim(label) == 0
@@ -162,7 +179,8 @@ class ImageRecordIterImpl(DataIter):
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False, rand_mirror=False,
                  resize=0, dtype="float32", preprocess_threads=4, prefetch_buffer=4,
-                 path_imgidx=None, data_name="data", label_name="softmax_label", **kwargs):
+                 path_imgidx=None, data_name="data", label_name="softmax_label",
+                 seed=0, part_index=0, num_parts=1, **kwargs):
         super().__init__(batch_size)
         mean, std = mean_std_arrays(mean_r, mean_g, mean_b, std_r, std_g, std_b)
         if path_imgidx and not kwargs:
@@ -173,7 +191,8 @@ class ImageRecordIterImpl(DataIter):
                 label_width=label_width, shuffle=shuffle, resize=resize,
                 rand_crop=rand_crop, rand_mirror=rand_mirror,
                 mean=mean, std=std, preprocess_threads=preprocess_threads,
-                data_name=data_name, label_name=label_name)
+                data_name=data_name, label_name=label_name,
+                seed=seed, part_index=part_index, num_parts=num_parts)
         else:
             inner = ImageIter(
                 batch_size=batch_size, data_shape=tuple(data_shape), label_width=label_width,

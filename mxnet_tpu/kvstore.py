@@ -108,6 +108,11 @@ class KVStore:
         for k, olist in zip(keys, outs):
             src = self._store[k]
             for o, rid in zip(olist, rids):
+                # unique-sort requested ids first (ref kvstore_local.h
+                # PullRowSparse does the same); the row_sparse result
+                # then satisfies the canonical unique-index invariant
+                # without the constructor summing repeated requests
+                rid = nd.array(np.unique(np.asarray(rid.asnumpy(), np.int64)))
                 taken = nd.invoke("take", [src, rid], {"axis": 0, "mode": "clip"})
                 from .ndarray.sparse import RowSparseNDArray, row_sparse_array
 

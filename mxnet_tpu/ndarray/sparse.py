@@ -153,7 +153,14 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         indices = indices if isinstance(indices, NDArray) else array(indices, ctx=ctx, dtype=np.int64)
         if shape is None:
             raise MXNetError("row_sparse_array: shape required with (data, indices)")
-        return RowSparseNDArray(data, indices, shape, ctx=ctx)
+        # user-supplied indices may repeat or be unsorted; every consumer
+        # (tostype's .at[].set densify, the lazy optimizer kernels) assumes
+        # the canonical unique-sorted invariant, so enforce it here —
+        # duplicates are summed, matching the optimizer-kernel semantics
+        vals, idx = _canonicalize(data._data(), indices._data())
+        return RowSparseNDArray(NDArray(vals, ctx=ctx),
+                                NDArray(idx.astype("int64"), ctx=ctx),
+                                shape, ctx=ctx)
     dense = arg1 if isinstance(arg1, NDArray) else array(arg1, ctx=ctx, dtype=dtype)
     return cast_storage(dense, "row_sparse")
 

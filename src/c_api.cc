@@ -62,6 +62,18 @@ Handle *wrap(PyObject *obj) {
 
 PyObject *obj(void *handle) { return static_cast<Handle *>(handle)->obj; }
 
+/* PyUnicode_AsUTF8 returns nullptr for non-str / surrogate-laden
+ * objects, and std::string(nullptr) is UB — every AsUTF8 result must
+ * pass through this check (error lands in MXGetLastError) */
+const char *safe_utf8(PyObject *o) {
+  const char *s = (o != nullptr && PyUnicode_Check(o)) ? PyUnicode_AsUTF8(o) : nullptr;
+  if (s == nullptr) {
+    capture_py_error();
+    if (g_last_error.empty()) set_error("expected str from backend");
+  }
+  return s;
+}
+
 /* call backend fn, returning new ref or nullptr (+error captured) */
 PyObject *call(const char *fn, const char *fmt, ...) {
   PyObject *mod = backend();
@@ -125,7 +137,9 @@ int export_strings(Handle *h, PyObject *lst, mx_uint *out_size,
   h->str_store.clear();
   h->str_ptrs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
-    h->str_store.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i)));
+    const char *s = safe_utf8(PyList_GET_ITEM(lst, i));
+    if (s == nullptr) return -1;
+    h->str_store.emplace_back(s);
   }
   for (auto &s : h->str_store) h->str_ptrs.push_back(s.c_str());
   *out_size = static_cast<mx_uint>(n);
@@ -182,8 +196,13 @@ int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
     if (r == nullptr) return -1;
     Py_ssize_t n = PyList_Size(r);
     for (Py_ssize_t i = 0; i < n; ++i) {
-      g_op_name_store.emplace_back(
-          PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+      const char *s = safe_utf8(PyList_GET_ITEM(r, i));
+      if (s == nullptr) {
+        g_op_name_store.clear();
+        Py_DECREF(r);
+        return -1;
+      }
+      g_op_name_store.emplace_back(s);
     }
     for (auto &sname : g_op_name_store) {
       g_op_name_ptrs.push_back(sname.c_str());
@@ -352,7 +371,10 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   }
   *out_size = static_cast<mx_uint>(n);
   *out_arr = handles.data();
-  export_strings(&g_load_store, names, out_name_size, out_names);
+  if (export_strings(&g_load_store, names, out_name_size, out_names) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   return 0;
 }
@@ -413,7 +435,12 @@ int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
   Gil gil;
   PyObject *r = call("symbol_to_json", "(O)", h->obj);
   if (r == nullptr) return -1;
-  h->json = PyUnicode_AsUTF8(r);
+  const char *s = safe_utf8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  h->json = s;
   Py_DECREF(r);
   *out_json = h->json.c_str();
   return 0;
@@ -508,7 +535,12 @@ int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
     *success = 0;
     *out = nullptr;
   } else {
-    h->json = PyUnicode_AsUTF8(r);
+    const char *s = safe_utf8(r);
+    if (s == nullptr) {
+      Py_DECREF(r);
+      return -1;
+    }
+    h->json = s;
     *out = h->json.c_str();
     *success = 1;
   }
@@ -817,7 +849,12 @@ int MXKVStoreGetType(KVStoreHandle kv, const char **out) {
   Gil gil;
   PyObject *r = call("kvstore_type", "(O)", h->obj);
   if (r == nullptr) return -1;
-  h->json = PyUnicode_AsUTF8(r);
+  const char *s = safe_utf8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  h->json = s;
   Py_DECREF(r);
   *out = h->json.c_str();
   return 0;

@@ -245,3 +245,25 @@ def test_csr_dot_vector_rhs():
     np.testing.assert_allclose(out2.asnumpy(), dense_l @ [1, 2, 3])
     out3 = csr.dot(nd.array(np.array([1., 2.], np.float32)), transpose_a=True)
     np.testing.assert_allclose(out3.asnumpy(), dense_l.T @ [1, 2])
+
+
+def test_row_sparse_array_duplicate_indices_canonicalized():
+    """User-supplied duplicate row indices are summed at construction so
+    densify (.at[].set) and optimizer kernels (sum) agree (ADVICE r2)."""
+    data = np.array([[1., 2.], [10., 20.], [3., 4.]], np.float32)
+    idx = np.array([1, 0, 1], np.int64)
+    rsp = mx.nd.sparse.row_sparse_array((data, idx), shape=(3, 2))
+    assert rsp.indices.asnumpy().tolist() == [0, 1]
+    np.testing.assert_allclose(rsp.data.asnumpy(),
+                               [[10., 20.], [4., 6.]])
+    dense = rsp.tostype("default").asnumpy()
+    np.testing.assert_allclose(dense, [[10., 20.], [4., 6.], [0., 0.]])
+
+
+def test_c_api_version_encoding():
+    """version() follows major*10000+minor*100+patch (ref base.h:112)."""
+    from mxnet_tpu import c_api_backend, libinfo
+
+    parts = libinfo.__version__.split("-")[0].split(".")
+    expect = int(parts[0]) * 10000 + int(parts[1]) * 100 + int(parts[2])
+    assert c_api_backend.version() == expect
