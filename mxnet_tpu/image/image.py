@@ -26,6 +26,19 @@ except ImportError:
     _HAS_PIL = False
 
 
+def partition_rng_and_shard(seed, part_index, num_parts, keys):
+    """Shared DP-sharding contract for the image iterators: validate the
+    partition, derive a per-worker RNG seed (partition mixed in so
+    data-parallel workers diverge from one base seed), and shard the
+    record keys worker k of N -> every Nth record (ref
+    iter_image_recordio_2.cc partition behavior)."""
+    if not 0 <= part_index < num_parts:
+        raise MXNetError("part_index %d out of range for num_parts %d"
+                         % (part_index, num_parts))
+    mixed = (int(seed) * 1000003 + part_index * 8191) % (2 ** 31 - 1)
+    return mixed, list(keys)[part_index::num_parts]
+
+
 def imdecode_bytes(buf, iscolor=1):
     """Decode encoded image bytes to HWC uint8 numpy array."""
     if isinstance(buf, memoryview):
@@ -284,7 +297,7 @@ class ImageIter(object):
     def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
                  path_imglist=None, path_root=None, path_imgidx=None, shuffle=False,
                  part_index=0, num_parts=1, aug_list=None, imglist=None,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label", seed=0, **kwargs):
         from ..io import DataBatch, DataDesc
 
         assert path_imgrec or path_imglist or imglist or path_root
@@ -324,14 +337,16 @@ class ImageIter(object):
                      "brightness", "contrast", "saturation", "pca_noise", "inter_method")
         })
         self.cur = 0
-        self.seq = list(self.imgidx)
+        mixed, self.seq = partition_rng_and_shard(seed, part_index,
+                                                  num_parts, self.imgidx)
+        self._rand = random.Random(mixed)
         if shuffle:
-            random.shuffle(self.seq)
+            self._rand.shuffle(self.seq)
 
     def reset(self):
         self.cur = 0
         if self.shuffle:
-            random.shuffle(self.seq)
+            self._rand.shuffle(self.seq)
 
     def __iter__(self):
         return self

@@ -38,21 +38,20 @@ class _FastRecordIter(DataIter):
         if not path_imgidx:
             raise MXNetError("fast record iter requires path_imgidx")
         self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-        # DP sharding: worker k of N sees every Nth record (ref
-        # iter_image_recordio_2.cc partition by part_index/num_parts)
-        self._keys = list(self._rec.keys)[part_index::num_parts]
+        from .image import partition_rng_and_shard
+
+        mixed_seed, self._keys = partition_rng_and_shard(
+            seed, part_index, num_parts, self._rec.keys)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
         self.resize = resize
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
-        self.mean = None if mean is None else mean.astype(np.float32)
-        self.std = None if std is None else std.astype(np.float32)
-        # mix the partition into the stream so data-parallel workers get
-        # distinct shuffle/augmentation randomness even with one seed
-        self._rng = np.random.RandomState(
-            (int(seed) * 1000003 + part_index * 8191) % (2 ** 31 - 1))
+        ch = tuple(data_shape)[0]
+        self.mean = None if mean is None else np.resize(mean.astype(np.float32), ch)
+        self.std = None if std is None else np.resize(std.astype(np.float32), ch)
+        self._rng = np.random.RandomState(mixed_seed)
         self._pool = (ThreadPoolExecutor(preprocess_threads)
                       if preprocess_threads > 1 else None)
         self.data_name = data_name
@@ -116,14 +115,15 @@ class _FastRecordIter(DataIter):
         if f.shape[2] != ch:
             if ch == 1:
                 f = f.mean(axis=2, keepdims=True)
-            elif f.shape[2] == 1:
-                f = np.repeat(f, ch, axis=2)
+            elif f.shape[2] < ch:
+                reps = -(-ch // f.shape[2])  # tile up then trim
+                f = np.tile(f, (1, 1, reps))[:, :, :ch]
             else:
                 f = f[:, :, :ch]
         if self.mean is not None:
-            f -= self.mean[:ch]
+            f -= self.mean
         if self.std is not None:
-            f /= self.std[:ch]
+            f /= self.std
         out[i] = f.transpose(2, 0, 1)
         label = header.label
         return (float(label) if np.isscalar(label) or np.ndim(label) == 0
@@ -199,6 +199,8 @@ class ImageRecordIterImpl(DataIter):
                 path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
                 rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
                 mean=mean, std=std, data_name=data_name, label_name=label_name,
+                seed=seed, part_index=part_index, num_parts=num_parts,
+                **kwargs,
             )
         self._iter = PrefetchingIter(inner)
 

@@ -114,10 +114,13 @@ class KVStore:
                 # without the constructor summing repeated requests
                 rid = nd.array(np.unique(np.asarray(rid.asnumpy(), np.int64)))
                 taken = nd.invoke("take", [src, rid], {"axis": 0, "mode": "clip"})
-                from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+                from .ndarray.sparse import RowSparseNDArray
 
                 if isinstance(o, RowSparseNDArray):
-                    newo = row_sparse_array((taken, rid.astype(np.int64)), shape=src.shape, ctx=o.ctx)
+                    # rid is already unique-sorted above — construct
+                    # directly, skipping row_sparse_array's re-canonicalize
+                    newo = RowSparseNDArray(taken, rid.astype(np.int64),
+                                            src.shape, ctx=o.ctx)
                     o._rebind_sparse(newo)
                 else:
                     # dense out: scatter rows into place, others zero

@@ -62,17 +62,7 @@ Handle *wrap(PyObject *obj) {
 
 PyObject *obj(void *handle) { return static_cast<Handle *>(handle)->obj; }
 
-/* PyUnicode_AsUTF8 returns nullptr for non-str / surrogate-laden
- * objects, and std::string(nullptr) is UB — every AsUTF8 result must
- * pass through this check (error lands in MXGetLastError) */
-const char *safe_utf8(PyObject *o) {
-  const char *s = (o != nullptr && PyUnicode_Check(o)) ? PyUnicode_AsUTF8(o) : nullptr;
-  if (s == nullptr) {
-    capture_py_error();
-    if (g_last_error.empty()) set_error("expected str from backend");
-  }
-  return s;
-}
+using mxtpu_embed::safe_utf8;
 
 /* call backend fn, returning new ref or nullptr (+error captured) */
 PyObject *call(const char *fn, const char *fmt, ...) {
@@ -372,6 +362,8 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   *out_size = static_cast<mx_uint>(n);
   *out_arr = handles.data();
   if (export_strings(&g_load_store, names, out_name_size, out_names) != 0) {
+    for (NDArrayHandle hnd : handles) delete static_cast<Handle *>(hnd);
+    handles.clear();
     Py_DECREF(r);
     return -1;
   }

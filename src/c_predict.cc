@@ -228,9 +228,22 @@ int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
   Py_ssize_t n = PyObject_Length(lst);
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *k = PyObject_CallMethod(lst, "key", "n", i);
-    l->keys.emplace_back(PyUnicode_AsUTF8(k));
+    const char *kk = mxtpu_embed::safe_utf8(k);
+    if (kk == nullptr) {
+      Py_XDECREF(k);
+      Py_DECREF(l->obj);
+      delete l;
+      return -1;
+    }
+    l->keys.emplace_back(kk);
     Py_DECREF(k);
     PyObject *s = PyObject_CallMethod(lst, "shape", "n", i);
+    if (s == nullptr) {
+      capture_py_error();
+      Py_DECREF(l->obj);
+      delete l;
+      return -1;
+    }
     std::vector<mx_uint> shape;
     for (Py_ssize_t j = 0; j < PyTuple_Size(s); ++j) {
       shape.push_back(static_cast<mx_uint>(

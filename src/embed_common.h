@@ -38,6 +38,23 @@ inline void capture_py_error() {
   Py_XDECREF(tb);
 }
 
+/* PyUnicode_AsUTF8 returns nullptr for non-str / surrogate-laden
+ * objects, and std::string(nullptr) is UB — every AsUTF8 on a value
+ * that crosses the C boundary must pass through this check (the error
+ * lands in the MXGetLastError / MXPredGetLastError slot). */
+inline const char *safe_utf8(PyObject *o) {
+  const char *s =
+      (o != nullptr && PyUnicode_Check(o)) ? PyUnicode_AsUTF8(o) : nullptr;
+  if (s == nullptr) {
+    if (PyErr_Occurred() != nullptr) {
+      capture_py_error();
+    } else {
+      set_error("expected str from backend");
+    }
+  }
+  return s;
+}
+
 /* Interpreter bring-up. Must run before any PyGILState_Ensure: the init
  * leaves the GIL held on the calling thread, so it is released right
  * away and every entry point balances it via the Gil guard below. */

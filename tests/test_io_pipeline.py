@@ -96,9 +96,16 @@ def _run_tool(script, *argv, timeout=420, clear_xla_flags=False, raw=False):
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
     if clear_xla_flags:
         env.pop("XLA_FLAGS", None)
-    r = subprocess.run(
-        [sys.executable, os.path.join(root, "tools", script)] + list(argv),
-        capture_output=True, text=True, timeout=timeout, env=env)
+    cmd = [sys.executable, os.path.join(root, "tools", script)] + list(argv)
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    if r.returncode < 0:
+        # XLA's CPU Eigen pool can rarely segfault at high host contention
+        # on this 1-core machine (kernel log: tf_XLAEigen instruction-fetch
+        # faults); one retry distinguishes that infra flake from a real
+        # crash in our code, which would fail deterministically
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     if raw:
         return r.stdout
@@ -143,15 +150,15 @@ def test_parse_log_tool(tmp_path):
 
 
 def _pack_gray(tmp_path, n=6, edge=36):
-    from PIL import Image
-
+    # .npy payloads skip imdecode's convert('RGB'), so the 2-D array
+    # reaches _process as-is — the only route that hits the coercion code
     prefix = str(tmp_path / "gray")
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     rng = np.random.RandomState(1)
     for i in range(n):
-        img = rng.randint(0, 255, (edge, edge), np.uint8)  # L mode: 2-D decode
+        img = rng.randint(0, 255, (edge, edge), np.uint8)  # 2-D decode
         buf = _io.BytesIO()
-        Image.fromarray(img).save(buf, format="PNG")
+        np.save(buf, img, allow_pickle=False)
         rec.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
                                        buf.getvalue()))
     rec.close()
