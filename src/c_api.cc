@@ -9,14 +9,13 @@
  * calls (shape arrays, string lists) — same ownership discipline the
  * reference implemented with thread-local ret stores.
  */
-#include <Python.h>
+#include "embed_common.h" /* defines PY_SSIZE_T_CLEAN before Python.h */
 
 #include <cstring>
 #include <string>
 #include <vector>
 
 #include "c_api.h"
-#include "embed_common.h"
 
 namespace {
 
@@ -44,12 +43,20 @@ struct Handle {
   std::vector<std::vector<mx_uint>> shapes3[3];
   std::vector<mx_uint> ndims[3];
   std::vector<const mx_uint *> pdata[3];
+  /* infer-type scratch */
+  std::vector<int> types3[3];
   std::string json;
+  /* keepalive for pointer-returning calls (GetData host buffer,
+   * raw-bytes python object) */
+  PyObject *scratch = nullptr;
+  std::string bytes_buf;
+  std::vector<uint64_t> idx_buf;
 
   ~Handle() {
-    if (obj != nullptr) {
+    if (obj != nullptr || scratch != nullptr) {
       Gil gil;
-      Py_DECREF(obj);
+      Py_XDECREF(obj);
+      Py_XDECREF(scratch);
     }
   }
 };
@@ -147,6 +154,169 @@ std::vector<const char *> g_op_name_ptrs;
  * caller copies before its next Load, same contract as the handle
  * array below) */
 thread_local Handle g_load_store;
+
+/* iterator-"creator" interning, mirroring the op-name store above */
+std::vector<std::string> g_iter_name_store;
+std::vector<const char *> g_iter_name_ptrs;
+
+/* thread-local string store behind the info functions (op / func /
+ * iter): valid until the thread's next info call, the reference's own
+ * ret-store contract */
+struct InfoStore {
+  std::string name, desc, kv_num_args, ret_type;
+  std::vector<std::string> store[3]; /* names, type infos, descriptions */
+  std::vector<const char *> ptrs[3];
+};
+thread_local InfoStore g_info;
+
+/* parse backend info tuple (name, desc, [names], [types], [descs], ...)
+ * into g_info; extra[0]=key_var_num_args, extra[1]=return_type */
+int export_info(PyObject *r, const char **name, const char **description,
+                mx_uint *num_args, const char ***arg_names,
+                const char ***arg_type_infos, const char ***arg_descriptions,
+                const char **key_var_num_args, const char **return_type) {
+  const char *n = safe_utf8(PyTuple_GET_ITEM(r, 0));
+  const char *d = safe_utf8(PyTuple_GET_ITEM(r, 1));
+  if (n == nullptr || d == nullptr) return -1;
+  g_info.name = n;
+  g_info.desc = d;
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyTuple_GET_ITEM(r, 2 + g);
+    g_info.store[g].clear();
+    g_info.ptrs[g].clear();
+    Py_ssize_t cnt = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < cnt; ++i) {
+      const char *s = safe_utf8(PyList_GET_ITEM(lst, i));
+      if (s == nullptr) return -1;
+      g_info.store[g].emplace_back(s);
+    }
+    for (auto &s : g_info.store[g]) g_info.ptrs[g].push_back(s.c_str());
+  }
+  *name = g_info.name.c_str();
+  *description = g_info.desc.c_str();
+  *num_args = static_cast<mx_uint>(g_info.store[0].size());
+  *arg_names = g_info.ptrs[0].data();
+  *arg_type_infos = g_info.ptrs[1].data();
+  *arg_descriptions = g_info.ptrs[2].data();
+  if (key_var_num_args != nullptr && PyTuple_Size(r) > 5) {
+    const char *kv = safe_utf8(PyTuple_GET_ITEM(r, 5));
+    if (kv == nullptr) return -1;
+    g_info.kv_num_args = kv;
+    *key_var_num_args = g_info.kv_num_args.c_str();
+  }
+  if (return_type != nullptr) {
+    g_info.ret_type = "Symbol";
+    if (PyTuple_Size(r) > 6) {
+      const char *rt = safe_utf8(PyTuple_GET_ITEM(r, 6));
+      if (rt == nullptr) return -1;
+      g_info.ret_type = rt;
+    }
+    *return_type = g_info.ret_type.c_str();
+  }
+  return 0;
+}
+
+/* C-callback trampolines: PyCFunctions whose capsule self carries the
+ * consumer's C function pointer + user data, letting backend python
+ * call straight back out (monitor callbacks, kvstore updaters). The
+ * GIL is released around the C call so the callback may re-enter the
+ * MX API. */
+struct CallbackCtx {
+  ExecutorMonitorCallback monitor = nullptr;
+  MXKVStoreUpdater *updater = nullptr;
+  MXKVStoreStrUpdater *str_updater = nullptr;
+  void *user = nullptr;
+};
+
+void callback_ctx_destroy(PyObject *capsule) {
+  delete static_cast<CallbackCtx *>(
+      PyCapsule_GetPointer(capsule, "mxtpu_cb"));
+}
+
+PyObject *monitor_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx =
+      static_cast<CallbackCtx *>(PyCapsule_GetPointer(self, "mxtpu_cb"));
+  const char *name = nullptr;
+  PyObject *arr = nullptr;
+  if (!PyArg_ParseTuple(args, "sO", &name, &arr)) return nullptr;
+  Py_INCREF(arr);
+  /* consumer owns the handle (frees with MXNDArrayFree) — the
+   * reference monitor convention (python monitor.py wraps + frees) */
+  NDArrayHandle h = wrap(arr);
+  Py_BEGIN_ALLOW_THREADS
+  ctx->monitor(name, h, ctx->user);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyObject *updater_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx =
+      static_cast<CallbackCtx *>(PyCapsule_GetPointer(self, "mxtpu_cb"));
+  PyObject *key = nullptr, *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "OOO", &key, &recv, &local)) return nullptr;
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  NDArrayHandle hr = wrap(recv);
+  NDArrayHandle hl = wrap(local);
+  if (PyUnicode_Check(key) && ctx->str_updater != nullptr) {
+    const char *ks = safe_utf8(key);
+    if (ks == nullptr) {
+      delete static_cast<Handle *>(hr);
+      delete static_cast<Handle *>(hl);
+      return nullptr;
+    }
+    std::string key_copy(ks);
+    Py_BEGIN_ALLOW_THREADS
+    ctx->str_updater(key_copy.c_str(), hr, hl, ctx->user);
+    Py_END_ALLOW_THREADS
+  } else if (ctx->updater != nullptr) {
+    long k = 0;
+    if (PyUnicode_Check(key)) {
+      const char *ks = safe_utf8(key);
+      if (ks == nullptr) {
+        delete static_cast<Handle *>(hr);
+        delete static_cast<Handle *>(hl);
+        return nullptr;
+      }
+      k = std::strtol(ks, nullptr, 10);
+    } else {
+      k = PyLong_AsLong(key);
+    }
+    Py_BEGIN_ALLOW_THREADS
+    ctx->updater(static_cast<int>(k), hr, hl, ctx->user);
+    Py_END_ALLOW_THREADS
+  } else {
+    delete static_cast<Handle *>(hr);
+    delete static_cast<Handle *>(hl);
+    PyErr_SetString(PyExc_RuntimeError, "no matching updater registered");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_monitor_def = {"mxtpu_monitor", monitor_trampoline,
+                             METH_VARARGS, nullptr};
+PyMethodDef g_updater_def = {"mxtpu_updater", updater_trampoline,
+                             METH_VARARGS, nullptr};
+
+PyObject *make_callback(PyMethodDef *def, CallbackCtx *ctx) {
+  PyObject *cap = PyCapsule_New(ctx, "mxtpu_cb", callback_ctx_destroy);
+  if (cap == nullptr) {
+    delete ctx;
+    return nullptr;
+  }
+  PyObject *fn = PyCFunction_New(def, cap);
+  Py_DECREF(cap); /* PyCFunction_New took its own reference */
+  return fn;
+}
+
+int rtc_unavailable(const char *fn) {
+  set_error(std::string(fn) +
+            ": CUDA runtime compilation is not available in the TPU build "
+            "(parity with a reference build using USE_CUDA=0; see "
+            "mxnet_tpu.rtc for the TPU-native runtime-compile path)");
+  return -1;
+}
 
 }  // namespace
 
@@ -548,15 +718,19 @@ int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
   return 0;
 }
 
-int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
-                       const mx_uint *arg_ind_ptr,
-                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
-                       const mx_uint **in_shape_ndim,
-                       const mx_uint ***in_shape_data,
-                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
-                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
-                       const mx_uint **aux_shape_ndim,
-                       const mx_uint ***aux_shape_data, int *complete) {
+static int infer_shape_impl(const char *backend_fn, SymbolHandle sym,
+                            mx_uint num_args, const char **keys,
+                            const mx_uint *arg_ind_ptr,
+                            const mx_uint *arg_shape_data,
+                            mx_uint *in_shape_size,
+                            const mx_uint **in_shape_ndim,
+                            const mx_uint ***in_shape_data,
+                            mx_uint *out_shape_size,
+                            const mx_uint **out_shape_ndim,
+                            const mx_uint ***out_shape_data,
+                            mx_uint *aux_shape_size,
+                            const mx_uint **aux_shape_ndim,
+                            const mx_uint ***aux_shape_data, int *complete) {
   auto *h = static_cast<Handle *>(sym);
   Gil gil;
   PyObject *ks = str_list(keys, num_args);
@@ -567,7 +741,7 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
         arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
   }
   PyObject *flat = uint_list(arg_shape_data, total);
-  PyObject *r = call("symbol_infer_shape", "(OOOO)", h->obj, ks, nds, flat);
+  PyObject *r = call(backend_fn, "(OOOO)", h->obj, ks, nds, flat);
   Py_DECREF(ks);
   Py_DECREF(nds);
   Py_DECREF(flat);
@@ -609,6 +783,76 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
   return 0;
 }
 
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_impl("symbol_infer_shape", sym, num_args, keys,
+                          arg_ind_ptr, arg_shape_data, in_shape_size,
+                          in_shape_ndim, in_shape_data, out_shape_size,
+                          out_shape_ndim, out_shape_data, aux_shape_size,
+                          aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_impl("symbol_infer_shape_partial", sym, num_args, keys,
+                          arg_ind_ptr, arg_shape_data, in_shape_size,
+                          in_shape_ndim, in_shape_data, out_shape_size,
+                          out_shape_ndim, out_shape_data, aux_shape_size,
+                          aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *ks = str_list(keys, num_args);
+  PyObject *ts = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(ts, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject *r = call("symbol_infer_type", "(OOO)", h->obj, ks, ts);
+  Py_DECREF(ks);
+  Py_DECREF(ts);
+  if (r == nullptr) return -1;
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  mx_uint *sizes[3] = {in_type_size, out_type_size, aux_type_size};
+  const int **data_out[3] = {in_type_data, out_type_data, aux_type_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyTuple_GET_ITEM(r, g);
+    h->types3[g].clear();
+    if (lst == Py_None) {
+      *sizes[g] = 0;
+      *data_out[g] = nullptr;
+      continue;
+    }
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      h->types3[g].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+    }
+    *sizes[g] = static_cast<mx_uint>(n);
+    *data_out[g] = h->types3[g].data();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 /* ---------------- Executor ---------------- */
 
 int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
@@ -629,6 +873,56 @@ int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
   if (r == nullptr) return -1;
   *out = wrap(r);
   return 0;
+}
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  Gil gil;
+  PyObject *mk = str_list(map_keys, num_map_keys);
+  PyObject *mt = PyList_New(num_map_keys);
+  PyObject *mi = PyList_New(num_map_keys);
+  for (mx_uint i = 0; i < num_map_keys; ++i) {
+    PyList_SET_ITEM(mt, i, PyLong_FromLong(map_dev_types[i]));
+    PyList_SET_ITEM(mi, i, PyLong_FromLong(map_dev_ids[i]));
+  }
+  PyObject *args = handle_list(in_args, len);
+  PyObject *grads = handle_list(arg_grad_store, len);
+  PyObject *reqs = uint_list(grad_req_type, len);
+  PyObject *aux = handle_list(aux_states, aux_states_len);
+  PyObject *shex = shared_exec != nullptr
+                       ? (Py_INCREF(obj(shared_exec)), obj(shared_exec))
+                       : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = call("executor_bind_x", "(OiiOOOOOOOO)", obj(sym), dev_type,
+                     dev_id, mk, mt, mi, args, grads, reqs, aux, shex);
+  Py_DECREF(mk);
+  Py_DECREF(mt);
+  Py_DECREF(mi);
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  Py_DECREF(aux);
+  Py_DECREF(shex);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  return MXExecutorBindEX(sym, dev_type, dev_id, num_map_keys, map_keys,
+                          map_dev_types, map_dev_ids, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, nullptr, out);
 }
 
 int MXExecutorForward(ExecutorHandle exe, int is_train) {
@@ -834,6 +1128,1267 @@ int MXKVStoreBarrier(KVStoreHandle kv) {
   if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;
+}
+
+/* ---------------- misc runtime ---------------- */
+
+int MXNotifyShutdown() {
+  Gil gil;
+  PyObject *r = call("notify_shutdown", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  Gil gil;
+  PyObject *r = call("set_num_omp_threads", "(i)", thread_num);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  Gil gil;
+  PyObject *r = call("engine_set_bulk_size", "(i)", bulk_size);
+  if (r == nullptr) return -1;
+  *prev_bulk_size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  Gil gil;
+  PyObject *r = call("set_profiler_config", "(is)", mode, filename);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  Gil gil;
+  PyObject *r = call("set_profiler_state", "(i)", state);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProfile() {
+  Gil gil;
+  PyObject *r = call("dump_profile", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num_vars);
+  PyObject *vs = str_list(vals, num_vars);
+  PyObject *r = call("init_ps_env", "(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- op / func info ---------------- */
+
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names, const char ***arg_type_infos,
+    const char ***arg_descriptions, const char **key_var_num_args,
+    const char **return_type) {
+  Gil gil;
+  PyObject *r = call("op_info", "(s)", static_cast<const char *>(creator));
+  if (r == nullptr) return -1;
+  int rc = export_info(r, name, description, num_args, arg_names,
+                       arg_type_infos, arg_descriptions, key_var_num_args,
+                       return_type);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  /* FunctionHandle == AtomicSymbolCreator == interned op name */
+  mx_uint n = 0;
+  const char **arr = nullptr;
+  if (MXListAllOpNames(&n, &arr) != 0) return -1;
+  *out_size = n;
+  *out_array = reinterpret_cast<FunctionHandle *>(arr);
+  return 0;
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  mx_uint n = 0;
+  const char **arr = nullptr;
+  if (MXListAllOpNames(&n, &arr) != 0) return -1;
+  for (mx_uint i = 0; i < n; ++i) {
+    if (std::strcmp(arr[i], name) == 0) {
+      *out = arr[i];
+      return 0;
+    }
+  }
+  set_error(std::string("function not found: ") + name);
+  return -1;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type) {
+  const char *kv = nullptr;
+  return MXSymbolGetAtomicSymbolInfo(fun, name, description, num_args,
+                                     arg_names, arg_type_infos,
+                                     arg_descriptions, &kv, return_type);
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  Gil gil;
+  PyObject *r =
+      call("func_describe", "(s)", static_cast<const char *>(fun));
+  if (r == nullptr) return -1;
+  *num_use_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 0)));
+  *num_scalars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 1)));
+  *num_mutate_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int func_invoke_impl(FunctionHandle fun, NDArrayHandle *use_vars,
+                            mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                            int num_params, const char **param_keys,
+                            const char **param_vals) {
+  mx_uint n_use = 0, n_scalar = 0, n_mut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0) return -1;
+  (void)scalar_args;
+  Gil gil;
+  PyObject *use = handle_list(use_vars, n_use);
+  PyObject *mut = handle_list(mutate_vars, n_mut);
+  PyObject *ks = str_list(param_keys, num_params);
+  PyObject *vs = str_list(param_vals, num_params);
+  PyObject *scal = PyList_New(0);
+  PyObject *r = call("func_invoke", "(sOOOOO)",
+                     static_cast<const char *>(fun), use, scal, mut, ks, vs);
+  Py_DECREF(use);
+  Py_DECREF(mut);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  Py_DECREF(scal);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  return func_invoke_impl(fun, use_vars, scalar_args, mutate_vars, 0, nullptr,
+                          nullptr);
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  return func_invoke_impl(fun, use_vars, scalar_args, mutate_vars, num_params,
+                          const_cast<const char **>(param_keys),
+                          const_cast<const char **>(param_vals));
+}
+
+/* ---------------- NDArray extras ---------------- */
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  return MXNDArrayCreate(shape, ndim, dev_type, dev_id, delay_alloc, dtype,
+                         out);
+}
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out) {
+  (void)delay_alloc;
+  (void)num_aux;
+  (void)aux_type;
+  (void)aux_ndims;
+  (void)aux_shape; /* aux layout is derived from stype in this design */
+  Gil gil;
+  PyObject *shp = uint_list(shape, ndim);
+  PyObject *r = call("ndarray_create_sparse", "(iOiii)", storage_type, shp,
+                     dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  /* XLA async dispatch: readiness == value materialization */
+  PyObject *r = call("ndarray_shape", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return MXNDArrayWaitAll();
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_at", "(OI)", obj(handle), idx);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_detach", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  Gil gil;
+  PyObject *r = call("ndarray_storage_type", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out_storage_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("ndarray_data_ptr", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_XDECREF(h->scratch);
+  h->scratch = PyTuple_GET_ITEM(r, 0);
+  Py_INCREF(h->scratch);
+  *out_pdata = reinterpret_cast<void *>(
+      PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_aux_type", "(OI)", obj(handle), i);
+  if (r == nullptr) return -1;
+  *out_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_aux_ndarray", "(OI)", obj(handle), i);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_data_ndarray", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  Gil gil;
+  PyObject *r = call("ndarray_set_grad_state", "(Oi)", obj(handle), state);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_grad_state", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("ndarray_save_raw_bytes", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  h->bytes_buf.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *out_size = h->bytes_buf.size();
+  *out_buf = h->bytes_buf.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("ndarray_load_from_raw_bytes", "(y#)",
+                     static_cast<const char *>(buf),
+                     static_cast<Py_ssize_t>(size));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, const int i) {
+  Gil gil;
+  PyObject *r = call("ndarray_sync_copy_from_ndarray", "(OOi)",
+                     obj(handle_dst), obj(const_cast<void *>(handle_src)), i);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  Gil gil;
+  PyObject *r = call("ndarray_sync_check_format", "(Oi)", obj(handle),
+                     static_cast<int>(full_check));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int *shared_pid,
+                                int *shared_id) {
+  Gil gil;
+  PyObject *r = call("ndarray_get_shared_mem_handle", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *shared_pid = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *shared_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint *shape, mx_uint ndim, int dtype,
+                                 NDArrayHandle *out) {
+  Gil gil;
+  PyObject *shp = uint_list(shape, ndim);
+  PyObject *r = call("ndarray_create_from_shared_mem", "(iiOi)", shared_pid,
+                     shared_id, shp, dtype);
+  Py_DECREF(shp);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  if (MXImperativeInvoke(creator, num_inputs, inputs, num_outputs, outputs,
+                         num_params, param_keys, param_vals) != 0) {
+    return -1;
+  }
+  static thread_local std::vector<int> stypes;
+  stypes.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    int st = 0;
+    if (MXNDArrayGetStorageType((*outputs)[i], &st) != 0) return -1;
+    stypes.push_back(st);
+  }
+  *out_stypes = stypes.data();
+  return 0;
+}
+
+/* ---------------- CachedOp ---------------- */
+
+int MXCreateCachedOpEx(SymbolHandle handle, int num_params, const char **keys,
+                       const char **vals, CachedOpHandle *out) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num_params);
+  PyObject *vs = str_list(vals, num_params);
+  PyObject *r = call("cached_op_create", "(OOO)", obj(handle), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  return MXCreateCachedOpEx(handle, 0, nullptr, nullptr, out);
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  Gil gil;
+  PyObject *ins = handle_list(inputs, num_inputs);
+  PyObject *r = call("cached_op_invoke", "(OO)", obj(handle), ins);
+  Py_DECREF(ins);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  static thread_local std::vector<NDArrayHandle> outs;
+  outs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outs.push_back(wrap(o));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = outs.data();
+  return 0;
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes) {
+  if (MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs, outputs) !=
+      0) {
+    return -1;
+  }
+  static thread_local std::vector<int> stypes;
+  stypes.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    int st = 0;
+    if (MXNDArrayGetStorageType((*outputs)[i], &st) != 0) return -1;
+    stypes.push_back(st);
+  }
+  *out_stypes = stypes.data();
+  return 0;
+}
+
+/* ---------------- autograd compat ---------------- */
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles,
+                              retain_graph, 1);
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+/* ---------------- Symbol extras ---------------- */
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  Gil gil;
+  PyObject *syms = handle_list(symbols, num_symbols);
+  PyObject *r = call("symbol_create_group", "(O)", syms);
+  Py_DECREF(syms);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_create_from_file", "(s)", fname);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  Gil gil;
+  PyObject *r = call("symbol_save_to_file", "(Os)", obj(symbol), fname);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  auto *h = static_cast<Handle *>(symbol);
+  Gil gil;
+  PyObject *r = call("symbol_print", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char *s = safe_utf8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  h->json = s;
+  Py_DECREF(r);
+  *out_str = h->json.c_str();
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  auto *h = static_cast<Handle *>(symbol);
+  Gil gil;
+  PyObject *r = call("symbol_get_name", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  PyObject *name = PyTuple_GET_ITEM(r, 0);
+  *success = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  if (*success != 0) {
+    const char *s = safe_utf8(name);
+    if (s == nullptr) {
+      Py_DECREF(r);
+      return -1;
+    }
+    h->json = s;
+    *out = h->json.c_str();
+  } else {
+    *out = nullptr;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_get_internals", "(O)", obj(symbol));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_get_children", "(O)", obj(symbol));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("symbol_get_output", "(OI)", obj(symbol), index);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count) {
+  Gil gil;
+  PyObject *r = call("symbol_get_num_outputs", "(O)", obj(symbol));
+  if (r == nullptr) return -1;
+  *output_count = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int export_sym_strings_fn(SymbolHandle sym, const char *fn,
+                                 mx_uint *out_size, const char ***out_array) {
+  auto *h = static_cast<Handle *>(sym);
+  Gil gil;
+  PyObject *r = call(fn, "(O)", h->obj);
+  if (r == nullptr) return -1;
+  int rc = export_strings(h, r, out_size, out_array);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  return export_sym_strings_fn(symbol, "symbol_list_attr", out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  return export_sym_strings_fn(symbol, "symbol_list_attr_shallow", out_size,
+                               out);
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  /* exact parity: the reference's MXSymbolGrad is LOG(FATAL)
+   * "not implemented" (c_api_symbolic.cc:564-568) */
+  (void)sym;
+  (void)num_wrt;
+  (void)wrt;
+  (void)out;
+  set_error("MXSymbolGrad is not implemented (reference parity: "
+            "c_api_symbolic.cc LOG(FATAL)); use MXAutogradBackwardEx or "
+            "executor backward");
+  return -1;
+}
+
+/* ---------------- Executor extras ---------------- */
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("executor_print", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  const char *s = safe_utf8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  h->json = s;
+  Py_DECREF(r);
+  *out_str = h->json.c_str();
+  return 0;
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  Gil gil;
+  PyObject *grads = handle_list(head_grads, len);
+  PyObject *r =
+      call("executor_backward_ex", "(OOi)", obj(handle), grads, is_train);
+  Py_DECREF(grads);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  Gil gil;
+  PyObject *g2ck = str_list(g2c_keys, num_g2c_keys);
+  PyObject *g2ct = PyList_New(num_g2c_keys);
+  PyObject *g2ci = PyList_New(num_g2c_keys);
+  for (mx_uint i = 0; i < num_g2c_keys; ++i) {
+    PyList_SET_ITEM(g2ct, i, PyLong_FromLong(g2c_dev_types[i]));
+    PyList_SET_ITEM(g2ci, i, PyLong_FromLong(g2c_dev_ids[i]));
+  }
+  /* grad_req four-way convention (ref c_api_executor.cc:348-380):
+   * string = (len 0, names null, types non-null, types[0] global),
+   * list = (len>0, names null), dict = (len>0, names non-null),
+   * none = types null */
+  const char *req_mode = "none";
+  mx_uint n_req_types = 0, n_req_names = 0;
+  if (provided_grad_req_types != nullptr) {
+    if (provided_grad_req_list_len == 0 &&
+        provided_grad_req_names == nullptr) {
+      req_mode = "string";
+      n_req_types = 1;
+    } else if (provided_grad_req_list_len > 0 &&
+               provided_grad_req_names == nullptr) {
+      req_mode = "list";
+      n_req_types = provided_grad_req_list_len;
+    } else if (provided_grad_req_list_len > 0) {
+      req_mode = "dict";
+      n_req_types = provided_grad_req_list_len;
+      n_req_names = provided_grad_req_list_len;
+    }
+  }
+  PyObject *reqm = PyUnicode_FromString(req_mode);
+  PyObject *reqn = str_list(provided_grad_req_names, n_req_names);
+  PyObject *reqt = str_list(provided_grad_req_types, n_req_types);
+  PyObject *shpn = str_list(provided_arg_shape_names,
+                            num_provided_arg_shapes);
+  mx_uint shp_total =
+      num_provided_arg_shapes ? provided_arg_shape_idx[num_provided_arg_shapes]
+                              : 0;
+  PyObject *shpd = uint_list(provided_arg_shape_data, shp_total);
+  PyObject *shpi = uint_list(provided_arg_shape_idx,
+                             num_provided_arg_shapes
+                                 ? num_provided_arg_shapes + 1
+                                 : 0);
+  PyObject *dtn = str_list(provided_arg_dtype_names, num_provided_arg_dtypes);
+  PyObject *dti = PyList_New(num_provided_arg_dtypes);
+  for (mx_uint i = 0; i < num_provided_arg_dtypes; ++i) {
+    PyList_SET_ITEM(dti, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  }
+  PyObject *stn = str_list(provided_arg_stype_names, num_provided_arg_stypes);
+  PyObject *sti = PyList_New(num_provided_arg_stypes);
+  for (mx_uint i = 0; i < num_provided_arg_stypes; ++i) {
+    PyList_SET_ITEM(sti, i, PyLong_FromLong(provided_arg_stypes[i]));
+  }
+  PyObject *shan = str_list(shared_arg_name_list, num_shared_arg_names);
+  mx_uint n_shared_buf =
+      (shared_buffer_len != nullptr && *shared_buffer_len > 0)
+          ? static_cast<mx_uint>(*shared_buffer_len)
+          : 0;
+  PyObject *shbn = str_list(shared_buffer_name_list, n_shared_buf);
+  PyObject *shbh = handle_list(shared_buffer_handle_list, n_shared_buf);
+  PyObject *shex = shared_exec_handle != nullptr
+                       ? (Py_INCREF(obj(shared_exec_handle)),
+                          obj(shared_exec_handle))
+                       : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = call(
+      "executor_simple_bind", "(OiiOOOOOOOOOOOOOOOOO)", obj(symbol_handle),
+      dev_type, dev_id, g2ck, g2ct, g2ci, reqm, reqn, reqt, shpn, shpd, shpi,
+      dtn, dti, stn, sti, shan, shbn, shbh, shex);
+  Py_DECREF(g2ck);
+  Py_DECREF(g2ct);
+  Py_DECREF(g2ci);
+  Py_DECREF(reqm);
+  Py_DECREF(reqn);
+  Py_DECREF(reqt);
+  Py_DECREF(shpn);
+  Py_DECREF(shpd);
+  Py_DECREF(shpi);
+  Py_DECREF(dtn);
+  Py_DECREF(dti);
+  Py_DECREF(stn);
+  Py_DECREF(sti);
+  Py_DECREF(shan);
+  Py_DECREF(shbn);
+  Py_DECREF(shbh);
+  Py_DECREF(shex);
+  if (r == nullptr) return -1;
+  /* r = (exe, in_args, arg_grads, aux) */
+  static thread_local std::vector<NDArrayHandle> s_in, s_grad, s_aux;
+  s_in.clear();
+  s_grad.clear();
+  s_aux.clear();
+  PyObject *in_lst = PyTuple_GET_ITEM(r, 1);
+  PyObject *gr_lst = PyTuple_GET_ITEM(r, 2);
+  PyObject *ax_lst = PyTuple_GET_ITEM(r, 3);
+  for (Py_ssize_t i = 0; i < PyList_Size(in_lst); ++i) {
+    PyObject *o = PyList_GET_ITEM(in_lst, i);
+    Py_INCREF(o);
+    s_in.push_back(wrap(o));
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(gr_lst); ++i) {
+    PyObject *o = PyList_GET_ITEM(gr_lst, i);
+    if (o == Py_None) {
+      s_grad.push_back(nullptr);
+    } else {
+      Py_INCREF(o);
+      s_grad.push_back(wrap(o));
+    }
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(ax_lst); ++i) {
+    PyObject *o = PyList_GET_ITEM(ax_lst, i);
+    Py_INCREF(o);
+    s_aux.push_back(wrap(o));
+  }
+  *num_in_args = static_cast<mx_uint>(s_in.size());
+  *in_args = s_in.data();
+  *arg_grads = s_grad.data();
+  *num_aux_states = static_cast<mx_uint>(s_aux.size());
+  *aux_states = s_aux.data();
+  /* shared buffer passthrough: XLA owns pooling, nothing to update */
+  if (shared_buffer_len != nullptr && *shared_buffer_len >= 0) {
+    *updated_shared_buffer_name_list = shared_buffer_name_list;
+    *updated_shared_buffer_handle_list = shared_buffer_handle_list;
+  }
+  PyObject *exe = PyTuple_GET_ITEM(r, 0);
+  Py_INCREF(exe);
+  Py_DECREF(r);
+  *out = wrap(exe);
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  Gil gil;
+  auto *ctx = new CallbackCtx();
+  ctx->monitor = callback;
+  ctx->user = callback_handle;
+  PyObject *cb = make_callback(&g_monitor_def, ctx);
+  if (cb == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject *r = call("executor_set_monitor_callback", "(OO)", obj(handle), cb);
+  Py_DECREF(cb);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- DataIter ---------------- */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  Gil gil;
+  if (g_iter_name_ptrs.empty()) {
+    PyObject *r = call("list_data_iters", "()");
+    if (r == nullptr) return -1;
+    Py_ssize_t n = PyList_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *s = safe_utf8(PyList_GET_ITEM(r, i));
+      if (s == nullptr) {
+        g_iter_name_store.clear();
+        Py_DECREF(r);
+        return -1;
+      }
+      g_iter_name_store.emplace_back(s);
+    }
+    for (auto &sname : g_iter_name_store) {
+      g_iter_name_ptrs.push_back(sname.c_str());
+    }
+    Py_DECREF(r);
+  }
+  *out_size = static_cast<mx_uint>(g_iter_name_ptrs.size());
+  *out_array =
+      reinterpret_cast<DataIterCreator *>(g_iter_name_ptrs.data());
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  Gil gil;
+  PyObject *r =
+      call("data_iter_info", "(s)", static_cast<const char *>(creator));
+  if (r == nullptr) return -1;
+  int rc = export_info(r, name, description, num_args, arg_names,
+                       arg_type_infos, arg_descriptions, nullptr, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num_param);
+  PyObject *vs = str_list(vals, num_param);
+  PyObject *r = call("data_iter_create", "(sOO)",
+                     static_cast<const char *>(creator), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = call("data_iter_next", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject *r = call("data_iter_before_first", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("data_iter_get_data", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = call("data_iter_get_label", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  Gil gil;
+  PyObject *r = call("data_iter_get_pad", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("data_iter_get_index", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  h->idx_buf.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->idx_buf.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i))));
+  }
+  Py_DECREF(r);
+  *out_index = h->idx_buf.data();
+  *out_size = static_cast<uint64_t>(h->idx_buf.size());
+  return 0;
+}
+
+/* ---------------- RecordIO ---------------- */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  Gil gil;
+  PyObject *r = call("recordio_writer_create", "(s)", uri);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+static int recordio_free(RecordIOHandle handle) {
+  {
+    Gil gil;
+    PyObject *r = call("recordio_close", "(O)", obj(handle));
+    if (r == nullptr) return -1;
+    Py_DECREF(r);
+  }
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  Gil gil;
+  PyObject *r = call("recordio_writer_write", "(Oy#)", obj(handle), buf,
+                     static_cast<Py_ssize_t>(size));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  Gil gil;
+  PyObject *r = call("recordio_writer_tell", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  Gil gil;
+  PyObject *r = call("recordio_reader_create", "(s)", uri);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size) {
+  auto *h = static_cast<Handle *>(handle);
+  Gil gil;
+  PyObject *r = call("recordio_reader_read", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    /* EOF: reference sets size 0 / null buffer */
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  h->bytes_buf.assign(data, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *buf = h->bytes_buf.data();
+  *size = h->bytes_buf.size();
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  Gil gil;
+  PyObject *r = call("recordio_reader_seek", "(OK)", obj(handle),
+                     static_cast<unsigned long long>(pos));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  Gil gil;
+  PyObject *r = call("recordio_reader_tell", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- KVStore full tier ---------------- */
+
+static PyObject *int_key_list(const int *keys, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyLong_FromLong(keys[i]));
+  }
+  return lst;
+}
+
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  Gil gil;
+  PyObject *ks = int_key_list(keys, num);
+  PyObject *vs = handle_list(vals, num);
+  PyObject *r = call("kvstore_init_int", "(OOO)", obj(kv), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  Gil gil;
+  PyObject *ks = int_key_list(keys, num);
+  PyObject *vs = handle_list(vals, num);
+  PyObject *r = call("kvstore_push_int", "(OOOi)", obj(kv), ks, vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *outs, int priority) {
+  Gil gil;
+  PyObject *ks = int_key_list(keys, num);
+  PyObject *vs = handle_list(outs, num);
+  PyObject *r = call("kvstore_pull_int", "(OOOi)", obj(kv), ks, vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int kv_pull_row_sparse_impl(KVStoreHandle kv, PyObject *ks, mx_uint num,
+                                   NDArrayHandle *vals,
+                                   const NDArrayHandle *row_ids,
+                                   int priority) {
+  PyObject *vs = handle_list(vals, num);
+  PyObject *rids =
+      handle_list(const_cast<NDArrayHandle *>(row_ids), num);
+  PyObject *r = call("kvstore_pull_row_sparse", "(OOOOi)", obj(kv), ks, vs,
+                     rids, priority);
+  Py_DECREF(vs);
+  Py_DECREF(rids);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num, const int *keys,
+                           NDArrayHandle *vals, const NDArrayHandle *row_ids,
+                           int priority) {
+  Gil gil;
+  PyObject *ks = int_key_list(keys, num);
+  int rc = kv_pull_row_sparse_impl(kv, ks, num, vals, row_ids, priority);
+  Py_DECREF(ks);
+  return rc;
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                             NDArrayHandle *vals, const NDArrayHandle *row_ids,
+                             int priority) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num);
+  int rc = kv_pull_row_sparse_impl(kv, ks, num, vals, row_ids, priority);
+  Py_DECREF(ks);
+  return rc;
+}
+
+static int kv_set_updater_impl(KVStoreHandle kv, MXKVStoreUpdater *updater,
+                               MXKVStoreStrUpdater *str_updater,
+                               void *updater_handle) {
+  Gil gil;
+  auto *ctx = new CallbackCtx();
+  ctx->updater = updater;
+  ctx->str_updater = str_updater;
+  ctx->user = updater_handle;
+  PyObject *cb = make_callback(&g_updater_def, ctx);
+  if (cb == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject *r = call("kvstore_set_updater", "(OO)", obj(kv), cb);
+  Py_DECREF(cb);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  return kv_set_updater_impl(kv, updater, nullptr, updater_handle);
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  return kv_set_updater_impl(kv, updater, str_updater, updater_handle);
+}
+
+static int kv_role_query(const char *fn, int *ret) {
+  Gil gil;
+  PyObject *r = call(fn, "()");
+  if (r == nullptr) return -1;
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  return kv_role_query("kvstore_is_worker_node", ret);
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  return kv_role_query("kvstore_is_server_node", ret);
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  return kv_role_query("kvstore_is_scheduler_node", ret);
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv,
+                                  const int barrier_before_exit) {
+  Gil gil;
+  PyObject *r = call("kvstore_set_barrier_before_exit", "(Oi)", obj(kv),
+                     barrier_before_exit);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
+                                    const char **keys, const char **vals) {
+  Gil gil;
+  PyObject *ks = str_list(keys, num_params);
+  PyObject *vs = str_list(vals, num_params);
+  PyObject *r =
+      call("kvstore_set_gradient_compression", "(OOO)", obj(kv), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                   const char *cmd_body) {
+  Gil gil;
+  PyObject *r = call("kvstore_send_command_to_servers", "(Ois)", obj(kv),
+                     cmd_id, cmd_body);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle kv, MXKVStoreServerController controller,
+                       void *controller_handle) {
+  /* serverless mesh design: no server loop to run (kvstore_server.py);
+   * return immediately, matching a worker-side no-op */
+  (void)controller;
+  (void)controller_handle;
+  Gil gil;
+  PyObject *r = call("kvstore_run_server", "(OO)", obj(kv), Py_None);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id, int *number,
+                            const int timeout_sec) {
+  Gil gil;
+  PyObject *r = call("kvstore_get_num_dead_node", "(Oii)", obj(kv), node_id,
+                     timeout_sec);
+  if (r == nullptr) return -1;
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- Rtc (CUDA-only: unavailable, reference-parity
+ * error behavior for non-CUDA builds) ---------------- */
+
+int MXRtcCreate(char *, mx_uint, mx_uint, char **, char **, NDArrayHandle *,
+                NDArrayHandle *, char *, RtcHandle *) {
+  return rtc_unavailable("MXRtcCreate");
+}
+
+int MXRtcPush(RtcHandle, mx_uint, mx_uint, NDArrayHandle *, NDArrayHandle *,
+              mx_uint, mx_uint, mx_uint, mx_uint, mx_uint, mx_uint) {
+  return rtc_unavailable("MXRtcPush");
+}
+
+int MXRtcFree(RtcHandle) { return rtc_unavailable("MXRtcFree"); }
+
+int MXRtcCudaModuleCreate(const char *, int, const char **, int,
+                          const char **, CudaModuleHandle *) {
+  return rtc_unavailable("MXRtcCudaModuleCreate");
+}
+
+int MXRtcCudaModuleFree(CudaModuleHandle) {
+  return rtc_unavailable("MXRtcCudaModuleFree");
+}
+
+int MXRtcCudaKernelCreate(CudaModuleHandle, const char *, int, int *, int *,
+                          int *, CudaKernelHandle *) {
+  return rtc_unavailable("MXRtcCudaKernelCreate");
+}
+
+int MXRtcCudaKernelFree(CudaKernelHandle) {
+  return rtc_unavailable("MXRtcCudaKernelFree");
+}
+
+int MXRtcCudaKernelCall(CudaKernelHandle, int, void **, mx_uint, mx_uint,
+                        mx_uint, mx_uint, mx_uint, mx_uint, mx_uint) {
+  return rtc_unavailable("MXRtcCudaKernelCall");
 }
 
 int MXKVStoreGetType(KVStoreHandle kv, const char **out) {

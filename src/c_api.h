@@ -20,13 +20,32 @@ extern "C" {
 
 #define MXNET_DLL __attribute__((visibility("default")))
 
+#include <stddef.h>
+#include <stdint.h>
+
 typedef unsigned int mx_uint;
 typedef float mx_float;
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+typedef void *RecordIOHandle;
+typedef void *CachedOpHandle;
+typedef void *RtcHandle;
+typedef void *CudaModuleHandle;
+typedef void *CudaKernelHandle;
 typedef const void *AtomicSymbolCreator;
+typedef const void *DataIterCreator;
+typedef const void *FunctionHandle;
+
+typedef void (*ExecutorMonitorCallback)(const char *, NDArrayHandle, void *);
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void *handle);
+typedef void(MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                  NDArrayHandle local, void *handle);
+typedef void(MXKVStoreServerController)(int head, const char *body,
+                                        void *controller_handle);
 
 MXNET_DLL const char *MXGetLastError();
 MXNET_DLL int MXGetVersion(int *out);
@@ -152,6 +171,301 @@ MXNET_DLL int MXKVStoreGetRank(KVStoreHandle kv, int *out);
 MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
 MXNET_DLL int MXKVStoreBarrier(KVStoreHandle kv);
 MXNET_DLL int MXKVStoreGetType(KVStoreHandle kv, const char **out);
+
+/* ---- round-3 surface (ref c_api.h:828-860 info, :1214-1305 DataIter,
+ * :1730-1800 RecordIO; same names/conventions) ---- */
+
+/* misc runtime */
+MXNET_DLL int MXNotifyShutdown();
+MXNET_DLL int MXSetNumOMPThreads(int thread_num);
+MXNET_DLL int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size);
+MXNET_DLL int MXSetProfilerConfig(int mode, const char *filename);
+MXNET_DLL int MXSetProfilerState(int state);
+MXNET_DLL int MXDumpProfile();
+MXNET_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals);
+
+/* op info (the binding-generator tier) */
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names, const char ***arg_type_infos,
+    const char ***arg_descriptions, const char **key_var_num_args,
+    const char **return_type);
+
+/* legacy Func tier (FunctionHandle == AtomicSymbolCreator) */
+MXNET_DLL int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+MXNET_DLL int MXGetFunction(const char *name, FunctionHandle *out);
+MXNET_DLL int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions,
+                            const char **return_type);
+MXNET_DLL int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                             int *type_mask);
+MXNET_DLL int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args, NDArrayHandle *mutate_vars);
+MXNET_DLL int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                             mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                             int num_params, char **param_keys,
+                             char **param_vals);
+
+/* NDArray extras */
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                                      mx_uint ndim, int dev_type, int dev_id,
+                                      int delay_alloc, int dtype,
+                                      mx_uint num_aux, int *aux_type,
+                                      mx_uint *aux_ndims,
+                                      const mx_uint *aux_shape,
+                                      NDArrayHandle *out);
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitToWrite(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle *out);
+MXNET_DLL int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetStorageType(NDArrayHandle handle,
+                                      int *out_storage_type);
+MXNET_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+MXNET_DLL int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i,
+                                  int *out_type);
+MXNET_DLL int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                                     NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetDataNDArray(NDArrayHandle handle,
+                                      NDArrayHandle *out);
+MXNET_DLL int MXNDArraySetGradState(NDArrayHandle handle, int state);
+MXNET_DLL int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf);
+MXNET_DLL int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out);
+MXNET_DLL int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                           const NDArrayHandle handle_src,
+                                           const int i);
+MXNET_DLL int MXNDArraySyncCheckFormat(NDArrayHandle handle,
+                                       const bool full_check);
+MXNET_DLL int MXNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                          int *shared_pid, int *shared_id);
+MXNET_DLL int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                           const mx_uint *shape, mx_uint ndim,
+                                           int dtype, NDArrayHandle *out);
+
+/* imperative invoke with storage types */
+MXNET_DLL int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                                   NDArrayHandle *inputs, int *num_outputs,
+                                   NDArrayHandle **outputs, int num_params,
+                                   const char **param_keys,
+                                   const char **param_vals,
+                                   const int **out_stypes);
+
+/* CachedOp */
+MXNET_DLL int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+MXNET_DLL int MXCreateCachedOpEx(SymbolHandle handle, int num_params,
+                                 const char **keys, const char **vals,
+                                 CachedOpHandle *out);
+MXNET_DLL int MXFreeCachedOp(CachedOpHandle handle);
+MXNET_DLL int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                               NDArrayHandle *inputs, int *num_outputs,
+                               NDArrayHandle **outputs);
+MXNET_DLL int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs,
+                                 const int **out_stypes);
+
+/* autograd compat */
+MXNET_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 NDArrayHandle *ograd_handles,
+                                 int retain_graph);
+MXNET_DLL int MXAutogradComputeGradient(mx_uint num_output,
+                                        NDArrayHandle *output_handles);
+
+/* Symbol extras */
+MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                                  SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXNET_DLL int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+MXNET_DLL int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+MXNET_DLL int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                              int *success);
+MXNET_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                                SymbolHandle *out);
+MXNET_DLL int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count);
+MXNET_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                               const char ***out);
+MXNET_DLL int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                                      const char ***out);
+MXNET_DLL int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out);
+MXNET_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size, const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete);
+MXNET_DLL int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+
+/* Executor extras */
+MXNET_DLL int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
+                              int dev_id, mx_uint num_map_keys,
+                              const char **map_keys, const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
+                               int dev_id, mx_uint num_map_keys,
+                               const char **map_keys, const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint len,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               mx_uint *grad_req_type, mx_uint aux_states_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out);
+MXNET_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+MXNET_DLL int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                                   NDArrayHandle *head_grads, int is_train);
+MXNET_DLL int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
+MXNET_DLL int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           ExecutorMonitorCallback callback,
+                                           void *callback_handle);
+
+/* DataIter C surface */
+MXNET_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+MXNET_DLL int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions);
+MXNET_DLL int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXNET_DLL int MXDataIterFree(DataIterHandle handle);
+MXNET_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXNET_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+MXNET_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
+
+/* RecordIO C surface */
+MXNET_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char *buf, size_t size);
+MXNET_DLL int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+MXNET_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         char const **buf, size_t *size);
+MXNET_DLL int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+MXNET_DLL int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* KVStore full tier */
+MXNET_DLL int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *vals, int priority);
+MXNET_DLL int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                            NDArrayHandle *outs, int priority);
+MXNET_DLL int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num,
+                                     const int *keys, NDArrayHandle *vals,
+                                     const NDArrayHandle *row_ids,
+                                     int priority);
+MXNET_DLL int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                                       const char **keys, NDArrayHandle *vals,
+                                       const NDArrayHandle *row_ids,
+                                       int priority);
+MXNET_DLL int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                                  void *updater_handle);
+MXNET_DLL int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                                    MXKVStoreStrUpdater str_updater,
+                                    void *updater_handle);
+MXNET_DLL int MXKVStoreIsWorkerNode(int *ret);
+MXNET_DLL int MXKVStoreIsServerNode(int *ret);
+MXNET_DLL int MXKVStoreIsSchedulerNode(int *ret);
+MXNET_DLL int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv,
+                                            const int barrier_before_exit);
+MXNET_DLL int MXKVStoreSetGradientCompression(KVStoreHandle kv,
+                                              mx_uint num_params,
+                                              const char **keys,
+                                              const char **vals);
+MXNET_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                             const char *cmd_body);
+MXNET_DLL int MXKVStoreRunServer(KVStoreHandle kv,
+                                 MXKVStoreServerController controller,
+                                 void *controller_handle);
+MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id,
+                                      int *number, const int timeout_sec);
+
+/* Rtc tier — CUDA runtime compilation is not available in the TPU
+ * build; these return -1 with a clear error, matching a reference
+ * build with USE_CUDA=0 (src/common/rtc.cc CHECK on CUDA). */
+MXNET_DLL int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                          char **input_names, char **output_names,
+                          NDArrayHandle *inputs, NDArrayHandle *outputs,
+                          char *kernel, RtcHandle *out);
+MXNET_DLL int MXRtcPush(RtcHandle handle, mx_uint num_input,
+                        mx_uint num_output, NDArrayHandle *inputs,
+                        NDArrayHandle *outputs, mx_uint gridDimX,
+                        mx_uint gridDimY, mx_uint gridDimZ, mx_uint blockDimX,
+                        mx_uint blockDimY, mx_uint blockDimZ);
+MXNET_DLL int MXRtcFree(RtcHandle handle);
+MXNET_DLL int MXRtcCudaModuleCreate(const char *source, int num_options,
+                                    const char **options, int num_exports,
+                                    const char **exports,
+                                    CudaModuleHandle *out);
+MXNET_DLL int MXRtcCudaModuleFree(CudaModuleHandle handle);
+MXNET_DLL int MXRtcCudaKernelCreate(CudaModuleHandle handle, const char *name,
+                                    int num_args, int *is_ndarray,
+                                    int *is_const, int *arg_types,
+                                    CudaKernelHandle *out);
+MXNET_DLL int MXRtcCudaKernelFree(CudaKernelHandle handle);
+MXNET_DLL int MXRtcCudaKernelCall(CudaKernelHandle handle, int dev_id,
+                                  void **args, mx_uint grid_dim_x,
+                                  mx_uint grid_dim_y, mx_uint grid_dim_z,
+                                  mx_uint block_dim_x, mx_uint block_dim_y,
+                                  mx_uint block_dim_z,
+                                  mx_uint shared_mem_bytes);
 
 #ifdef __cplusplus
 }

@@ -12,7 +12,7 @@
  * Thread-safety: every entry takes the GIL (PyGILState_Ensure), same
  * serialization the reference achieved with its engine push ordering.
  */
-#include <Python.h>
+#include "embed_common.h"  /* defines PY_SSIZE_T_CLEAN before Python.h */
 
 #include <cstdio>
 #include <cstring>
@@ -20,7 +20,6 @@
 #include <vector>
 
 #include "c_predict_api.h"
-#include "embed_common.h"
 
 namespace {
 

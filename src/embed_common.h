@@ -9,6 +9,10 @@
 #ifndef MXTPU_EMBED_COMMON_H_
 #define MXTPU_EMBED_COMMON_H_
 
+/* "#" length args in Py_BuildValue are Py_ssize_t (required since 3.10) */
+#ifndef PY_SSIZE_T_CLEAN
+#define PY_SSIZE_T_CLEAN
+#endif
 #include <Python.h>
 
 #include <string>
