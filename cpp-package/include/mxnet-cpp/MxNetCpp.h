@@ -95,10 +95,32 @@ class NDArray {
   std::shared_ptr<void> handle_;
 };
 
+/* Tuple-valued op attribute, rendered "(a,b,...)" for the string-kwargs
+ * C API (ref cpp-package Shape, shape.h). */
+struct Shape {
+  Shape() {}
+  explicit Shape(std::vector<mx_uint> d) : dims(std::move(d)) {}
+  Shape(mx_uint a) : dims{a} {}
+  Shape(mx_uint a, mx_uint b) : dims{a, b} {}
+  Shape(mx_uint a, mx_uint b, mx_uint c) : dims{a, b, c} {}
+  Shape(mx_uint a, mx_uint b, mx_uint c, mx_uint d) : dims{a, b, c, d} {}
+  std::string Str() const {
+    std::string s = "(";
+    for (size_t i = 0; i < dims.size(); ++i) {
+      if (i) s += ",";
+      s += std::to_string(dims[i]);
+    }
+    return s + ")";
+  }
+  std::vector<mx_uint> dims;
+};
+
 class Symbol {
  public:
   Symbol() : handle_(nullptr, &Symbol::Release) {}
   explicit Symbol(SymbolHandle owned) : handle_(owned, &Symbol::Release) {}
+
+  bool IsNull() const { return handle_ == nullptr; }
 
   static Symbol Variable(const std::string &name) {
     SymbolHandle h = nullptr;
@@ -192,6 +214,11 @@ class Operator {
     nd_inputs_.push_back(arr);
     return *this;
   }
+  /* positional (unnamed) input — var-input ops like Concat */
+  Operator &AddInput(const Symbol &sym) {
+    unnamed_syms_.push_back(sym);
+    return *this;
+  }
 
   Symbol CreateSymbol(const std::string &name) {
     std::vector<const char *> ks, vs;
@@ -207,8 +234,18 @@ class Operator {
       iks.push_back(input_keys_[i].c_str());
       ias.push_back(input_syms_[i].GetHandle());
     }
+    if (!unnamed_syms_.empty() && !input_syms_.empty()) {
+      /* positional compose would silently drop the names and rebind
+       * everything in insertion order — refuse instead */
+      throw std::runtime_error(
+          "Operator: cannot mix SetInput(name, sym) with AddInput(sym)");
+    }
+    for (const auto &s : unnamed_syms_) ias.push_back(s.GetHandle());
+    /* all-positional composition passes null keys (backend *args) */
+    const char **keys_arg =
+        unnamed_syms_.empty() ? iks.data() : nullptr;
     Check(MXSymbolCompose(atom, name.c_str(),
-                          static_cast<mx_uint>(ias.size()), iks.data(),
+                          static_cast<mx_uint>(ias.size()), keys_arg,
                           ias.data()));
     return Symbol(atom);
   }
@@ -253,11 +290,14 @@ class Operator {
   }
   static std::string ToString(const std::string &v) { return v; }
   static std::string ToString(const char *v) { return v; }
+  static std::string ToString(const Shape &v) { return v.Str(); }
+  static std::string ToString(bool v) { return v ? "true" : "false"; }
 
   std::string op_name_;
   std::vector<std::string> keys_, vals_;
   std::vector<std::string> input_keys_;
   std::vector<Symbol> input_syms_;
+  std::vector<Symbol> unnamed_syms_;
   std::vector<std::string> nd_input_keys_;
   std::vector<NDArray> nd_inputs_;
 };

@@ -409,15 +409,26 @@ def op_info(op_name):
     key_var_num_args, return_type) — ref MXSymbolGetAtomicSymbolInfo."""
     op = registry.get(op_name)
     names, types, descs = [], [], []
+    if op.var_inputs:
+        # reference convention: variable-count input is one list-typed
+        # arg ("NDArray-or-Symbol[]"); key_var_num_args (below) is the
+        # separate count attr, present only when the op declares one
+        names.append("data")
+        types.append("NDArray-or-Symbol[]")
+        descs.append("List of input symbols")
     for inp in op.input_names:
         names.append(inp)
-        types.append("NDArray-or-Symbol")
+        types.append("NDArray-or-Symbol, optional"
+                     if inp in op.optional_inputs else "NDArray-or-Symbol")
         descs.append("Input %s" % inp)
     for k in sorted(op.attr_defaults):
         names.append(k)
         types.append(_type_info_str(op.attr_defaults[k]))
         descs.append("")
-    key_var_num_args = "num_args" if op.var_inputs else ""
+    # only ops that actually declare a count attr (Concat-style) get the
+    # key_var_num_args marker; add_n-style *args ops take bare inputs
+    key_var_num_args = ("num_args" if op.var_inputs
+                        and "num_args" in op.attr_defaults else "")
     doc = op.doc.strip()
     if not doc:
         # synthesized description: what binding generators actually

@@ -57,6 +57,7 @@ class OpDef:
         "doc",
         "input_names",
         "var_inputs",
+        "optional_inputs",
         "var_attrs",
         "kwarg_input_order",
     )
@@ -90,7 +91,8 @@ class OpDef:
         # var-input ops may define how named tensor kwargs map to input
         # order (Custom: the prop's list_arguments()); set post-register
         self.kwarg_input_order = None
-        self.input_names, self.var_inputs = _input_names(fn, needs_rng)
+        self.input_names, self.var_inputs, self.optional_inputs = (
+            _input_names(fn, needs_rng))
         for n in self.input_names:
             self.attr_defaults.pop(n, None)
 
@@ -140,6 +142,7 @@ def _input_names(fn, needs_rng):
     if needs_rng and params and params[0].name == "key":
         params = params[1:]
     names = []
+    optional = []
     var = False
     for p in params:
         if p.kind is p.VAR_POSITIONAL:
@@ -147,11 +150,14 @@ def _input_names(fn, needs_rng):
             break
         if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
             break
-        if p.default is p.empty or (p.default is None and p.name in _OPTIONAL_TENSOR_NAMES):
+        if p.default is p.empty:
             names.append(p.name)
+        elif p.default is None and p.name in _OPTIONAL_TENSOR_NAMES:
+            names.append(p.name)
+            optional.append(p.name)
         else:
             break
-    return tuple(names), var
+    return tuple(names), var, frozenset(optional)
 
 
 def _kwarg_defaults(fn, needs_rng):

@@ -1,6 +1,7 @@
 """Header-only C++ API (ref: cpp-package/ — NDArray/Symbol/Operator/
-Executor/KVStore wrappers over the C ABI). Compiles and runs the C++
-MLP training example; it must actually learn."""
+Executor/KVStore wrappers over the C ABI, plus the GENERATED typed op
+wrappers in op.h). Compiles and runs the C++ training examples; they
+must actually learn."""
 import os
 import subprocess
 import sys
@@ -11,29 +12,72 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_cpp_mlp_trains(tmp_path):
-    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src"), "capi"],
-                       capture_output=True, text=True)
-    if r.returncode != 0:
-        pytest.skip("c_api build failed: " + r.stderr[-400:])
-    exe = str(tmp_path / "mlp_train")
-    r = subprocess.run(
-        ["g++", "-std=c++17",
-         os.path.join(ROOT, "cpp-package", "example", "mlp_train.cpp"),
-         "-I", os.path.join(ROOT, "cpp-package"),
-         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_c_api",
-         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"),
-         "-o", exe],
-        capture_output=True, text=True)
-    assert r.returncode == 0, r.stderr[-2500:]
+def _env():
     env = dict(os.environ)
     env["MXNET_TPU_HOME"] = ROOT
     env["PYTHONPATH"] = os.pathsep.join(
         [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
     env["JAX_PLATFORMS"] = "cpu"
-    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+    return env
+
+
+def _build_capi_or_skip():
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src"), "capi"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("c_api build failed: " + r.stderr[-400:])
+
+
+def _compile_example(src_name, out_path):
+    r = subprocess.run(
+        ["g++", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example", src_name),
+         "-I", os.path.join(ROOT, "cpp-package"),
+         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_c_api",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"),
+         "-o", out_path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2500:]
+
+
+def test_cpp_mlp_trains(tmp_path):
+    _build_capi_or_skip()
+    exe = str(tmp_path / "mlp_train")
+    _compile_example("mlp_train.cpp", exe)
+    r = subprocess.run([exe], capture_output=True, text=True, env=_env(),
                        timeout=420)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2500:]
     assert "CPP_MLP_OK" in r.stdout
     acc = float(r.stdout.split("accuracy=")[1].split()[0])
     assert acc > 0.9, r.stdout
+
+
+def test_op_wrapper_generator_in_sync(tmp_path):
+    """op.h is GENERATED from the C ABI info tier (ref
+    OpWrapperGenerator.py); the checked-in copy must match a fresh run
+    so new ops can't silently drift out of the C++ surface."""
+    _build_capi_or_skip()
+    out = str(tmp_path / "op.h")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "cpp-package", "scripts",
+                      "op_wrapper_generator.py"), out],
+        capture_output=True, text=True, env=_env(), timeout=420)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    checked_in = os.path.join(ROOT, "cpp-package", "include", "mxnet-cpp",
+                              "op.h")
+    with open(out) as f_new, open(checked_in) as f_old:
+        assert f_new.read() == f_old.read(), \
+            "op.h out of date: re-run cpp-package/scripts/op_wrapper_generator.py"
+
+
+def test_cpp_conv_trains_with_generated_wrappers(tmp_path):
+    """Conv net built from the generated typed wrappers
+    (op::Convolution/Pooling/Concat/...) compiles and learns."""
+    _build_capi_or_skip()
+    exe = str(tmp_path / "conv_train")
+    _compile_example("conv_train.cpp", exe)
+    r = subprocess.run([exe], capture_output=True, text=True, env=_env(),
+                       timeout=420)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2500:]
+    assert "CONV_TRAIN_OK" in r.stdout
