@@ -89,6 +89,29 @@ def main():
         print("epoch %d: mean |loc loss| %.4f over %d batches"
               % (epoch, tot / max(nb, 1), nb))
 
+    # evaluation: clean (un-augmented, unshuffled) iterator + detection
+    # symbol + VOC07 mAP (ref example/ssd/evaluate.py with
+    # evaluate/eval_metric.py)
+    from mxnet_tpu.contrib.eval_metric import VOC07MApMetric
+
+    eval_it = mx.io.ImageDetRecordIter(
+        path_imgrec=prefix + ".rec", batch_size=args.batch_size,
+        data_shape=(3, 300, 300), shuffle=False,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0)
+    det_mod = mx.mod.Module(
+        ssd.get_symbol(num_classes=args.num_classes, nms_thresh=0.45),
+        data_names=("data",), label_names=(), context=mx.tpu(0))
+    det_mod.bind(data_shapes=eval_it.provide_data, for_training=False)
+    arg, aux = mod.get_params()
+    det_mod.set_params(arg, aux)
+    metric = VOC07MApMetric(ovp_thresh=0.5)
+    for batch in eval_it:
+        det_mod.forward(batch, is_train=False)
+        n = batch.data[0].shape[0] - batch.pad  # skip wrap-around pads
+        metric.update([batch.label[0][:n]],
+                      [det_mod.get_outputs()[0][:n]])
+    print("VOC07 mAP: %.4f" % metric.get()[1])
+
 
 if __name__ == "__main__":
     main()

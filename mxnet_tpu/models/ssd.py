@@ -61,42 +61,45 @@ def _backbone(data):
     return feats
 
 
-def _multibox_head(feats, num_classes):
+def _multibox_head(feats, num_classes, sizes=None, ratios=None,
+                   l2norm_first=True, prefix=""):
+    """Shared per-feature-map loc/cls/anchor assembly; the layout
+    contract (transpose/Flatten/Reshape ordering) consumed by
+    MultiBoxTarget/Detection lives only here."""
+    sizes = _SIZES if sizes is None else sizes
+    ratios = _RATIOS if ratios is None else ratios
     loc_preds, cls_preds, anchors = [], [], []
     for i, feat in enumerate(feats):
-        if i == 0:
+        if i == 0 and l2norm_first:
             feat = sym.L2Normalization(data=feat, mode="channel",
                                        name="conv4_3_norm")
-        sizes, ratios = _SIZES[i], _RATIOS[i]
-        n_anchor = len(sizes) + len(ratios) - 1
+        n_anchor = len(sizes[i]) + len(ratios[i]) - 1
         loc = sym.Convolution(data=feat, kernel=(3, 3), pad=(1, 1),
                               num_filter=n_anchor * 4,
-                              name="loc_pred%d" % i)
+                              name="%sloc_pred%d" % (prefix, i))
         loc = sym.transpose(loc, axes=(0, 2, 3, 1))
         loc_preds.append(sym.Flatten(data=loc))
         cls = sym.Convolution(data=feat, kernel=(3, 3), pad=(1, 1),
                               num_filter=n_anchor * (num_classes + 1),
-                              name="cls_pred%d" % i)
+                              name="%scls_pred%d" % (prefix, i))
         cls = sym.transpose(cls, axes=(0, 2, 3, 1))
         cls = sym.Reshape(data=cls, shape=(0, -1, num_classes + 1))
         cls_preds.append(cls)
         anchors.append(sym.contrib.MultiBoxPrior(
-            feat, sizes=sizes, ratios=ratios, clip=True,
-            name="anchor%d" % i))
-    loc_pred = sym.Concat(*loc_preds, dim=1, name="multibox_loc_pred")
-    cls_pred = sym.Concat(*cls_preds, dim=1, name="multibox_cls_concat")
+            feat, sizes=sizes[i], ratios=ratios[i], clip=True,
+            name="%sanchor%d" % (prefix, i)))
+    loc_pred = sym.Concat(*loc_preds, dim=1, name=prefix + "multibox_loc_pred")
+    cls_pred = sym.Concat(*cls_preds, dim=1,
+                          name=prefix + "multibox_cls_concat")
     cls_pred = sym.transpose(cls_pred, axes=(0, 2, 1))  # (N, C+1, A)
-    anchor = sym.Concat(*anchors, dim=1, name="multibox_anchors")
+    anchor = sym.Concat(*anchors, dim=1, name=prefix + "multibox_anchors")
     return loc_pred, cls_pred, anchor
 
 
-def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
-                     nms_topk=400, **kwargs):
-    """Training symbol: outputs [cls_prob, loc_loss, cls_label]
+def _assemble_train(loc_pred, cls_pred, anchor):
+    """Training tail: MultiBoxTarget + softmax cls + smooth-L1 loc
     (ref symbol_builder.py:get_symbol_train)."""
-    data = sym.var("data")
     label = sym.var("label")
-    loc_pred, cls_pred, anchor = _multibox_head(_backbone(data), num_classes)
     box_target, box_mask, cls_target = sym.contrib.MultiBoxTarget(
         anchor, label, cls_pred, overlap_threshold=0.5,
         ignore_label=-1.0, negative_mining_ratio=3.0,
@@ -115,14 +118,60 @@ def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
     return sym.Group([cls_prob, loc_loss, cls_label])
 
 
-def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
-               nms_topk=400, **kwargs):
-    """Inference symbol: MultiBoxDetection output (N, A, 6)
+def _assemble_detect(loc_pred, cls_pred, anchor, nms_thresh, force_suppress,
+                     nms_topk):
+    """Inference tail: MultiBoxDetection output (N, A, 6)
     [cls, score, xmin, ymin, xmax, ymax] (ref get_symbol)."""
-    data = sym.var("data")
-    loc_pred, cls_pred, anchor = _multibox_head(_backbone(data), num_classes)
     cls_prob = sym.softmax(cls_pred, axis=1, name="cls_prob")
     return sym.contrib.MultiBoxDetection(
         cls_prob, loc_pred, anchor, nms_threshold=nms_thresh,
         force_suppress=force_suppress, nms_topk=nms_topk,
         variances=(0.1, 0.1, 0.2, 0.2), name="detection")
+
+
+def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
+                     nms_topk=400, **kwargs):
+    """Training symbol: outputs [cls_prob, loc_loss, cls_label]."""
+    data = sym.var("data")
+    loc_pred, cls_pred, anchor = _multibox_head(_backbone(data), num_classes)
+    return _assemble_train(loc_pred, cls_pred, anchor)
+
+
+def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
+               nms_topk=400, **kwargs):
+    """Inference symbol over the full VGG16-reduced backbone."""
+    data = sym.var("data")
+    loc_pred, cls_pred, anchor = _multibox_head(_backbone(data), num_classes)
+    return _assemble_detect(loc_pred, cls_pred, anchor, nms_thresh,
+                            force_suppress, nms_topk)
+
+
+# ---------------------------------------------------------------------------
+# Tiny detector: the same target-assign → detect → NMS chain on a
+# 3-stage backbone with one anchor layer. CPU-affordable, so the
+# end-to-end mAP evidence (train → MultiBoxDetection → VOC07MApMetric)
+# can run in CI; the full-size config above is the benchmark path.
+# ---------------------------------------------------------------------------
+def _tiny_head(data, num_classes):
+    x = data
+    for i, f in enumerate((16, 32, 64), 1):
+        x = _conv_act(x, "tconv%d" % i, f)
+        x = sym.Pooling(data=x, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", name="tpool%d" % i)
+    return _multibox_head([x], num_classes, sizes=[(0.35, 0.6)],
+                          ratios=[(1.0, 2.0, 0.5)], l2norm_first=False,
+                          prefix="t")
+
+
+def get_tiny_symbol_train(num_classes=2, **kwargs):
+    data = sym.var("data")
+    loc_pred, cls_pred, anchor = _tiny_head(data, num_classes)
+    return _assemble_train(loc_pred, cls_pred, anchor)
+
+
+def get_tiny_symbol(num_classes=2, nms_thresh=0.45, force_suppress=False,
+                    nms_topk=100, **kwargs):
+    data = sym.var("data")
+    loc_pred, cls_pred, anchor = _tiny_head(data, num_classes)
+    return _assemble_detect(loc_pred, cls_pred, anchor, nms_thresh,
+                            force_suppress, nms_topk)
