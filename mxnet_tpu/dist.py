@@ -136,6 +136,17 @@ def allreduce(value):
     return np.asarray(out)
 
 
+def broadcast0(value):
+    """Rank-0's array wins everywhere (the reference's kvstore.init
+    broadcast semantics): realized as one allreduce where every other
+    rank contributes zeros."""
+    value = np.asarray(value)
+    if num_workers() == 1 or not _INITIALIZED:
+        return value
+    contrib = value if rank() == 0 else np.zeros_like(value)
+    return allreduce(contrib)
+
+
 def barrier():
     """Block until every worker reaches the barrier (ref
     KVStore::Barrier, kvstore.h:254-311)."""

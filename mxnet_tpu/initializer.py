@@ -14,6 +14,15 @@ import numpy as np
 from .base import MXNetError
 from .ndarray import ndarray as nd
 
+def _rng():
+    """Module-owned RandomState: seeded by mx.random.seed (reference
+    parity — initializers follow the engine RNG), leaving the user's
+    global numpy RNG untouched."""
+    from . import random as _random
+
+    return _random.initializer_rng()
+
+
 _INIT_REGISTRY = {}
 
 
@@ -132,7 +141,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, desc, arr):
-        self._set(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+        self._set(arr, _rng().uniform(-self.scale, self.scale, arr.shape))
 
 
 @register
@@ -142,7 +151,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, desc, arr):
-        self._set(arr, np.random.normal(0, self.sigma, arr.shape))
+        self._set(arr, _rng().normal(0, self.sigma, arr.shape))
 
 
 @register
@@ -169,9 +178,9 @@ class Xavier(Initializer):
         ]
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, np.random.uniform(-scale, scale, shape))
+            self._set(arr, _rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._set(arr, np.random.normal(0, scale, shape))
+            self._set(arr, _rng().normal(0, scale, shape))
         else:
             raise MXNetError("unknown rnd_type %r" % self.rnd_type)
 
@@ -195,9 +204,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == (nout, nin) else v
         self._set(arr, self.scale * q.reshape(arr.shape))
@@ -256,7 +265,7 @@ class FusedRNN(Initializer):
     def _init_weight(self, desc, arr):
         # initialize whole flat vector with the inner init, then set LSTM
         # forget-gate biases; layout matches ops/nn.py rnn() unpacking.
-        flat = np.random.uniform(-0.07, 0.07, arr.shape).astype(np.float32)
+        flat = _rng().uniform(-0.07, 0.07, arr.shape).astype(np.float32)
         H = self._num_hidden
         L = self._num_layers
         D = 2 if self._bidirectional else 1

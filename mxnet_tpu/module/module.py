@@ -304,9 +304,12 @@ class Module(BaseModule):
         # Comm reduce (module.py:468-530, comm.h). Falls back to the
         # per-executor path for optimizers the fused step can't mirror.
         self._fused = None
-        if kvstore is not None and kvstore.type == "tpu" and self.for_training:
+        fused_types = ("tpu", "dist_sync", "dist_sync_device", "dist_async")
+        if (kvstore is not None and kvstore.type in fused_types
+                and self.for_training):
             from .spmd_group import FusedSPMDGroup
 
+            distributed = kvstore.type.startswith("dist")
             try:
                 self._fused = FusedSPMDGroup(
                     self._symbol, self._context, self._optimizer,
@@ -316,18 +319,21 @@ class Module(BaseModule):
                     logger=self.logger,
                     batch_size=self._exec_group.batch_size,
                     inputs_need_grad=self.inputs_need_grad,
+                    distributed=distributed,
                 )
-                kvstore.attach_mesh(self._fused.mesh)
+                if hasattr(kvstore, "attach_mesh"):
+                    kvstore.attach_mesh(self._fused.mesh)
                 update_on_kvstore = False
                 self._update_on_kvstore = False
             except MXNetError as e:
                 self.logger.warning(
-                    "kvstore='tpu': %s; using per-executor update path", e)
+                    "kvstore=%r: %s; using per-executor update path",
+                    kvstore.type, e)
                 self._fused = None
             except Exception as e:  # mesh/device construction failed
                 self.logger.warning(
-                    "kvstore='tpu': fused step unavailable (%r); using "
-                    "per-executor update path", e)
+                    "kvstore=%r: fused step unavailable (%r); using "
+                    "per-executor update path", kvstore.type, e)
                 self._fused = None
 
         if kvstore:

@@ -29,11 +29,21 @@ from ..parallel.spmd import (
 
 
 class FusedSPMDGroup:
-    """One fused train step over a dp mesh built from Module's contexts."""
+    """One fused train step over a dp mesh built from Module's contexts.
+
+    With ``distributed=True`` (multi-process job via tools/launch.py /
+    jax.distributed), the mesh is the GLOBAL ``("dcn", "dp")`` mesh from
+    :func:`mxnet_tpu.dist.global_mesh`: every process contributes its
+    local batch shard and the cross-host gradient all-reduce happens
+    *inside* the compiled step over the dcn axis — XLA overlaps it with
+    backprop (the reference got overlap from priority-scheduled push,
+    model.py:126-137; the DistKVStore tier remains as the compatibility
+    path when the fused step can't be used).
+    """
 
     def __init__(self, symbol, contexts, optimizer, arg_params, aux_params,
                  data_names, label_names, fixed_param_names=None, logger=None,
-                 batch_size=None, inputs_need_grad=False):
+                 batch_size=None, inputs_need_grad=False, distributed=False):
         import jax
 
         if fixed_param_names:
@@ -47,13 +57,29 @@ class FusedSPMDGroup:
             raise MXNetError(
                 "fused SPMD step: batch size %d not divisible by %d devices"
                 % (batch_size, len(devices)))
-        self.mesh = make_mesh({"dp": len(devices)}, devices=devices)
+        self.distributed = bool(distributed)
+        if self.distributed:
+            from .. import dist
+
+            self._dist = dist
+            if len(devices) != jax.local_device_count():
+                raise MXNetError(
+                    "fused dist step: contexts must cover all %d local "
+                    "devices (got %d)"
+                    % (jax.local_device_count(), len(devices)))
+            self.mesh = dist.global_mesh({"dp": len(devices)})
+            data_axes = self.mesh.axis_names  # ("dcn","dp") when multi-proc
+        else:
+            self._dist = None
+            self.mesh = make_mesh({"dp": len(devices)}, devices=devices)
+            data_axes = ("dp",)
+        self._data_axes = tuple(data_axes)
         self._fopt = functional_from_optimizer(
             optimizer, [n for n in symbol.list_arguments()
                         if n not in data_names and n not in label_names])
         # rescale_grad already carries the 1/batch normalization Module set.
         self._ts = TrainStep(
-            symbol, self._fopt, mesh=self.mesh,
+            symbol, self._fopt, mesh=self.mesh, data_axes=self._data_axes,
             data_names=tuple(data_names), label_names=tuple(label_names),
             compute_dtype=None, normalize_grads=False, return_outputs=True,
         )
@@ -61,6 +87,7 @@ class FusedSPMDGroup:
         self.aux_names = list(self._ts.aux_names)
         params = {k: arg_params[k]._data() for k in self.param_names}
         aux = {k: aux_params[k]._data() for k in self.aux_names}
+        params, aux = self._sync_rank0(params, aux)
         opt_state = self._fopt.init(params)
         self._carry = self._ts.place(params, opt_state, aux)
         self._data_names = list(data_names)
@@ -70,33 +97,137 @@ class FusedSPMDGroup:
         self._step_no = 0
         self._loss = None
         self._outputs = None
+        self._raw_outputs = None
+        self._agreed_batches = set()
+
+    def _sync_rank0(self, params, aux):
+        """Rank-0's host values win on every process (the reference's
+        kvstore.init broadcast, kvstore_local.h) — one flattened
+        collective for all params+aux, DistKVStore._flush style."""
+        import jax
+
+        if not self.distributed or jax.process_count() == 1:
+            return params, aux
+        keys_p = sorted(params)
+        keys_a = sorted(aux)
+        flats = [np.asarray(params[k], np.float64).ravel() for k in keys_p]
+        flats += [np.asarray(aux[k], np.float64).ravel() for k in keys_a]
+        if not flats:
+            return params, aux
+        synced = self._dist.broadcast0(np.concatenate(flats))
+        off = 0
+        out_p, out_a = {}, {}
+        for k in keys_p:
+            v = np.asarray(params[k])
+            out_p[k] = synced[off:off + v.size].reshape(v.shape).astype(v.dtype)
+            off += v.size
+        for k in keys_a:
+            v = np.asarray(aux[k])
+            out_a[k] = synced[off:off + v.size].reshape(v.shape).astype(v.dtype)
+            off += v.size
+        return out_p, out_a
+
+    def _check_local_batch_agreement(self, n_rows):
+        """A per-rank local-batch mismatch builds inconsistent global
+        programs (a silent cross-host hang); turn it into an error.
+        Checked once per distinct shape (one tiny collective)."""
+        if n_rows in self._agreed_batches:
+            return
+        # sum and sum-of-squares together catch any mismatch (equal
+        # mean with unequal values inflates the square sum)
+        stats = self._dist.allreduce(
+            np.asarray([n_rows, n_rows * n_rows], np.int64))
+        nproc = self._dist.num_workers()
+        if (int(stats[0]) != n_rows * nproc
+                or int(stats[1]) != n_rows * n_rows * nproc):
+            raise MXNetError(
+                "fused dist step: local batch size %d differs across "
+                "workers; pad or drop the tail batch so every rank "
+                "agrees" % n_rows)
+        self._agreed_batches.add(n_rows)
+
+    def _put_batch_array(self, name, arr):
+        """Host batch → device: local device_put, or the process-local
+        shard of the global batch in distributed mode."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        value = arr._data()
+        if not self.distributed or jax.process_count() == 1:
+            ndev = self.mesh.devices.size
+            if value.shape[0] % ndev != 0:
+                raise MXNetError(
+                    "fused SPMD step: batch dim %d of %r not divisible by "
+                    "%d mesh devices" % (value.shape[0], name, ndev))
+            return jax.device_put(value, data_sharding(self.mesh,
+                                                       self._data_axes))
+        local = np.asarray(value)
+        nproc = jax.process_count()
+        if local.shape[0] % jax.local_device_count() != 0:
+            raise MXNetError(
+                "fused dist step: local batch dim %d of %r not divisible "
+                "by %d local devices"
+                % (local.shape[0], name, jax.local_device_count()))
+        self._check_local_batch_agreement(local.shape[0])
+        sh = NamedSharding(self.mesh, P(self._data_axes))
+        return jax.make_array_from_process_local_data(
+            sh, local, global_shape=(local.shape[0] * nproc,) + local.shape[1:])
 
     # -- the hot loop --------------------------------------------------------
     def forward_backward_update(self, data_batch):
-        """Run one fused step: shard batch over dp, fwd+bwd+update in XLA."""
+        """Run one fused step: shard batch over the mesh data axes,
+        fwd+bwd+update in XLA (cross-host all-reduce included)."""
         import jax
 
-        ndev = self.mesh.devices.size
-        sh = data_sharding(self.mesh)
         batch = {}
         for name, arr in zip(self._data_names, data_batch.data):
-            if arr.shape[0] % ndev != 0:
-                raise MXNetError(
-                    "fused SPMD step: batch dim %d of %r not divisible by "
-                    "%d mesh devices" % (arr.shape[0], name, ndev))
-            batch[name] = jax.device_put(arr._data(), sh)
+            batch[name] = self._put_batch_array(name, arr)
         labels = getattr(data_batch, "label", None) or []
         for name, arr in zip(self._label_names, labels):
-            batch[name] = jax.device_put(arr._data(), sh)
+            batch[name] = self._put_batch_array(name, arr)
         key = jax.random.fold_in(self._key, self._step_no)
         self._carry, (loss, outs) = self._ts(self._carry, batch, key)
         self._step_no += 1
         self._loss = loss
-        self._outputs = [nd.NDArray(o) for o in outs]
+        # keep raw device arrays — materialization is deferred to
+        # get_outputs() so the hot loop stays async when outputs
+        # aren't consumed every step
+        self._raw_outputs = outs
+        self._outputs = None
+
+    def _materialize_outputs(self, outs):
+        """Wrap step outputs; in multi-process mode return each
+        worker's own rows (the addressable shards of the global array),
+        matching what this worker's metric expects to see."""
+        import jax
+
+        if not self.distributed or jax.process_count() == 1:
+            return [nd.NDArray(o) for o in outs]
+        res = []
+        for o in outs:
+            if getattr(o, "is_fully_replicated", False):
+                res.append(nd.array(np.asarray(o.addressable_data(0))))
+                continue
+            # shards live on different local devices: assemble on host
+            shards = sorted(
+                o.addressable_shards,
+                key=lambda s: (s.index[0].start or 0) if s.index else 0)
+            seen = set()
+            pieces = []
+            for s in shards:
+                k = tuple((sl.start, sl.stop) for sl in s.index)
+                if k in seen:
+                    continue
+                seen.add(k)
+                pieces.append(np.asarray(s.data))
+            res.append(nd.array(np.concatenate(pieces, axis=0)))
+        return res
 
     def get_outputs(self):
         if self._outputs is None:
-            raise MXNetError("fused SPMD step: no batch has run yet")
+            if self._raw_outputs is None:
+                raise MXNetError("fused SPMD step: no batch has run yet")
+            self._outputs = self._materialize_outputs(self._raw_outputs)
         return list(self._outputs)
 
     def update_metric(self, eval_metric, labels):
@@ -133,9 +264,12 @@ class FusedSPMDGroup:
         self._carry = (carry[0], carry[1], carry[2], s)
 
     def set_params(self, arg_params, aux_params):
-        """Reset device params/aux from host (e.g. after load)."""
+        """Reset device params/aux from host (e.g. after load). In
+        distributed mode rank-0's values win, same as __init__ — a
+        per-process re-init must not silently desynchronize ranks."""
         params = {k: arg_params[k]._data() for k in self.param_names}
         aux = {k: aux_params[k]._data() for k in self.aux_names}
+        params, aux = self._sync_rank0(params, aux)
         self._replace(params=params, aux=aux)
 
     # -- optimizer state -----------------------------------------------------

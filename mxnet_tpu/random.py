@@ -26,10 +26,34 @@ def seed(seed_state, ctx=None):
     import jax
 
     if ctx is None:
+        global _INIT_RNG
         _DEFAULT_SEED = int(seed_state)
         _chains().clear()
+        # the initializer zoo draws from a module-owned numpy RNG (the
+        # reference's initializers run on the engine RNG that
+        # mx.random.seed controls); reseeding it here makes seeded runs
+        # reproducible end to end — including across processes — without
+        # clobbering the user's global numpy RNG
+        import numpy as _np
+
+        _INIT_RNG = _np.random.RandomState(int(seed_state) & 0x7FFFFFFF)
     else:
         _chains()[ctx] = jax.random.PRNGKey(int(seed_state))
+
+
+_INIT_RNG = None
+
+
+def initializer_rng():
+    """The numpy RandomState behind the initializer zoo. Unseeded runs
+    draw fresh entropy; ``mx.random.seed`` reseeds it (reference
+    parity: initializers follow the engine RNG that seed() controls)."""
+    global _INIT_RNG
+    if _INIT_RNG is None:
+        import numpy as _np
+
+        _INIT_RNG = _np.random.RandomState()
+    return _INIT_RNG
 
 
 def push_trace_key(key):
@@ -61,8 +85,14 @@ def next_key(ctx=None):
     ctx = ctx or current_context()
     chains = _chains()
     if ctx not in chains:
+        import zlib
+
         base = jax.random.PRNGKey(_DEFAULT_SEED)
-        chains[ctx] = jax.random.fold_in(base, hash(ctx) % (2**31))
+        # deterministic per-context fold: python's hash() is salted per
+        # process (PYTHONHASHSEED), which would make seeded runs diverge
+        # across processes/restarts — crc32 of the stable repr is not
+        chains[ctx] = jax.random.fold_in(
+            base, zlib.crc32(repr(ctx).encode()) % (2**31))
     key, chains[ctx] = jax.random.split(chains[ctx])
     return key
 

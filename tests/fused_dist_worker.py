@@ -1,0 +1,99 @@
+"""Worker for the fused multi-host training test (run via
+tools/launch.py, or standalone for the single-process reference).
+
+Trains a deterministic MLP through Module.fit's machinery with
+kvstore='dist_sync'. In the 2-process job the fused global-mesh path
+must engage (one compiled step, DCN all-reduce inside XLA); the
+single-process invocation (--single) trains the concatenated global
+batch locally as the reference trajectory. Final params are saved to
+--out for the parent test to compare.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+LOCAL_BATCH = 8
+STEPS = 5
+
+
+def build_module(batch_size, kvstore):
+    mx.random.seed(42)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    contexts = [mx.cpu(i) for i in range(jax.local_device_count())]
+    mod = mx.mod.Module(net, context=contexts)
+    mod.bind(data_shapes=[("data", (batch_size, 12))],
+             label_shapes=[("softmax_label", (batch_size,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    # identical rescale in both topologies: 1/LOCAL_BATCH (the dist_sync
+    # convention — worker gradients summed, each rescaled by local batch)
+    mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / LOCAL_BATCH})
+    return mod
+
+
+def global_data():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2 * LOCAL_BATCH, 12).astype(np.float32)
+    y = (np.abs(X).sum(axis=1) * 3 % 3).astype(np.float32)
+    return X, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--single", action="store_true")
+    args = p.parse_args()
+
+    if not args.single:
+        # must run before anything touches the XLA backend
+        from mxnet_tpu import dist
+
+        dist.init_from_env()
+
+    X, y = global_data()
+    r = 0
+    if args.single:
+        mod = build_module(2 * LOCAL_BATCH, kvstore="local")
+        batch = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+    else:
+        mod = build_module(LOCAL_BATCH, kvstore="dist_sync")
+        assert mod._fused is not None, "fused dist path did not engage"
+        assert mod._fused.distributed
+        assert mod._fused.mesh.axis_names == ("dcn", "dp"), \
+            mod._fused.mesh.axis_names
+        r = mx.kv.create("dist_sync").rank
+        lo = r * LOCAL_BATCH
+        batch = mx.io.DataBatch(data=[nd.array(X[lo:lo + LOCAL_BATCH])],
+                                label=[nd.array(y[lo:lo + LOCAL_BATCH])])
+
+    for _ in range(STEPS):
+        mod.forward_backward(batch)
+        mod.update()
+
+    arg, _aux = mod.get_params()
+    out = args.out % r if "%" in args.out else args.out
+    np.savez(out, **{k: v.asnumpy() for k, v in arg.items()})
+    print("FUSED_DIST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
