@@ -108,29 +108,58 @@ def global_mesh(axes=None):
     return Mesh(devices.reshape(sizes), tuple(axes.keys()))
 
 
-def allreduce(value):
-    """Sum a host-local numpy array across all worker processes; the
+def _stack_across_workers(value):
+    """(mesh, global array): each process's host value on the leading
+    worker axis of a (num_workers, ...) stacked array."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh()
+    axis0 = mesh.axis_names[0]                      # "dcn"
+    sh = NamedSharding(mesh, P(axis0))
+    nproc = num_workers()
+    garr = jax.make_array_from_process_local_data(
+        sh, value[None], global_shape=(nproc,) + value.shape)
+    return mesh, garr
+
+
+def allreduce(value, op="sum"):
+    """Reduce a host-local numpy array across all worker processes; the
     result is identical (replicated) on every worker.
 
     This is the KVStore-dist push semantics (kvstore_dist.h Push_ →
     server-side aggregation) as one XLA collective: each process
     contributes its slice of a stacked (num_workers, ...) array and the
-    sum collapses the worker axis."""
+    reduction collapses the worker axis. op: "sum" or "max"."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     value = np.asarray(value)
-    nproc = num_workers()
-    if nproc == 1 or not _INITIALIZED:
+    if num_workers() == 1 or not _INITIALIZED:
         return value
-    mesh = global_mesh()
-    axis0 = mesh.axis_names[0]                      # "dcn"
-    sh = NamedSharding(mesh, P(axis0))
-    garr = jax.make_array_from_process_local_data(
-        sh, value[None], global_shape=(nproc,) + value.shape)
+    mesh, garr = _stack_across_workers(value)
+    red = {"sum": jnp.sum, "max": jnp.max}[op]
     out = jax.jit(
-        lambda x: jnp.sum(x, axis=0),
+        lambda x: red(x, axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )(garr)
+    return np.asarray(out)
+
+
+def allgather(value):
+    """Gather each worker's host-local array: returns the stacked
+    (num_workers, ...) array, identical on every worker (ps-lite
+    worker→server key exchange collapsed into one collective)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    value = np.asarray(value)
+    if num_workers() == 1 or not _INITIALIZED:
+        return value[None]
+    mesh, garr = _stack_across_workers(value)
+    out = jax.jit(
+        lambda x: x,
         out_shardings=NamedSharding(mesh, P()),
     )(garr)
     return np.asarray(out)

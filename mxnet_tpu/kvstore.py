@@ -350,11 +350,27 @@ class DistKVStore(TPUKVStore):
                 # accumulate semantics by flushing the first round
                 self._flush()
             agg = self._reduce(vlist)
-            if self._compression_params is not None:
+            from .ndarray.sparse import RowSparseNDArray
+
+            if (self._compression_params is not None
+                    and not isinstance(agg, RowSparseNDArray)):
+                # ref parity: compression applies to dense keys only;
+                # row-sparse crosses the wire uncompressed
+                # (kvstore_dist.h EncodeCompressedKey vs EncodeRowSparseKey)
                 agg = self._compress_decompress(k, agg)
-            # snapshot the (immutable) array now: the caller may overwrite
-            # its gradient NDArray in place before the flushing pull
-            self._pending[k] = (agg._data(), agg.ctx)
+            if isinstance(agg, RowSparseNDArray):
+                # keep row-sparse grads sparse across the wire (ref
+                # EncodeRowSparseKey, kvstore_dist.h:147-346): snapshot
+                # (values, row_ids); _flush exchanges only stored rows
+                self._pending[k] = (
+                    "rsp",
+                    np.asarray(agg.data._data()),
+                    np.asarray(agg.indices._data(), np.int64),
+                    tuple(agg.shape), agg.ctx)
+            else:
+                # snapshot the (immutable) array now: the caller may
+                # overwrite its gradient in place before the flushing pull
+                self._pending[k] = (agg._data(), agg.ctx)
 
     def _flush(self):
         """One cross-worker collective for every pending key."""
@@ -363,6 +379,12 @@ class DistKVStore(TPUKVStore):
         from . import dist
 
         pending, self._pending = self._pending, {}
+        rsp = {k: pending.pop(k) for k in
+               [k for k, v in pending.items() if v[0] == "rsp"]}
+        if rsp:
+            self._flush_row_sparse(rsp)
+        if not pending:
+            return
         # group by dtype so the flattened concat is bit-exact per key;
         # concat on host — the collective is host-mediated anyway, so a
         # device-side concat would only add a round-trip
@@ -385,6 +407,86 @@ class DistKVStore(TPUKVStore):
                     self._updater(self._normalize_key(k), agg, self._store[k])
                 else:
                     self._store[k] += agg
+
+    def _flush_row_sparse(self, rsp):
+        """Cross-worker aggregation of pending row-sparse gradients
+        without densifying: workers exchange only their stored
+        (row_id, values) pairs, padded per key to the max nnz (ref
+        kvstore_dist.h EncodeRowSparseKey — the wire carries nnz*width,
+        not the dense shape; nightly invariant
+        dist_sync_kvstore.py:28-50). All keys batch into one max-nnz
+        reduction, one id gather, and one value gather per dtype —
+        the same few-collective discipline as the dense flush.
+
+        Row ids cross the wire as int32 (JAX canonicalizes int64 down
+        anyway without x64); tables beyond 2^31 rows are rejected
+        rather than silently corrupted."""
+        import jax.numpy as jnp
+
+        from . import dist
+        from .ndarray.sparse import RowSparseNDArray, _canonicalize
+
+        keys = sorted(rsp)
+        for k in keys:
+            if rsp[k][3][0] > np.iinfo(np.int32).max:
+                raise MXNetError(
+                    "row-sparse dist push: %r has %d rows; the int32 "
+                    "wire format supports up to 2^31-1"
+                    % (k, rsp[k][3][0]))
+        nnzs = np.asarray([rsp[k][2].shape[0] for k in keys], np.int64)
+        max_nnzs = dist.allreduce(nnzs, op="max")
+        # pad ids with -1 / values with 0, concat across keys
+        id_parts, val_parts_by_dtype, layouts = [], {}, []
+        for k, m in zip(keys, max_nnzs):
+            _tag, vals, ids, shape, ctx = rsp[k]
+            m = int(m)
+            width = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            pid = np.full((m,), -1, np.int32)
+            pid[:ids.shape[0]] = ids.astype(np.int32)
+            id_parts.append(pid)
+            dt = np.dtype(vals.dtype)
+            pval = np.zeros((m, width), dt)
+            pval[:ids.shape[0]] = vals.reshape(ids.shape[0], width)
+            val_parts_by_dtype.setdefault(dt, []).append(pval.reshape(-1))
+            layouts.append((k, m, width, dt, shape, ctx))
+        gathered_ids = dist.allgather(np.concatenate(id_parts))
+        gathered_vals = {dt: dist.allgather(np.concatenate(parts))
+                         for dt, parts in val_parts_by_dtype.items()}
+        nworkers = gathered_ids.shape[0]
+        id_off = 0
+        val_off = {dt: 0 for dt in gathered_vals}
+        for k, m, width, dt, shape, ctx in layouts:
+            ids_w = gathered_ids[:, id_off:id_off + m]
+            id_off += m
+            vals_w = gathered_vals[dt][:, val_off[dt]:val_off[dt] + m * width]
+            val_off[dt] += m * width
+            flat_ids = ids_w.reshape(-1)
+            flat_vals = vals_w.reshape(nworkers * m, width)
+            keep = flat_ids >= 0
+            all_ids = jnp.asarray(flat_ids[keep].astype(np.int64))
+            all_vals = jnp.asarray(
+                flat_vals[keep].reshape((-1,) + tuple(shape[1:])))
+            m_vals, m_ids = _canonicalize(all_vals, all_ids)
+            agg = RowSparseNDArray(NDArray(m_vals, ctx=ctx),
+                                   NDArray(m_ids.astype("int64"), ctx=ctx),
+                                   shape, ctx=ctx)
+            if self._updater is not None:
+                self._updater(self._normalize_key(k), agg, self._store[k])
+            else:
+                self._accumulate_rsp(k, agg)
+
+    def _accumulate_rsp(self, k, agg):
+        """store[k] += row-sparse agg (server DataHandleRowSparse add)."""
+        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray import sparse as nd_sparse
+
+        store = self._store[k]
+        if isinstance(store, RowSparseNDArray):
+            self._store[k] = nd_sparse.add(store, agg)
+            return
+        ids = agg.indices._data().astype("int32")
+        new = store._data().at[ids].add(agg.data._data())
+        store._rebind(new)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         self._flush()

@@ -107,6 +107,72 @@ def main():
     agreed = dist.allreduce(np.asarray([lv], np.float32))
     assert abs(agreed[0] - n * lv) < 1e-4 * max(1.0, abs(n * lv)), (agreed, lv)
 
+    # --- row-sparse dist push: sparse end to end (ref nightly
+    # dist_sync_kvstore.py:28-50 — rows exchanged as (id, values) pairs,
+    # never densified on the wire) ------------------------------------
+    from mxnet_tpu.ndarray import sparse as nd_sparse
+
+    kvr = mx.kv.create("dist_sync")
+    shape_r = (6, 3)
+    kvr.init("rsp", nd.zeros(shape_r))
+    # worker r contributes rows {r, r+1} with value (r+1); overlapping
+    # rows sum across workers
+    my_rows = np.array([r, r + 1], np.int64)
+    my_vals = np.full((2, 3), float(r + 1), np.float32)
+    grad = nd_sparse.row_sparse_array((my_vals, my_rows), shape=shape_r)
+    assert grad.stype == "row_sparse"
+    kvr.push("rsp", grad)
+    # second rsp key with a different row pattern: both flush in one
+    # batched exchange (ids gather + value gather shared across keys)
+    kvr.init("rsp2", nd.zeros(shape_r))
+    kvr.push("rsp2", nd_sparse.row_sparse_array(
+        (np.full((1, 3), 10.0 * (r + 1), np.float32),
+         np.array([5 - r], np.int64)), shape=shape_r))
+    # pending entries stayed sparse (densify would store the full shape)
+    tag = kvr._pending["rsp"][0]
+    assert tag == "rsp", tag
+    out = nd.zeros(shape_r)
+    kvr.pull("rsp", out=out)
+    out2 = nd.zeros(shape_r)
+    kvr.pull("rsp2", out=out2)
+    expect2 = np.zeros(shape_r, np.float32)
+    for g in range(n):
+        expect2[5 - g] += 10.0 * (g + 1)
+    assert np.allclose(out2.asnumpy(), expect2), (r, out2.asnumpy(), expect2)
+    expect = np.zeros(shape_r, np.float32)
+    for g in range(n):
+        expect[g] += g + 1
+        expect[g + 1] += g + 1
+    assert np.allclose(out.asnumpy(), expect), (r, out.asnumpy(), expect)
+
+    # row_sparse_pull of selected rows after a sparse dist update
+    rsp_out = nd.sparse.zeros("row_sparse", shape_r)
+    kvr.row_sparse_pull("rsp", out=rsp_out,
+                        row_ids=nd.array(np.array([1.0, 3.0])))
+    got_rows = rsp_out.tostype("default").asnumpy()
+    assert np.allclose(got_rows[1], expect[1]), (r, got_rows[1], expect[1])
+
+    # lazy sparse updater: only touched rows change
+    kvu = mx.kv.create("dist_sync")
+    kvu.init("w", nd.ones(shape_r))
+    touched = []
+    def _upd(key, g, w):
+        assert g.stype == "row_sparse"
+        touched.append(np.asarray(g.indices.asnumpy()))
+        w._rebind((w._data().at[g.indices._data().astype("int32")]
+                   .add(-0.1 * g.data._data())))
+    kvu._set_updater(_upd)
+    kvu.push("w", nd_sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), np.array([r], np.int64)),
+        shape=shape_r))
+    wout = nd.zeros(shape_r)
+    kvu.pull("w", out=wout)
+    w_np = wout.asnumpy()
+    for row in range(shape_r[0]):
+        want = 1.0 - 0.1 if row < n else 1.0
+        assert np.allclose(w_np[row], want), (r, row, w_np[row], want)
+    assert sorted(touched[-1].tolist()) == list(range(n))
+
     print("DIST_CHECK_OK rank=%d loss=%.4f" % (r, lv), flush=True)
 
 
