@@ -70,6 +70,13 @@ class MApMetric(EvalMetric):
 
     def _update_image(self, gts, dets):
         gts = gts[gts[:, 0] >= 0]
+        if len(gts) == 0:
+            # ref parity: images with no (non-pad) ground truth are
+            # skipped entirely — their detections are NOT false
+            # positives (eval_metric.py "if np.sum(label[:, 0] >= 0)
+            # < 1: continue"); counting them would depress mAP vs the
+            # 77.8 VOC07 baseline on datasets with empty images
+            return
         dets = dets[dets[:, 0] >= 0]
         difficult = (gts[:, 5] > 0 if gts.shape[1] >= 6 and
                      not self.use_difficult

@@ -379,8 +379,11 @@ class DistKVStore(TPUKVStore):
         from . import dist
 
         pending, self._pending = self._pending, {}
+        # discriminate structurally: only row-sparse entries carry a str
+        # tag in slot 0 (dense slot 0 is a device array, and array==str
+        # comparison semantics vary across numpy/JAX versions)
         rsp = {k: pending.pop(k) for k in
-               [k for k, v in pending.items() if v[0] == "rsp"]}
+               [k for k, v in pending.items() if isinstance(v[0], str)]}
         if rsp:
             self._flush_row_sparse(rsp)
         if not pending:

@@ -98,53 +98,60 @@ class FusedSPMDGroup:
         self._loss = None
         self._outputs = None
         self._raw_outputs = None
-        self._agreed_batches = set()
 
     def _sync_rank0(self, params, aux):
         """Rank-0's host values win on every process (the reference's
         kvstore.init broadcast, kvstore_local.h) — one flattened
-        collective for all params+aux, DistKVStore._flush style."""
+        collective for all params+aux. Arrays cross the wire as raw
+        bytes (uint8) so every dtype — int64 counters, float64 — is
+        bit-exact regardless of JAX's 32-bit canonicalization."""
         import jax
 
         if not self.distributed or jax.process_count() == 1:
             return params, aux
         keys_p = sorted(params)
         keys_a = sorted(aux)
-        flats = [np.asarray(params[k], np.float64).ravel() for k in keys_p]
-        flats += [np.asarray(aux[k], np.float64).ravel() for k in keys_a]
-        if not flats:
+        arrs = [np.ascontiguousarray(np.asarray(params[k])) for k in keys_p]
+        arrs += [np.ascontiguousarray(np.asarray(aux[k])) for k in keys_a]
+        if not arrs:
             return params, aux
-        synced = self._dist.broadcast0(np.concatenate(flats))
+        blob = np.frombuffer(b"".join(a.tobytes() for a in arrs), np.uint8)
+        # the reduction promotes uint8 (sum dtype widening); every value
+        # is still a byte (one nonzero contributor), so cast back
+        buf = np.asarray(self._dist.broadcast0(blob),
+                         np.uint8).tobytes()
         off = 0
-        out_p, out_a = {}, {}
-        for k in keys_p:
-            v = np.asarray(params[k])
-            out_p[k] = synced[off:off + v.size].reshape(v.shape).astype(v.dtype)
-            off += v.size
-        for k in keys_a:
-            v = np.asarray(aux[k])
-            out_a[k] = synced[off:off + v.size].reshape(v.shape).astype(v.dtype)
-            off += v.size
+
+        def take(a):
+            nonlocal off
+            v = np.frombuffer(buf, a.dtype, count=a.size,
+                              offset=off).reshape(a.shape)
+            off += a.nbytes
+            return v
+
+        out_p = {k: take(a) for k, a in zip(keys_p, arrs[:len(keys_p)])}
+        out_a = {k: take(a) for k, a in zip(keys_a, arrs[len(keys_p):])}
         return out_p, out_a
 
-    def _check_local_batch_agreement(self, n_rows):
+    def _check_local_batch_agreement(self, n_rows_list):
         """A per-rank local-batch mismatch builds inconsistent global
         programs (a silent cross-host hang); turn it into an error.
-        Checked once per distinct shape (one tiny collective)."""
-        if n_rows in self._agreed_batches:
-            return
-        # sum and sum-of-squares together catch any mismatch (equal
-        # mean with unequal values inflates the square sum)
-        stats = self._dist.allreduce(
-            np.asarray([n_rows, n_rows * n_rows], np.int64))
-        nproc = self._dist.num_workers()
-        if (int(stats[0]) != n_rows * nproc
-                or int(stats[1]) != n_rows * n_rows * nproc):
+        Runs unconditionally, ONE collective per batch covering every
+        input array's leading dim: memoizing per-process would itself
+        desynchronize ranks when one rank sees a repeat size while
+        another sees a new one (unequal shard tails) — the exact
+        deadlock this check exists to prevent."""
+        # allgather the raw per-rank sizes and compare rows: exact for
+        # any size < 2^31 (an allreduce of n^2 would wrap on the int32
+        # wire — JAX canonicalizes int64 down — at n >= 46341)
+        arr = np.asarray(n_rows_list, np.int32)
+        rows = self._dist.allgather(arr)
+        if not (rows == arr[None, :]).all():
             raise MXNetError(
-                "fused dist step: local batch size %d differs across "
-                "workers; pad or drop the tail batch so every rank "
-                "agrees" % n_rows)
-        self._agreed_batches.add(n_rows)
+                "fused dist step: local batch sizes %s differ across "
+                "workers (per-rank sizes %s); pad or drop the tail "
+                "batch so every rank agrees"
+                % (list(n_rows_list), rows.tolist()))
 
     def _put_batch_array(self, name, arr):
         """Host batch → device: local device_put, or the process-local
@@ -168,7 +175,6 @@ class FusedSPMDGroup:
                 "fused dist step: local batch dim %d of %r not divisible "
                 "by %d local devices"
                 % (local.shape[0], name, jax.local_device_count()))
-        self._check_local_batch_agreement(local.shape[0])
         sh = NamedSharding(self.mesh, P(self._data_axes))
         return jax.make_array_from_process_local_data(
             sh, local, global_shape=(local.shape[0] * nproc,) + local.shape[1:])
@@ -179,11 +185,14 @@ class FusedSPMDGroup:
         fwd+bwd+update in XLA (cross-host all-reduce included)."""
         import jax
 
-        batch = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            batch[name] = self._put_batch_array(name, arr)
+        arrays = list(zip(self._data_names, data_batch.data))
         labels = getattr(data_batch, "label", None) or []
-        for name, arr in zip(self._label_names, labels):
+        arrays += list(zip(self._label_names, labels))
+        if self.distributed and jax.process_count() > 1:
+            self._check_local_batch_agreement(
+                [a.shape[0] for _n, a in arrays])
+        batch = {}
+        for name, arr in arrays:
             batch[name] = self._put_batch_array(name, arr)
         key = jax.random.fold_in(self._key, self._step_no)
         self._carry, (loss, outs) = self._ts(self._carry, batch, key)

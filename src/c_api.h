@@ -229,6 +229,14 @@ MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
 MXNET_DLL int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
 MXNET_DLL int MXNDArrayGetStorageType(NDArrayHandle handle,
                                       int *out_storage_type);
+/*!
+ * Returns a host pointer to a SNAPSHOT of the array's contents, valid
+ * until the next MXNDArrayGetData/MXNDArrayFree on the same handle.
+ * READ-ONLY: unlike the reference (which exposes the live CPU buffer,
+ * ndarray.h data()), device arrays here are immutable XLA buffers, so
+ * writes through this pointer are silently discarded. To mutate from C,
+ * use MXNDArraySyncCopyFromCPU, which is the supported write path.
+ */
 MXNET_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
 MXNET_DLL int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i,
                                   int *out_type);

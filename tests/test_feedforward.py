@@ -68,12 +68,18 @@ def test_feedforward_fit_after_score(tmp_path):
     loaded = mx.model.FeedForward.load(prefix, 2, ctx=mx.cpu(), num_epoch=6,
                                        optimizer="sgd", learning_rate=0.5)
     loaded.begin_epoch = 0
-    it = mx.io.NDArrayIter(x, y, 40, label_name="softmax_label")
-    before = loaded.score(it)
+
+    def nll(m):
+        p = np.clip(m.predict(x), 1e-9, None)
+        return float(-np.log(p[np.arange(len(y)), y.astype(int)]).mean())
+
+    before = nll(loaded)
     loaded.fit(x, y)            # must actually train, not no-op
-    it.reset()
-    after = loaded.score(it)
-    assert after >= before - 1e-6
+    after = nll(loaded)
+    # continued training must reduce the training loss; accuracy is NOT
+    # asserted monotone — at lr=0.5 one re-classified sample (1/400)
+    # can drop it while the model still improves
+    assert after < before, (before, after)
     w0 = model.arg_params["fc_weight"].asnumpy()
     w1 = loaded.arg_params["fc_weight"].asnumpy()
     assert not np.allclose(w0, w1)   # params moved
