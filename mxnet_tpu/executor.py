@@ -74,8 +74,15 @@ def _graph_closure(symbol: Symbol, is_train: bool, placement=None):
             out = out if isinstance(out, tuple) else (out,)
             out = _place(node, out)
             results[i] = out
+            # generic aux-state contract: op declares which outputs
+            # replace which aux inputs each training step (fused blocks)
+            if is_train and node.op.aux_state_outputs and node._arity:
+                for pname, (inode, _) in zip(node._arity, node.inputs):
+                    idx = node.op.aux_state_outputs.get(pname)
+                    if idx is not None and inode.is_variable():
+                        aux_updates[inode.name] = out[idx]
             # aux-state update semantics (BatchNorm moving stats)
-            if is_train and node.op.name in _AUX_PARAMS and node._arity:
+            elif is_train and node.op.name in _AUX_PARAMS and node._arity:
                 momentum = attrs.get("momentum", 0.9)
                 for pname, (inode, _) in zip(node._arity, node.inputs):
                     if not inode.is_variable():
@@ -247,6 +254,20 @@ def _param_shape_hints(node, in_shapes):
         hints["weight"] = (nf, data[1] // ng) + kernel
         if not attrs.get("no_bias"):
             hints["bias"] = (nf,)
+    elif op == "FusedBottleneckUnit":
+        # data is NHWC; weights keep the unfused OIHW checkpoint shapes
+        nf = int(attrs.get("num_filter", 1))
+        c = nf // 4
+        ci = data[3]
+        hints["conv1_weight"] = (c, ci, 1, 1)
+        hints["conv2_weight"] = (c, c, 3, 3)
+        hints["conv3_weight"] = (nf, c, 1, 1)
+        hints["sc_weight"] = (nf, ci, 1, 1)
+        for i, ch in (("1", ci), ("2", c), ("3", c)):
+            hints["bn%s_gamma" % i] = (ch,)
+            hints["bn%s_beta" % i] = (ch,)
+            hints["bn%s_moving_mean" % i] = (ch,)
+            hints["bn%s_moving_var" % i] = (ch,)
     elif op == "Deconvolution":
         kernel = tuple(int(k) for k in attrs.get("kernel", ()))
         nf = int(attrs.get("num_filter", 1))

@@ -1,0 +1,741 @@
+"""Fused ResNet bottleneck block as Pallas TPU kernels (fwd + bwd).
+
+Reference counterpart: the conv/BN/ReLU chains built by
+``example/image-classification/symbols/resnet.py`` residual_unit — on the
+reference stack each op is a separate cuDNN/CUDA kernel and the
+activations round-trip device memory between them. Profiling
+(PROFILE.md) shows the TPU port is HBM-bandwidth-bound the same way:
+XLA materializes every BN input/output, so a ResNet-50 train step moves
+~78 GB/step where ~48 GB is the structural minimum.
+
+This module removes the extra passes with a small library of Pallas
+convolution kernels in NHWC whose contract is:
+
+- **prologue**: BatchNorm-apply + ReLU folded into the conv's *input
+  read* — the normalized activation lives only in VMEM, never in HBM.
+- **epilogue**: per-channel sum / sum-of-squares of the conv's *output
+  write* — the next BatchNorm's statistics cost no extra pass.
+- backward mirrors it: the BN/ReLU backward elementwise math rides the
+  wgrad/dgrad kernels' operand reads (``bnbwd`` prologue), and dgrad
+  accumulates the (dbeta, dgamma) reductions as it writes.
+
+Every intermediate activation therefore crosses HBM exactly once, raw
+(the conv output), which is the minimum any schedule with true training
+BN semantics can do.
+
+Layout: NHWC with channels on the TPU lane dimension; weights HWIO.
+1x1 convs are per-pixel matmuls; 3x3 convs are 9 shifted matmuls over a
+spatially tiled block with 1-row halos (halo rows enter as extra
+1-row BlockSpec operands, so no manual DMA is needed). Stride-2
+backward uses zero-stuffed input tiles (transposed conv), built with
+interleave/concat only — no pad/scatter primitives, so the kernels
+lower on Mosaic and run identically under ``interpret``.
+
+``interpret=None`` auto-selects interpreter mode off-TPU so the CPU
+test mesh runs the same code path (same convention as
+flash_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _need_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _tile_rows(h_out):
+    """Output rows per grid tile: the largest divisor of H_out <= 16."""
+    for cand in range(min(16, h_out), 0, -1):
+        if h_out % cand == 0:
+            return cand
+    return 1
+
+
+def _pad_w(v, left=1, right=1):
+    """Zero-pad the W (second-to-last of 3) axis via concat (Mosaic-safe)."""
+    rows, _, c = v.shape
+    z = jnp.zeros((rows, 1, c), v.dtype)
+    parts = [z] * left + [v] + [z] * right
+    return jnp.concatenate(parts, axis=1)
+
+
+def _interleave_zeros(v, axis, offset):
+    """Double ``axis`` by interleaving zeros; v lands at offset::2."""
+    z = jnp.zeros_like(v)
+    pair = (v, z) if offset == 0 else (z, v)
+    stacked = jnp.stack(pair, axis=axis + 1)
+    shape = list(v.shape)
+    shape[axis] *= 2
+    return stacked.reshape(shape)
+
+
+def _apply_prologue(x, pro, compute_dtype):
+    """BN-apply (+ ReLU) on a VMEM-resident value, f32 math."""
+    if pro is None:
+        return x.astype(compute_dtype)
+    scale, bias, relu = pro
+    h = x.astype(jnp.float32) * scale + bias
+    if relu:
+        h = jnp.maximum(h, 0.0)
+    return h.astype(compute_dtype)
+
+
+def _bnbwd_value(e, y_raw, consts):
+    """Reconstruct dL/dy from the relu-masked partial ``e`` in VMEM.
+
+    With xhat = (y - mu) * inv_sigma and forward out = gamma*xhat + beta,
+    the relu-masked upstream grad e gives
+    dL/dy = (gamma * inv_sigma) * (e - m0 - xhat * m1),
+    where m0 = mean(e), m1 = mean(e * xhat) over the batch.
+    ``consts`` = (k = gamma*inv_sigma, mu, inv_sigma, m0, m1), (1,1,C) f32.
+    """
+    k, mu, inv_sigma, m0, m1 = consts
+    ef = e.astype(jnp.float32)
+    xhat = (y_raw.astype(jnp.float32) - mu) * inv_sigma
+    return k * (ef - m0 - xhat * m1)
+
+
+def _nine_shift_matmul(hp, w_ref, th_out, w_out, stride):
+    """Core of the 3x3 conv: 9 shifted (rows, Ci) @ (Ci, Co) matmuls on a
+    W-padded tile ``hp`` of shape (rows_in, W_out*stride + 2, Ci)."""
+    ci = hp.shape[-1]
+    co = w_ref.shape[-1]
+    acc = jnp.zeros((th_out * w_out, co), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            if stride == 1:
+                xs = hp[dy:dy + th_out, dx:dx + w_out, :]
+            else:
+                xs = hp[dy:dy + 2 * th_out - 1:2, dx:dx + 2 * w_out - 1:2, :]
+            acc += jnp.dot(xs.reshape(th_out * w_out, ci), w_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    return acc
+
+
+def _accumulate_out(ref, value, is_first):
+    """Accumulate into an output ref revisited across the whole grid."""
+    @pl.when(is_first)
+    def _():
+        ref[...] = value
+
+    @pl.when(jnp.logical_not(is_first))
+    def _():
+        ref[...] = ref[...] + value
+
+
+def _vec_spec(cdim):
+    return pl.BlockSpec((1, 1, cdim), lambda n_, i_: (0, 0, 0))
+
+
+def _mask_halo_rows(hv, i, top_bad, bottom_bad):
+    """Zero out-of-image halo rows (padding applies to the normalized
+    activation, matching the unfused graph's zero-pad of act)."""
+    rows = hv.shape[0]
+    rid = jax.lax.broadcasted_iota(jnp.int32, (rows, 1, 1), 0)
+    bad = None
+    if top_bad:
+        bad = jnp.logical_and(i == 0, rid == 0)
+    if bottom_bad:
+        b = jnp.logical_and(i == pl.num_programs(1) - 1, rid == rows - 1)
+        bad = b if bad is None else jnp.logical_or(bad, b)
+    if bad is None:
+        return hv
+    return jnp.where(bad, jnp.zeros_like(hv), hv)
+
+
+# ---------------------------------------------------------------------------
+# forward conv (k in {1,3}, stride in {1,2}), BN-apply prologue, stats
+# epilogue
+# ---------------------------------------------------------------------------
+def conv_fwd(x, w, *, stride=1, prologue=None, emit_stats=False,
+             interpret=None):
+    """NHWC conv: y = conv(act(bn(x)), w).
+
+    x: (N, H, W, Ci); w: (k, k, Ci, Co) with k in {1, 3} (pad = k // 2);
+    prologue: None or (scale, bias, relu) with (Ci,) f32 vectors —
+    per-channel folded BN apply; emit_stats: additionally return a
+    (2, Co) f32 [sum, sum_sq] over the *stored* (dtype-cast) output.
+    Returns (y, stats|None).
+    """
+    n, h, wd, ci = x.shape
+    k = int(w.shape[0])
+    co = int(w.shape[-1])
+    if stride == 2 and (h % 2 or wd % 2):
+        # the unfused conv emits ceil((h-1)/2)+1 rows on odd inputs; the
+        # tiled kernels only implement the even case — fail loudly
+        # rather than silently computing a different network
+        raise ValueError(
+            "fused conv: stride-2 requires even spatial dims, got "
+            "(%d, %d)" % (h, wd))
+    ho, wo = h // stride, wd // stride
+    th = _tile_rows(ho)
+    ht = ho // th
+    rows_in = stride * th
+    dtype = x.dtype
+    has_pro = prologue is not None
+    relu = bool(prologue[2]) if has_pro else False
+
+    operands, in_specs = [], []
+    if has_pro:
+        scale, bias, _ = prologue
+        operands += [scale.reshape(1, 1, ci).astype(jnp.float32),
+                     bias.reshape(1, 1, ci).astype(jnp.float32)]
+        in_specs += [_vec_spec(ci), _vec_spec(ci)]
+    nvec = len(operands)
+
+    in_specs.append(pl.BlockSpec((1, rows_in, wd, ci),
+                                 lambda n_, i_: (n_, i_, 0, 0)))
+    operands.append(x)
+    nx = 1
+    if k == 3:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, wd, ci),
+            lambda n_, i_: (n_, jnp.maximum(rows_in * i_ - 1, 0), 0, 0)))
+        operands.append(x)
+        nx += 1
+        if stride == 1:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, wd, ci),
+                lambda n_, i_: (n_, jnp.minimum(th * i_ + th, h - 1), 0, 0)))
+            operands.append(x)
+            nx += 1
+    in_specs.append(pl.BlockSpec((k, k, ci, co),
+                                 lambda n_, i_: (0, 0, 0, 0)))
+    operands.append(w)
+
+    out_shapes = [jax.ShapeDtypeStruct((n, ho, wo, co), dtype)]
+    out_specs = [pl.BlockSpec((1, th, wo, co), lambda n_, i_: (n_, i_, 0, 0))]
+    if emit_stats:
+        out_shapes.append(jax.ShapeDtypeStruct((2, co), jnp.float32))
+        out_specs.append(pl.BlockSpec((2, co), lambda n_, i_: (0, 0)))
+
+    def kernel(*refs):
+        vec_refs = refs[:nvec]
+        x_refs = refs[nvec:nvec + nx]
+        w_ref = refs[nvec + nx]
+        y_ref = refs[nvec + nx + 1]
+        stats_ref = refs[nvec + nx + 2] if emit_stats else None
+
+        i = pl.program_id(1)
+        is_first = jnp.logical_and(pl.program_id(0) == 0, i == 0)
+        pro = (vec_refs[0][0], vec_refs[1][0], relu) if has_pro else None
+
+        xc = x_refs[0][0]                                 # (rows_in, W, Ci)
+        if k == 3:
+            parts = [x_refs[1][0], xc]
+            if stride == 1:
+                parts.append(x_refs[2][0])
+            xin = jnp.concatenate(parts, axis=0)
+            hv = _apply_prologue(xin, pro, dtype)
+            hv = _mask_halo_rows(hv, i, top_bad=True, bottom_bad=(stride == 1))
+            hp = _pad_w(hv)
+            acc = _nine_shift_matmul(hp, w_ref, th, wo, stride)
+        else:
+            hv = _apply_prologue(xc, pro, dtype)
+            if stride == 2:
+                hv = hv[0::2, 0::2, :]
+            acc = jnp.dot(hv.reshape(th * wo, ci), w_ref[0, 0],
+                          preferred_element_type=jnp.float32)
+
+        y = acc.astype(dtype)
+        y_ref[0] = y.reshape(th, wo, co)
+        if emit_stats:
+            yf = y.astype(jnp.float32)
+            s = jnp.stack([jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)])
+            _accumulate_out(stats_ref, s, is_first)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n, ht),
+        in_specs=in_specs,
+        out_specs=out_specs if emit_stats else out_specs[0],
+        out_shape=out_shapes if emit_stats else out_shapes[0],
+        interpret=_need_interpret(interpret),
+    )(*operands)
+    return (out[0], out[1]) if emit_stats else (out, None)
+
+
+# ---------------------------------------------------------------------------
+# weight gradient: dw = sum_pixels act(bn(x))^T (.) g, with the BN backward
+# reconstruction of g riding the g-side read
+# ---------------------------------------------------------------------------
+def conv_wgrad(x, g_parts, w_shape, *, stride=1, x_prologue=None,
+               g_bnbwd=None, interpret=None):
+    """dw for conv_fwd, accumulated f32 across the whole grid.
+
+    x: (N, H, W, Ci) raw input; g_parts: the complete output gradient
+    (N, Ho, Wo, Co) when ``g_bnbwd`` is None, else ``(e, y_raw)`` from
+    which dL/dy is reconstructed per tile (see _bnbwd_value);
+    w_shape: (k, k, Ci, Co); x_prologue: (scale, bias, relu) BN-apply
+    consts for the x side.
+    """
+    n, h, wd, ci = x.shape
+    k = int(w_shape[0])
+    co = int(w_shape[-1])
+    ho, wo = h // stride, wd // stride
+    th = _tile_rows(ho)
+    ht = ho // th
+    rows_in = stride * th
+    dtype = x.dtype
+    has_xpro = x_prologue is not None
+    x_relu = bool(x_prologue[2]) if has_xpro else False
+
+    operands, in_specs = [], []
+    if has_xpro:
+        operands += [x_prologue[0].reshape(1, 1, ci).astype(jnp.float32),
+                     x_prologue[1].reshape(1, 1, ci).astype(jnp.float32)]
+        in_specs += [_vec_spec(ci), _vec_spec(ci)]
+    n_xvec = len(operands)
+    if g_bnbwd is not None:
+        operands += [c.reshape(1, 1, co).astype(jnp.float32) for c in g_bnbwd]
+        in_specs += [_vec_spec(co)] * 5
+    nvec = len(operands)
+
+    in_specs.append(pl.BlockSpec((1, rows_in, wd, ci),
+                                 lambda n_, i_: (n_, i_, 0, 0)))
+    operands.append(x)
+    nx = 1
+    if k == 3:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, wd, ci),
+            lambda n_, i_: (n_, jnp.maximum(rows_in * i_ - 1, 0), 0, 0)))
+        operands.append(x)
+        nx += 1
+        if stride == 1:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, wd, ci),
+                lambda n_, i_: (n_, jnp.minimum(th * i_ + th, h - 1), 0, 0)))
+            operands.append(x)
+            nx += 1
+    g_spec = pl.BlockSpec((1, th, wo, co), lambda n_, i_: (n_, i_, 0, 0))
+    if g_bnbwd is None:
+        in_specs.append(g_spec)
+        operands.append(g_parts)
+        n_g = 1
+    else:
+        in_specs += [g_spec, g_spec]
+        operands += [g_parts[0], g_parts[1]]
+        n_g = 2
+
+    def kernel(*refs):
+        vec_refs = refs[:nvec]
+        x_refs = refs[nvec:nvec + nx]
+        g_refs = refs[nvec + nx:nvec + nx + n_g]
+        dw_ref = refs[nvec + nx + n_g]
+
+        i = pl.program_id(1)
+        is_first = jnp.logical_and(pl.program_id(0) == 0, i == 0)
+        pro = (vec_refs[0][0], vec_refs[1][0], x_relu) if has_xpro else None
+
+        if g_bnbwd is None:
+            g_val = g_refs[0][0].astype(jnp.float32)
+        else:
+            consts = tuple(vec_refs[n_xvec + j][...] for j in range(5))
+            g_val = _bnbwd_value(g_refs[0][0], g_refs[1][0], consts)
+        gf = g_val.reshape(th * wo, co).astype(dtype)
+
+        xc = x_refs[0][0]
+        if k == 3:
+            parts = [x_refs[1][0], xc]
+            if stride == 1:
+                parts.append(x_refs[2][0])
+            xin = jnp.concatenate(parts, axis=0)
+            hv = _apply_prologue(xin, pro, dtype)
+            hv = _mask_halo_rows(hv, i, top_bad=True, bottom_bad=(stride == 1))
+            hp = _pad_w(hv)
+            dws = []
+            for dy in range(3):
+                for dx in range(3):
+                    if stride == 1:
+                        xs = hp[dy:dy + th, dx:dx + wo, :]
+                    else:
+                        xs = hp[dy:dy + 2 * th - 1:2, dx:dx + 2 * wo - 1:2, :]
+                    dws.append(jax.lax.dot_general(
+                        xs.reshape(th * wo, ci), gf,
+                        dimension_numbers=(((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+            dw = jnp.stack(dws).reshape(3, 3, ci, co)
+        else:
+            hv = _apply_prologue(xc, pro, dtype)
+            if stride == 2:
+                hv = hv[0::2, 0::2, :]
+            dw = jax.lax.dot_general(
+                hv.reshape(th * wo, ci), gf,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).reshape(1, 1, ci, co)
+        _accumulate_out(dw_ref, dw, is_first)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n, ht),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((k, k, ci, co), lambda n_, i_: (0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, k, ci, co), jnp.float32),
+        interpret=_need_interpret(interpret),
+    )(*operands)
+
+
+# ---------------------------------------------------------------------------
+# data gradient: e_out = mask(y_in) * (g (*) w^T), plus (dbeta, dgamma)
+# accumulation — the BN-backward input-side partial for the next layer down
+# ---------------------------------------------------------------------------
+def conv_dgrad(g_parts, w, x_shape, *, stride=1, g_bnbwd=None,
+               out_mask=None, extra=None, interpret=None):
+    """Input gradient of conv_fwd with fused epilogue.
+
+    g_parts: complete gradient (N, Ho, Wo, Co), or ``(e, y_raw)`` with
+    ``g_bnbwd`` consts; w: (k, k, Ci, Co); x_shape: (N, H, W, Ci).
+
+    out_mask: None → returns (dx, None) with raw dL/dx. Or (y_in,
+    gamma, beta, mu, inv_sigma) — the conv input's own BN: computes
+    mask = (gamma*xhat + beta > 0), returns (e_out, stats) where
+    e_out = mask * dL/dact and stats is (2, Ci) f32
+    [sum(e_out), sum(e_out*xhat)] = (dbeta, dgamma) of that BN.
+
+    extra: optional (g2, w2, stride2) second 1x1-conv contribution
+    added to dL/dact before masking (the downsample unit's shortcut
+    join at act1); g2 is a complete gradient at stride2 resolution.
+    """
+    n, h, wd, ci = x_shape
+    k = int(w.shape[0])
+    co = int(w.shape[-1])
+    ho, wo = h // stride, wd // stride
+    th_in = _tile_rows(h)
+    if stride == 2 and th_in % 2:
+        th_in = 2 if h % 2 == 0 else 1
+    ht = h // th_in
+    th_g = th_in // stride
+    dtype = w.dtype
+
+    # flipped, io-transposed kernel: dgrad = conv(g_stuffed, wflip)
+    wflip = jnp.flip(jnp.flip(w, 0), 1).transpose(0, 1, 3, 2)  # (k,k,Co,Ci)
+
+    operands, in_specs = [], []
+    if g_bnbwd is not None:
+        operands += [c.reshape(1, 1, co).astype(jnp.float32) for c in g_bnbwd]
+        in_specs += [_vec_spec(co)] * 5
+    n_gvec = len(operands)
+    if out_mask is not None:
+        y_in, m_gamma, m_beta, m_mu, m_inv = out_mask
+        operands += [v.reshape(1, 1, ci).astype(jnp.float32)
+                     for v in (m_gamma, m_beta, m_mu, m_inv)]
+        in_specs += [_vec_spec(ci)] * 4
+    nvec = len(operands)
+
+    halo_top = k == 3 and stride == 1
+    halo_bot = k == 3                       # s2 zero-stuff needs g[h0+th_g]
+    n_g_blocks = 1 + int(halo_top) + int(halo_bot)
+    g_ops = [g_parts] if g_bnbwd is None else [g_parts[0], g_parts[1]]
+    for op in g_ops:
+        in_specs.append(pl.BlockSpec((1, th_g, wo, co),
+                                     lambda n_, i_: (n_, i_, 0, 0)))
+        operands.append(op)
+        if halo_top:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, wo, co),
+                lambda n_, i_: (n_, jnp.maximum(th_g * i_ - 1, 0), 0, 0)))
+            operands.append(op)
+        if halo_bot:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, wo, co),
+                lambda n_, i_: (n_, jnp.minimum(th_g * i_ + th_g, ho - 1),
+                                0, 0)))
+            operands.append(op)
+
+    in_specs.append(pl.BlockSpec((k, k, co, ci), lambda n_, i_: (0, 0, 0, 0)))
+    operands.append(wflip)
+    n_extra = 0
+    if extra is not None:
+        g2, w2, s2 = extra
+        co2 = int(w2.shape[-1])
+        w2t = w2.reshape(ci, co2).T.astype(dtype)            # (Co2, Ci)
+        th_g2 = th_in // s2
+        in_specs.append(pl.BlockSpec((1, th_g2, wd // s2, co2),
+                                     lambda n_, i_: (n_, i_, 0, 0)))
+        operands.append(g2)
+        in_specs.append(pl.BlockSpec((co2, ci), lambda n_, i_: (0, 0)))
+        operands.append(w2t)
+        n_extra = 2
+    if out_mask is not None:
+        in_specs.append(pl.BlockSpec((1, th_in, wd, ci),
+                                     lambda n_, i_: (n_, i_, 0, 0)))
+        operands.append(y_in)
+
+    out_shapes = [jax.ShapeDtypeStruct((n, h, wd, ci), dtype)]
+    out_specs = [pl.BlockSpec((1, th_in, wd, ci),
+                              lambda n_, i_: (n_, i_, 0, 0))]
+    if out_mask is not None:
+        out_shapes.append(jax.ShapeDtypeStruct((2, ci), jnp.float32))
+        out_specs.append(pl.BlockSpec((2, ci), lambda n_, i_: (0, 0)))
+
+    def kernel(*refs):
+        pos = 0
+        vec_refs = refs[pos:pos + nvec]; pos += nvec
+        g_refs = refs[pos:pos + len(g_ops) * n_g_blocks]
+        pos += len(g_ops) * n_g_blocks
+        w_ref = refs[pos]; pos += 1
+        if extra is not None:
+            g2_ref, w2_ref = refs[pos], refs[pos + 1]
+            pos += 2
+        if out_mask is not None:
+            yin_ref = refs[pos]; pos += 1
+        e_ref = refs[pos]; pos += 1
+        stats_ref = refs[pos] if out_mask is not None else None
+
+        i = pl.program_id(1)
+        is_first = jnp.logical_and(pl.program_id(0) == 0, i == 0)
+
+        # assemble g (center + halo rows), reconstructing dL/dy per block
+        if g_bnbwd is None:
+            parts = [g_refs[j][0].astype(jnp.float32)
+                     for j in range(n_g_blocks)]
+        else:
+            consts = tuple(vec_refs[j][...] for j in range(5))
+            parts = [_bnbwd_value(g_refs[j][0], g_refs[n_g_blocks + j][0],
+                                  consts)
+                     for j in range(n_g_blocks)]
+        center, halos = parts[0], parts[1:]
+
+        if k == 1:
+            gm = center.reshape(th_g * wo, co).astype(dtype)
+            m = jnp.dot(gm, w_ref[0, 0], preferred_element_type=jnp.float32)
+            if stride == 1:
+                t = m.reshape(th_in, wd, ci)
+            else:
+                m3 = m.reshape(th_g, wo, ci)
+                t = _interleave_zeros(
+                    _interleave_zeros(m3, axis=1, offset=0), axis=0, offset=0)
+        else:
+            if stride == 1:
+                top = jnp.where(i == 0, jnp.zeros_like(halos[0]), halos[0])
+                bot = jnp.where(i == pl.num_programs(1) - 1,
+                                jnp.zeros_like(halos[1]), halos[1])
+                gin = jnp.concatenate([top, center, bot], axis=0)
+                gp = _pad_w(gin.astype(dtype))
+                t = _nine_shift_matmul(gp, w_ref, th_in, wd, 1)
+                t = t.reshape(th_in, wd, ci)
+            else:
+                # transposed conv via zero-stuffing: gz[2h+1-P0, 2w+1] =
+                # g[h, w] on a (th_in+2, W+2) tile; then a plain 3x3 s1
+                # sweep with the flipped kernel (see derivation in tests)
+                bot = jnp.where(i == pl.num_programs(1) - 1,
+                                jnp.zeros_like(halos[0]), halos[0])
+                g_ext = jnp.concatenate([center, bot], axis=0)  # (th_g+1,..)
+                rows = _interleave_zeros(g_ext, axis=0, offset=1)
+                z = _interleave_zeros(rows, axis=1, offset=1)
+                z = jnp.concatenate(
+                    [z, jnp.zeros((z.shape[0], 2, co), z.dtype)], axis=1)
+                t = _nine_shift_matmul(z.astype(dtype), w_ref, th_in, wd, 1)
+                t = t.reshape(th_in, wd, ci)
+
+        if extra is not None:
+            g2v = g2_ref[0]
+            s2 = extra[2]
+            m2 = jnp.dot(g2v.reshape(-1, co2).astype(dtype), w2_ref[...],
+                         preferred_element_type=jnp.float32)
+            if s2 == 1:
+                t = t + m2.reshape(th_in, wd, ci)
+            else:
+                m3 = m2.reshape(th_in // s2, wd // s2, ci)
+                t = t + _interleave_zeros(
+                    _interleave_zeros(m3, axis=1, offset=0), axis=0, offset=0)
+
+        if out_mask is None:
+            e_ref[0] = t.astype(dtype)
+        else:
+            gmma = vec_refs[n_gvec][...]
+            beta = vec_refs[n_gvec + 1][...]
+            mu = vec_refs[n_gvec + 2][...]
+            inv = vec_refs[n_gvec + 3][...]
+            xhat = (yin_ref[0].astype(jnp.float32) - mu) * inv
+            mask = (gmma * xhat + beta) > 0
+            e_out = jnp.where(mask, t, 0.0)
+            e_ref[0] = e_out.astype(dtype)
+            ef = e_out.reshape(th_in * wd, ci)
+            xf = xhat.reshape(th_in * wd, ci)
+            s = jnp.stack([jnp.sum(ef, axis=0), jnp.sum(ef * xf, axis=0)])
+            _accumulate_out(stats_ref, s, is_first)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n, ht),
+        in_specs=in_specs,
+        out_specs=out_specs if out_mask is not None else out_specs[0],
+        out_shape=out_shapes if out_mask is not None else out_shapes[0],
+        interpret=_need_interpret(interpret),
+    )(*operands)
+    return (out[0], out[1]) if out_mask is not None else (out, None)
+
+
+# ---------------------------------------------------------------------------
+# bottleneck-unit composition (ResNet v2 pre-activation), custom VJP
+# ---------------------------------------------------------------------------
+def _bn_consts(gamma, beta, mean, inv):
+    """Fold (gamma, beta, mean, inv_sigma) into apply (scale, bias)."""
+    scale = gamma.astype(jnp.float32) * inv
+    bias = beta.astype(jnp.float32) - mean * scale
+    return scale, bias
+
+
+def _finalize_stats(stats, count, eps):
+    mean = stats[0] / count
+    var = jnp.maximum(stats[1] / count - mean * mean, 0.0)
+    return mean, var, jax.lax.rsqrt(var + eps)
+
+
+def _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+              stride, eps, interpret):
+    """Training forward. Weights HWIO; data NHWC. Returns out, batch
+    stats (mean/var per BN), and the VJP residuals."""
+    n, h, wd, _ci = data.shape
+    n1 = n * h * wd
+    xf = data.astype(jnp.float32)
+    s0 = jnp.sum(xf, axis=(0, 1, 2))
+    s1 = jnp.sum(xf * xf, axis=(0, 1, 2))
+    mean1, var1, inv1 = _finalize_stats(jnp.stack([s0, s1]), n1, eps)
+    sc1, bi1 = _bn_consts(g1, b1, mean1, inv1)
+
+    y1, st1 = conv_fwd(data, w1, stride=1, prologue=(sc1, bi1, True),
+                       emit_stats=True, interpret=interpret)
+    mean2, var2, inv2 = _finalize_stats(st1, n1, eps)
+    sc2, bi2 = _bn_consts(g2, b2, mean2, inv2)
+
+    y2, st2 = conv_fwd(y1, w2, stride=stride, prologue=(sc2, bi2, True),
+                       emit_stats=True, interpret=interpret)
+    n2 = n * (h // stride) * (wd // stride)
+    mean3, var3, inv3 = _finalize_stats(st2, n2, eps)
+    sc3, bi3 = _bn_consts(g3, b3, mean3, inv3)
+
+    y3, _ = conv_fwd(y2, w3, stride=1, prologue=(sc3, bi3, True),
+                     emit_stats=False, interpret=interpret)
+    if wsc is None:
+        shortcut = data
+    else:
+        shortcut, _ = conv_fwd(data, wsc, stride=stride,
+                               prologue=(sc1, bi1, True), interpret=interpret)
+    out = y3 + shortcut
+    stats = (mean1, var1, mean2, var2, mean3, var3)
+    res = (data, y1, y2, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+           mean1, inv1, mean2, inv2, mean3, inv3)
+    return out, stats, res
+
+
+def _unit_bwd(stride, eps, interpret, res, g):
+    (data, y1, y2, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+     mean1, inv1, mean2, inv2, mean3, inv3) = res
+    n, h, wd, _ci = data.shape
+    n1 = float(n * h * wd)
+    n2 = float(n * (h // stride) * (wd // stride))
+    sc1, bi1 = _bn_consts(g1, b1, mean1, inv1)
+    sc2, bi2 = _bn_consts(g2, b2, mean2, inv2)
+    sc3, bi3 = _bn_consts(g3, b3, mean3, inv3)
+
+    # conv3 (1x1 s1): dgrad emits e2 = mask3 * dact3 and (dbeta3, dgamma3)
+    e2, st3 = conv_dgrad(g, w3, y2.shape, stride=1,
+                         out_mask=(y2, g3, b3, mean3, inv3),
+                         interpret=interpret)
+    dbeta3, dgamma3 = st3[0], st3[1]
+    dw3 = conv_wgrad(y2, g, w3.shape, stride=1,
+                     x_prologue=(sc3, bi3, True), interpret=interpret)
+    cb2 = (g3.astype(jnp.float32) * inv3, mean3, inv3,
+           dbeta3 / n2, dgamma3 / n2)
+
+    # conv2 (3x3, stride): g side reconstructed from (e2, y2) via bn3 bwd
+    dw2 = conv_wgrad(y1, (e2, y2), w2.shape, stride=stride,
+                     x_prologue=(sc2, bi2, True), g_bnbwd=cb2,
+                     interpret=interpret)
+    e1, st2 = conv_dgrad((e2, y2), w2, y1.shape, stride=stride, g_bnbwd=cb2,
+                         out_mask=(y1, g2, b2, mean2, inv2),
+                         interpret=interpret)
+    dbeta2, dgamma2 = st2[0], st2[1]
+    cb1 = (g2.astype(jnp.float32) * inv2, mean2, inv2,
+           dbeta2 / n1, dgamma2 / n1)
+
+    # conv1 (1x1 s1): the downsample shortcut joins at act1 (extra term)
+    dw1 = conv_wgrad(data, (e1, y1), w1.shape, stride=1,
+                     x_prologue=(sc1, bi1, True), g_bnbwd=cb1,
+                     interpret=interpret)
+    extra = None if wsc is None else (g, wsc, stride)
+    e0, st1 = conv_dgrad((e1, y1), w1, data.shape, stride=1, g_bnbwd=cb1,
+                         out_mask=(data, g1, b1, mean1, inv1), extra=extra,
+                         interpret=interpret)
+    dbeta1, dgamma1 = st1[0], st1[1]
+
+    dwsc = None
+    if wsc is not None:
+        dwsc = conv_wgrad(data, g, wsc.shape, stride=stride,
+                          x_prologue=(sc1, bi1, True),
+                          interpret=interpret).astype(wsc.dtype)
+
+    # bn1 backward to the unit input (elementwise; XLA fuses it with the
+    # dim-match shortcut add)
+    xhat0 = (data.astype(jnp.float32) - mean1) * inv1
+    ddata = (g1.astype(jnp.float32) * inv1) * (
+        e0.astype(jnp.float32) - dbeta1 / n1 - xhat0 * (dgamma1 / n1))
+    if wsc is None:
+        ddata = ddata + g.astype(jnp.float32)
+    ddata = ddata.astype(data.dtype)
+
+    return (ddata, dw1.astype(w1.dtype), dw2.astype(w2.dtype),
+            dw3.astype(w3.dtype), dwsc,
+            dgamma1.astype(g1.dtype), dbeta1.astype(b1.dtype),
+            dgamma2.astype(g2.dtype), dbeta2.astype(b2.dtype),
+            dgamma3.astype(g3.dtype), dbeta3.astype(b3.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13))
+def bottleneck_train(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                     stride, eps, interpret):
+    """Fused pre-activation bottleneck unit, training mode.
+
+    Returns (out, (mean1, var1, mean2, var2, mean3, var3)) — the batch
+    statistics feed the caller's moving-stat update (stop-gradient
+    them; they carry no cotangent).
+    """
+    out, stats, _ = _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                              stride, eps, interpret)
+    return out, stats
+
+
+def _bottleneck_train_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                          stride, eps, interpret):
+    out, stats, res = _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2,
+                                g3, b3, stride, eps, interpret)
+    return (out, stats), res
+
+
+def _bottleneck_train_bwd(stride, eps, interpret, res, cotangents):
+    g, _gstats = cotangents
+    return _unit_bwd(stride, eps, interpret, res, g)
+
+
+bottleneck_train.defvjp(_bottleneck_train_fwd, _bottleneck_train_bwd)
+
+
+def bottleneck_infer(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                     mm1, mv1, mm2, mv2, mm3, mv3, *, stride, eps,
+                     interpret=None):
+    """Inference mode: BN applies use the moving statistics."""
+    def consts(gm, bt, mm, mv):
+        inv = jax.lax.rsqrt(mv.astype(jnp.float32) + eps)
+        return _bn_consts(gm, bt, mm.astype(jnp.float32), inv)
+
+    p1 = consts(g1, b1, mm1, mv1) + (True,)
+    y1, _ = conv_fwd(data, w1, stride=1, prologue=p1, interpret=interpret)
+    p2 = consts(g2, b2, mm2, mv2) + (True,)
+    y2, _ = conv_fwd(y1, w2, stride=stride, prologue=p2, interpret=interpret)
+    p3 = consts(g3, b3, mm3, mv3) + (True,)
+    y3, _ = conv_fwd(y2, w3, stride=1, prologue=p3, interpret=interpret)
+    if wsc is None:
+        shortcut = data
+    else:
+        shortcut, _ = conv_fwd(data, wsc, stride=stride, prologue=p1,
+                               interpret=interpret)
+    return y3 + shortcut

@@ -60,6 +60,7 @@ class OpDef:
         "optional_inputs",
         "var_attrs",
         "kwarg_input_order",
+        "aux_state_outputs",
     )
 
     def __init__(
@@ -71,6 +72,7 @@ class OpDef:
         mutate_inputs=(),
         nondiff=False,
         num_visible_outputs=None,
+        aux_state_outputs=None,
     ):
         self.name = name
         self.fn = fn
@@ -78,6 +80,10 @@ class OpDef:
         self.needs_rng = needs_rng
         self.mutate_inputs = tuple(mutate_inputs)
         self.nondiff = nondiff
+        # generic aux-state contract (generalizes BatchNorm's hardcoded
+        # moving_mean/var tier): {input param name -> output index whose
+        # value REPLACES that aux state each training step}
+        self.aux_state_outputs = dict(aux_state_outputs or {})
         # ops like BatchNorm emit aux outputs (mean/var) hidden from the user
         # in the imperative path (ref NumVisibleOutputs in c_api_ndarray.cc)
         self.num_visible_outputs = num_visible_outputs
@@ -129,7 +135,7 @@ class OpDef:
 
 # None-default params with these names are *optional tensor inputs*; any
 # other defaulted param ends the input list (it's an attribute).
-_OPTIONAL_TENSOR_NAMES = {"bias", "gamma", "state_cell", "sequence_length", "weight", "grid", "loc"}
+_OPTIONAL_TENSOR_NAMES = {"bias", "gamma", "state_cell", "sequence_length", "weight", "grid", "loc", "sc_weight"}
 
 
 def _input_names(fn, needs_rng):
@@ -248,6 +254,7 @@ def register(
     mutate_inputs=(),
     nondiff=False,
     num_visible_outputs=None,
+    aux_state_outputs=None,
 ):
     """Decorator registering a pure JAX function as an operator."""
 
@@ -261,6 +268,7 @@ def register(
             mutate_inputs=mutate_inputs,
             nondiff=nondiff,
             num_visible_outputs=num_visible_outputs,
+            aux_state_outputs=aux_state_outputs,
         )
         if opname in _OPS:
             raise MXNetError("duplicate op registration: %s" % opname)

@@ -147,7 +147,8 @@ class Symbol:
         for node in self._topo():
             if node.op is None:
                 continue
-            aux_params = _AUX_PARAMS.get(node.op.name, ())
+            aux_params = set(_AUX_PARAMS.get(node.op.name, ()))
+            aux_params |= set(node.op.aux_state_outputs)
             if not aux_params or node._arity is None:
                 continue
             for pname, (inode, _) in zip(node._arity, node.inputs):
