@@ -6,31 +6,39 @@ train_imagenet.py with synthetic data; baseline 109 img/s on 1x K80,
 example/image-classification/README.md:147-156). Runs the fused SPMD
 training step — forward + backward + SGD-momentum update in ONE XLA
 program, bf16 compute / fp32 master weights — on all available devices
-(one TPU chip under the driver).
+(one TPU chip under the driver). Two graph variants:
+
+- ``fused``: the Pallas fused-bottleneck ResNet (kernels/fused_block.py)
+- ``unfused``: the plain XLA graph (the round-1/2 baseline)
+
+The parent process measures each variant in a FRESH subprocess (the axon
+TPU tunnel can wedge; a wedged child is killed and retried — round-2/3
+lost their bench numbers to exactly this) and reports the best success.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md)
+CHILD_INIT_TIMEOUT = int(os.environ.get("BENCH_INIT_TIMEOUT", 300))
+CHILD_TOTAL_TIMEOUT = int(os.environ.get("BENCH_CHILD_TIMEOUT", 1200))
+PARENT_BUDGET = int(os.environ.get("BENCH_BUDGET", 2400))
 
 
-def _device_probe_watchdog(seconds=300):
-    """Emit a diagnostic JSON line instead of hanging forever when the
-    remote TPU backend is unreachable (a wedged tunnel blocks the first
-    device touch inside a C call that never returns to the interpreter,
-    so this must be a timer *thread*, not a signal handler; normal init
-    is <60 s). Returns a cancel() callable."""
+def _device_probe_watchdog(seconds=CHILD_INIT_TIMEOUT):
+    """Emit a diagnostic line instead of hanging forever when the remote
+    TPU backend is unreachable (a wedged tunnel blocks the first device
+    touch inside a C call that never returns to the interpreter, so this
+    must be a timer *thread*, not a signal handler; normal init <60 s)."""
     import threading
 
     def _fire():
         sys.stdout.write(json.dumps({
-            "metric": "resnet50_imagenet_train_throughput", "value": 0.0,
-            "unit": "img/s", "vs_baseline": 0.0,
             "error": "TPU backend initialization exceeded %ds "
                      "(tunnel unreachable?)" % seconds}) + "\n")
         sys.stdout.flush()
@@ -42,12 +50,13 @@ def _device_probe_watchdog(seconds=300):
     return timer.cancel
 
 
-def main():
+def _measure(variant):
+    """Child: measure one graph variant, print one JSON line."""
     cancel_watchdog = _device_probe_watchdog()
     import jax
     import numpy as np
 
-    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import mxnet_tpu as mx
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel import make_mesh
@@ -55,14 +64,17 @@ def main():
 
     n_dev = len(jax.devices())
     cancel_watchdog()  # backend is up; compile/run own their time
-    sym = resnet.get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224))
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224),
+                            fused=(variant == "fused"))
 
     for per_dev_batch in (256, 128, 64, 32):
         batch = per_dev_batch * n_dev
         try:
             ts = TrainStep(
                 sym,
-                functional_optimizer("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4),
+                functional_optimizer("sgd", learning_rate=0.1, momentum=0.9,
+                                     wd=1e-4),
                 mesh=make_mesh({"dp": n_dev}),
                 compute_dtype="bfloat16",
             )
@@ -74,16 +86,15 @@ def main():
             rng = np.random.RandomState(0)
             batch_np = {
                 "data": rng.randn(batch, 3, 224, 224).astype(np.float32),
-                "softmax_label": rng.randint(0, 1000, (batch,)).astype(np.float32),
+                "softmax_label": rng.randint(0, 1000, (batch,))
+                .astype(np.float32),
             }
             key = jax.random.PRNGKey(0)
-            # place the synthetic batch once (input pipeline is measured by
-            # the IO benches, not this compute bench — parity with the
-            # reference's --benchmark 1 synthetic mode)
             from mxnet_tpu.parallel.spmd import data_sharding
 
             sharding = data_sharding(ts.mesh)
-            batch_dev = {k: jax.device_put(v, sharding) for k, v in batch_np.items()}
+            batch_dev = {k: jax.device_put(v, sharding)
+                         for k, v in batch_np.items()}
 
             carry, loss = ts(carry, batch_dev, key)  # compile + warmup
             jax.block_until_ready(loss)
@@ -99,19 +110,79 @@ def main():
             # where a remote-tunnel runtime under-reports block_until_ready
             dt = time.perf_counter() - t0
             img_s = batch * n_steps / dt
-            print(json.dumps({
-                "metric": "resnet50_imagenet_train_throughput",
-                "value": round(img_s, 2),
-                "unit": "img/s",
-                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-            }))
+            print(json.dumps({"img_s": round(img_s, 2), "variant": variant,
+                              "batch": per_dev_batch}))
             return
         except Exception as e:  # OOM at this batch — try smaller
-            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
                 continue
-            raise
-    raise SystemExit("bench: all batch sizes exhausted device memory")
+            print(json.dumps({"error": "%s: %s" % (variant, msg[:500])}))
+            return
+    print(json.dumps({"error": "%s: all batch sizes OOM" % variant}))
+
+
+def main():
+    deadline = time.time() + PARENT_BUDGET
+    results = {}
+    errors = []
+    # fused is the headline; unfused is the safety net. Two tries each —
+    # a wedged tunnel sometimes recovers between attempts.
+    for variant in ("fused", "unfused", "fused", "unfused"):
+        if variant in results:
+            continue
+        if time.time() > deadline - 60:
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--worker", variant],
+                capture_output=True, text=True,
+                timeout=min(CHILD_TOTAL_TIMEOUT,
+                            max(60, deadline - time.time())),
+            )
+            line = None
+            for ln in (proc.stdout or "").splitlines():
+                ln = ln.strip()
+                if not ln.startswith("{"):
+                    continue
+                try:
+                    parsed = json.loads(ln)
+                except ValueError:
+                    continue  # stray brace-looking log line
+                if "img_s" in parsed or "error" in parsed:
+                    line = parsed
+            if line and "img_s" in line:
+                results[variant] = line
+            else:
+                stderr_tail = (proc.stderr or "").strip()[-300:]
+                errors.append((line or {}).get(
+                    "error", "no result (rc=%s) %s"
+                    % (proc.returncode, stderr_tail)))
+                time.sleep(30)  # give a flaky tunnel a moment
+        except subprocess.TimeoutExpired:
+            errors.append("%s: child timeout" % variant)
+    if results:
+        best = max(results.values(), key=lambda r: r["img_s"])
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput",
+            "value": best["img_s"],
+            "unit": "img/s",
+            "vs_baseline": round(best["img_s"] / BASELINE_IMG_S, 3),
+            "variant": best["variant"],
+            "all": {k: v["img_s"] for k, v in results.items()},
+        }))
+    else:
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput",
+            "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+            "error": "; ".join(errors[-3:]) or "no attempts ran",
+        }))
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        _measure(sys.argv[2])
+    else:
+        main()
