@@ -101,6 +101,7 @@ class TapeNode:
         "rng_key",
         "saved",
         "custom",
+        "freed",
     )
 
     def __init__(self, op, attrs, inputs, input_values, n_outputs, rng_key=None, custom=None):
@@ -112,6 +113,7 @@ class TapeNode:
         self.rng_key = rng_key
         self.custom = custom  # optional CustomFunction providing backward
         self.saved = None
+        self.freed = False  # set when backward(retain_graph=False) guts it
 
 
 class GradEntry:
@@ -246,6 +248,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             node.inputs = []
             node.input_values = []
             node.saved = None
+            node.freed = True
         for arr in heads:
             e = getattr(arr, "_grad_entry", None)
             if e is not None and not e.is_variable:
@@ -316,7 +319,67 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
 
 def get_symbol(x):
-    raise MXNetError("autograd.get_symbol is not supported on the TPU runtime")
+    """Symbolize the recorded imperative graph reaching ``x`` (ref:
+    MXAutogradGetSymbol, c_api.h:792 / Imperative::GetGraph).
+
+    Leaf arrays (inputs and marked variables) become variables named
+    ``var0, var1, ...`` in first-use order; recorded ops re-compose as
+    symbol nodes with their recorded attrs. Graphs containing a Python
+    ``autograd.Function`` node cannot be symbolized (the reference has
+    the same limitation for its CachedOp-less custom functions)."""
+    from .symbol.symbol import Symbol, _Node, _infer_arity
+
+    entry = getattr(x, "_grad_entry", None)
+    if entry is None or entry.node is None:
+        raise MXNetError(
+            "autograd.get_symbol: array is not the output of a recorded op")
+    node_memo = {}
+    var_memo = {}
+    counter = [0]
+
+    def entry_for_array(arr):
+        e = getattr(arr, "_grad_entry", None)
+        if e is not None and e.node is not None:
+            return (build(e.node), e.index)
+        key = id(arr)
+        if key not in var_memo:
+            var_memo[key] = _Node(None, {}, [], "var%d" % counter[0])
+            counter[0] += 1
+        return (var_memo[key], 0)
+
+    def build(tnode):
+        if id(tnode) in node_memo:
+            return node_memo[id(tnode)]
+        if tnode.op is None:
+            raise MXNetError(
+                "autograd.get_symbol: graph contains a Python "
+                "autograd.Function node; only operator graphs symbolize")
+        if tnode.freed:
+            raise MXNetError(
+                "autograd.get_symbol: graph was freed by backward(); "
+                "pass retain_graph=True to keep it symbolizable")
+        attrs = {k: v for k, v in tnode.attrs.items()
+                 if not k.startswith("__")}
+        # omitted trailing optional inputs (recorded as None) drop, the
+        # same convention as create_symbol; a non-trailing hole cannot
+        # be represented as a graph node
+        arrays = list(tnode.inputs)
+        while arrays and arrays[-1] is None:
+            arrays.pop()
+        if any(a is None for a in arrays):
+            raise MXNetError(
+                "autograd.get_symbol: op %s was recorded with a "
+                "non-trailing missing optional input" % tnode.op.name)
+        inputs = [entry_for_array(a) for a in arrays]
+        n = _Node(tnode.op, attrs, inputs,
+                  "%s%d" % (tnode.op.name.lstrip("_").lower(),
+                            len(node_memo)),
+                  arity=_infer_arity(tnode.op, len(inputs)))
+        node_memo[id(tnode)] = n
+        return n
+
+    head = build(entry.node)
+    return Symbol([(head, entry.index)])
 
 
 class Function:

@@ -1099,3 +1099,25 @@ def ndarray_create_from_shared_mem(shared_pid, shared_id, shape, dtype_id):
     except OSError:
         pass
     return out
+
+
+# ---------------------------------------------------------------------------
+# Custom operator C tier (MXCustomOpRegister / MXCustomFunctionRecord /
+# MXAutogradGetSymbol) — marshalling lives in mxnet_tpu.c_custom
+# ---------------------------------------------------------------------------
+def custom_op_register(op_type, creator_addr):
+    from .c_custom import register_c_op
+
+    return register_c_op(op_type, creator_addr)
+
+
+def custom_function_record(inputs, outputs, cblist_addr):
+    from .c_custom import record_custom_function
+
+    return record_custom_function(inputs, outputs, cblist_addr)
+
+
+def autograd_get_symbol(arr):
+    from . import autograd as ag
+
+    return ag.get_symbol(arr)

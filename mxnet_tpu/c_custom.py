@@ -1,0 +1,439 @@
+"""C custom-op tier: ctypes marshalling behind MXCustomOpRegister /
+MXCustomFunctionRecord.
+
+Reference counterpart: ``src/operator/custom/custom.cc:50-414`` and
+``custom_function.cc`` — the ABI through which ANY frontend (not just
+Python) defines operators: the frontend hands the engine a table of C
+callbacks (MXCallbackList) and the engine calls back with NDArray
+handles. Here the engine side is this module: a registered C creator is
+wrapped into a :class:`mxnet_tpu.operator.CustomOpProp` subclass whose
+methods invoke the C callbacks through ctypes, so C-defined ops flow
+through the exact same Custom-op path (graph + imperative + autograd)
+as Python-defined ones.
+
+Tensor traffic crosses the C boundary as NDArray handles manufactured
+through the library's own public ABI (MXNDArrayCreate →
+SyncCopyFromCPU → callback → SyncCopyToCPU), mirroring the reference's
+handle-passing contract; callbacks mutate outputs through
+MXNDArraySyncCopyFromCPU, the documented write path.
+
+Callback layout parity (c_api.h:130-182):
+- forward  ptrs/tags: in_data(0) out_data(1) aux(4); reqs per output
+- backward ptrs/tags: out_grad(3) in_data(0) out_data(1) in_grad(2)
+  aux(4); reqs per input
+- InferShape: called with total = n_args + n_outs + n_aux entries,
+  input slots prefilled, callback fills the rest (custom.cc:105-146).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from .base import MXNetError
+
+# -- ABI types (c_api.h) ----------------------------------------------------
+_GenericFunc = ctypes.CFUNCTYPE(ctypes.c_int)
+
+
+class MXCallbackList(ctypes.Structure):
+    _fields_ = [
+        ("num_callbacks", ctypes.c_int),
+        ("callbacks", ctypes.POINTER(_GenericFunc)),
+        ("contexts", ctypes.POINTER(ctypes.c_void_p)),
+    ]
+
+
+# enum CustomOpCallbacks / CustomOpPropCallbacks / CustomFunctionCallbacks
+K_OP_DELETE, K_OP_FORWARD, K_OP_BACKWARD = range(3)
+(K_PROP_DELETE, K_PROP_LIST_ARGS, K_PROP_LIST_OUTS, K_PROP_LIST_AUX,
+ K_PROP_INFER_SHAPE, K_PROP_BWD_DEP, K_PROP_CREATE_OP,
+ K_PROP_INFER_TYPE) = range(8)
+K_FUNC_BACKWARD, K_FUNC_DELETE = range(2)
+
+_c_int_p = ctypes.POINTER(ctypes.c_int)
+_c_uint_p = ctypes.POINTER(ctypes.c_uint)
+
+PropCreator = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(MXCallbackList))
+ListFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+    ctypes.c_void_p)
+InferShapeFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, _c_int_p, ctypes.POINTER(_c_uint_p),
+    ctypes.c_void_p)
+InferTypeFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, _c_int_p, ctypes.c_void_p)
+BwdDepFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, _c_int_p, _c_int_p, _c_int_p, _c_int_p,
+    ctypes.POINTER(_c_int_p), ctypes.c_void_p)
+CreateFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(_c_uint_p),
+    _c_int_p, _c_int_p, ctypes.POINTER(MXCallbackList), ctypes.c_void_p)
+FBFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), _c_int_p,
+    _c_int_p, ctypes.c_int, ctypes.c_void_p)
+FuncBwdFunc = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_void_p), _c_int_p, ctypes.c_int, ctypes.c_void_p)
+DelFunc = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+_REQ_TO_INT = {"null": 0, "write": 1, "inplace": 2, "add": 3,
+               0: 0, 1: 1, 2: 2, 3: 3}
+_DTYPE_TO_ID = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 2}
+_DTYPE_FROM_ID = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+                  4: np.int32, 5: np.int8, 6: np.int64}
+
+_LIB = None
+
+
+def _lib():
+    """The c_api shared library — loaded by path; when this module runs
+    embedded inside it, CDLL returns the already-loaded image."""
+    global _LIB
+    if _LIB is None:
+        path = os.path.join(os.path.dirname(__file__), "lib",
+                            "libmxtpu_c_api.so")
+        if not os.path.exists(path):
+            raise MXNetError(
+                "custom-op C tier: %s not built (tests build it via "
+                "tests/test_c_api.py)" % path)
+        lib = ctypes.CDLL(path)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        _LIB = lib
+    return _LIB
+
+
+def _check(rc):
+    if rc != 0:
+        raise MXNetError("custom-op C tier: %s"
+                         % _lib().MXGetLastError().decode())
+
+
+def _cb(cblist, idx, proto):
+    if idx >= cblist.num_callbacks or not cblist.callbacks[idx]:
+        return None, None
+    fn = ctypes.cast(cblist.callbacks[idx], proto)
+    return fn, cblist.contexts[idx]
+
+
+def _copy_cblist(cblist):
+    """Snapshot a caller-owned MXCallbackList (the struct and its arrays
+    may be stack-allocated on the C side; the reference requires the
+    arrays to outlive the op — copying removes even that footgun)."""
+    out = MXCallbackList()
+    n = cblist.num_callbacks
+    out.num_callbacks = n
+    cbs = (_GenericFunc * n)(*[cblist.callbacks[i] for i in range(n)])
+    ctxs = (ctypes.c_void_p * n)(*[cblist.contexts[i] for i in range(n)])
+    out.callbacks = ctypes.cast(cbs, ctypes.POINTER(_GenericFunc))
+    out.contexts = ctypes.cast(ctxs, ctypes.POINTER(ctypes.c_void_p))
+    out._keepalive = (cbs, ctxs)
+    return out
+
+
+# -- handle manufacture through the public ABI ------------------------------
+def _new_handle(arr):
+    """NDArrayHandle holding a copy of ``arr`` (numpy)."""
+    lib = _lib()
+    arr = np.ascontiguousarray(arr)
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint * arr.ndim)(*arr.shape)
+    tid = _DTYPE_TO_ID[arr.dtype.name]
+    _check(lib.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, tid,
+                                 ctypes.byref(h)))
+    _check(lib.MXNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(arr.size)))
+    return h
+
+
+def _read_handle(h, shape, dtype):
+    lib = _lib()
+    out = np.empty(shape, dtype)
+    _check(lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(out.size)))
+    return out
+
+
+def _free_handles(handles):
+    lib = _lib()
+    for h in handles:
+        lib.MXNDArrayFree(h)
+
+
+def _as_numpy(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+# -- the prop adapter -------------------------------------------------------
+def register_c_op(op_type, creator_addr):
+    """MXCustomOpRegister: wrap a C CustomOpPropCreator as a Python
+    CustomOpProp subclass and register it under ``op_type``."""
+    from . import operator as _operator
+
+    creator = ctypes.cast(ctypes.c_void_p(int(creator_addr)), PropCreator)
+
+    class _CProp(_operator.CustomOpProp):
+        _op_type = str(op_type)
+
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = [str(k).encode() for k in kwargs]
+            vals = [str(v).encode() for v in kwargs.values()]
+            ka = (ctypes.c_char_p * max(len(keys), 1))(*(keys or [None]))
+            va = (ctypes.c_char_p * max(len(vals), 1))(*(vals or [None]))
+            raw = MXCallbackList()
+            if not creator(self._op_type.encode(), len(keys), ka, va,
+                           ctypes.byref(raw)):
+                raise MXNetError("custom op %r: C creator failed"
+                                 % self._op_type)
+            self._cblist = _copy_cblist(raw)
+
+        # ---- metadata callbacks ----
+        def _list(self, idx):
+            fn, ctx = _cb(self._cblist, idx, ListFunc)
+            if fn is None:
+                return []
+            out = ctypes.POINTER(ctypes.c_char_p)()
+            if not fn(ctypes.byref(out), ctx):
+                raise MXNetError("custom op %r: list callback failed"
+                                 % self._op_type)
+            res = []
+            i = 0
+            while out[i]:
+                res.append(out[i].decode())
+                i += 1
+            return res
+
+        def list_arguments(self):
+            return self._list(K_PROP_LIST_ARGS) or ["data"]
+
+        def list_outputs(self):
+            return self._list(K_PROP_LIST_OUTS) or ["output"]
+
+        def list_auxiliary_states(self):
+            return self._list(K_PROP_LIST_AUX)
+
+        def infer_shape(self, in_shape):
+            fn, ctx = _cb(self._cblist, K_PROP_INFER_SHAPE, InferShapeFunc)
+            if fn is None:
+                return super().infer_shape(in_shape)
+            n_in = len(in_shape)
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            ndims = (ctypes.c_int * total)(
+                *([len(s) for s in in_shape] + [0] * (total - n_in)))
+            bufs = [(ctypes.c_uint * max(len(s), 1))(*s) for s in in_shape]
+            shapes = (_c_uint_p * total)()
+            for i, b in enumerate(bufs):
+                shapes[i] = ctypes.cast(b, _c_uint_p)
+            if not fn(total, ndims, shapes, ctx):
+                raise MXNetError("custom op %r: infer_shape failed"
+                                 % self._op_type)
+            all_shapes = [tuple(int(shapes[i][j]) for j in range(ndims[i]))
+                          for i in range(total)]
+            return (all_shapes[:n_in], all_shapes[n_in:n_in + n_out],
+                    all_shapes[n_in + n_out:])
+
+        def infer_type(self, in_type):
+            fn, ctx = _cb(self._cblist, K_PROP_INFER_TYPE, InferTypeFunc)
+            if fn is None:
+                return super().infer_type(in_type)
+            n_in = len(in_type)
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            types = (ctypes.c_int * total)(
+                *([_DTYPE_TO_ID[np.dtype(t).name] for t in in_type]
+                  + [-1] * (total - n_in)))
+            if not fn(total, types, ctx):
+                raise MXNetError("custom op %r: infer_type failed"
+                                 % self._op_type)
+            ids = [int(types[i]) for i in range(total)]
+            conv = [_DTYPE_FROM_ID.get(i, np.float32) for i in ids]
+            return (conv[:n_in], conv[n_in:n_in + n_out],
+                    conv[n_in + n_out:])
+
+        def declare_backward_dependency(self, out_grad, in_data, out_data):
+            fn, ctx = _cb(self._cblist, K_PROP_BWD_DEP, BwdDepFunc)
+            if fn is None:
+                return super().declare_backward_dependency(
+                    out_grad, in_data, out_data)
+            og = (ctypes.c_int * max(len(out_grad), 1))(*(out_grad or [0]))
+            ind = (ctypes.c_int * max(len(in_data), 1))(*(in_data or [0]))
+            od = (ctypes.c_int * max(len(out_data), 1))(*(out_data or [0]))
+            num = ctypes.c_int(0)
+            rdeps = _c_int_p()
+            if not fn(og, ind, od, ctypes.byref(num), ctypes.byref(rdeps),
+                      ctx):
+                raise MXNetError("custom op %r: backward-dependency "
+                                 "callback failed" % self._op_type)
+            return [int(rdeps[i]) for i in range(num.value)]
+
+        def create_operator(self, ctx_str, in_shapes, in_dtypes=None):
+            fn, cctx = _cb(self._cblist, K_PROP_CREATE_OP, CreateFunc)
+            if fn is None:
+                raise MXNetError("custom op %r: no create_operator "
+                                 "callback" % self._op_type)
+            n = len(in_shapes)
+            if in_dtypes is None:
+                in_dtypes = [np.float32] * n
+            ndims = (ctypes.c_int * n)(*[len(s) for s in in_shapes])
+            bufs = [(ctypes.c_uint * max(len(s), 1))(*s) for s in in_shapes]
+            shapes = (_c_uint_p * n)()
+            for i, b in enumerate(bufs):
+                shapes[i] = ctypes.cast(b, _c_uint_p)
+            dtypes = (ctypes.c_int * n)(
+                *[_DTYPE_TO_ID[np.dtype(t).name] for t in in_dtypes])
+            raw = MXCallbackList()
+            if not fn(str(ctx_str).encode(), n, shapes, ndims, dtypes,
+                      ctypes.byref(raw), cctx):
+                raise MXNetError("custom op %r: create_operator failed"
+                                 % self._op_type)
+            return _COp(self._op_type, _copy_cblist(raw))
+
+        def __del__(self):
+            try:
+                fn, ctx = _cb(self._cblist, K_PROP_DELETE, DelFunc)
+                if fn is not None:
+                    fn(ctx)
+            except Exception:
+                pass
+
+    _CProp.__name__ = "CProp_%s" % op_type
+    _operator.register(str(op_type))(_CProp)
+    return True
+
+
+class _COp:
+    """Execution half: forwards/backwards through the C FB callbacks.
+
+    Duck-typed against mxnet_tpu.operator.CustomOp — the custom_call
+    bridge only needs forward/backward/assign."""
+
+    def __init__(self, op_type, cblist):
+        self._op_type = op_type
+        self._cblist = cblist
+
+    def assign(self, dst, req, src):
+        from .operator import CustomOp
+
+        CustomOp.assign(self, dst, req, src)
+
+    def _invoke(self, idx, groups, reqs, is_train):
+        """groups: list of (arrays, tag, writeback); flattens to the
+        (ptrs, tags) ABI arrays, round-trips the data, frees handles."""
+        fn, ctx = _cb(self._cblist, idx, FBFunc)
+        if fn is None:
+            raise MXNetError("custom op %r: missing %s callback"
+                             % (self._op_type,
+                                "forward" if idx == K_OP_FORWARD
+                                else "backward"))
+        ptrs, tags, slots = [], [], []
+        for arrays, tag, writeback in groups:
+            for a in arrays:
+                npv = _as_numpy(a)
+                h = _new_handle(npv)
+                ptrs.append(h.value)
+                tags.append(tag)
+                slots.append((h, a, npv.shape, npv.dtype, writeback))
+        size = len(ptrs)
+        pa = (ctypes.c_void_p * max(size, 1))(*(ptrs or [None]))
+        ta = (ctypes.c_int * max(size, 1))(*(tags or [0]))
+        ra = (ctypes.c_int * max(len(reqs), 1))(
+            *([_REQ_TO_INT.get(r, 1) for r in reqs] or [1]))
+        ok = fn(size, pa, ta, ra, 1 if is_train else 0, ctx)
+        results = []
+        try:
+            if not ok:
+                raise MXNetError("custom op %r: C callback failed"
+                                 % self._op_type)
+            for h, a, shape, dtype, writeback in slots:
+                if writeback:
+                    results.append((a, _read_handle(h, shape, dtype)))
+        finally:
+            _free_handles([h for h, *_rest in slots])
+        return results
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        groups = [(in_data, 0, False), (out_data, 1, True), (aux, 4, True)]
+        updated = self._invoke(K_OP_FORWARD, groups, list(req), is_train)
+        self._writeback(updated)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        groups = [(out_grad, 3, False), (in_data, 0, False),
+                  (out_data, 1, False), (in_grad, 2, True), (aux, 4, True)]
+        updated = self._invoke(K_OP_BACKWARD, groups, list(req), True)
+        self._writeback(updated)
+
+    @staticmethod
+    def _writeback(updated):
+        for target, value in updated:
+            if hasattr(target, "_rebind"):
+                from .ndarray.ndarray import array as _nd_array
+
+                target[:] = _nd_array(value)
+            else:
+                target[:] = value
+
+    def __del__(self):
+        try:
+            fn, ctx = _cb(self._cblist, K_OP_DELETE, DelFunc)
+            if fn is not None:
+                fn(ctx)
+        except Exception:
+            pass
+
+
+# -- custom autograd function (MXCustomFunctionRecord) ----------------------
+def record_custom_function(inputs, outputs, cblist_addr):
+    """Splice a C backward into the autograd tape for imperatively
+    computed outputs (ref: custom_function.cc CustomFunction)."""
+    from . import autograd as ag
+
+    raw = MXCallbackList.from_address(int(cblist_addr))
+    cblist = _copy_cblist(raw)
+
+    class _CFunction(ag.Function):
+        def backward(self, *ograds):
+            fn, ctx = _cb(cblist, K_FUNC_BACKWARD, FuncBwdFunc)
+            if fn is None:
+                raise MXNetError("custom function: no backward callback")
+            og_np = [_as_numpy(g) for g in ograds]
+            ig_np = [np.zeros(_as_numpy(i).shape, _as_numpy(i).dtype)
+                     for i in inputs]
+            handles = [_new_handle(a) for a in og_np + ig_np]
+            try:
+                pa = (ctypes.c_void_p * len(handles))(
+                    *[h.value for h in handles])
+                ra = (ctypes.c_int * max(len(ig_np), 1))(
+                    *([1] * len(ig_np) or [1]))
+                if not fn(len(og_np), len(ig_np), pa, ra, 1, ctx):
+                    raise MXNetError("custom function: C backward failed")
+                grads = [_read_handle(h, a.shape, a.dtype) for h, a in
+                         zip(handles[len(og_np):], ig_np)]
+            finally:
+                _free_handles(handles)
+            from .ndarray.ndarray import array as _nd_array
+
+            return [_nd_array(g) for g in grads]
+
+        def __del__(self):
+            try:
+                fn, ctx = _cb(cblist, K_FUNC_DELETE, DelFunc)
+                if fn is not None:
+                    fn(ctx)
+            except Exception:
+                pass
+
+    f = _CFunction()
+    if ag.is_recording():
+        node = ag.record_op(None, {}, list(inputs), list(outputs),
+                            [i._data() for i in inputs], custom=f)
+        node.saved = [o._data() for o in outputs]
+    return True

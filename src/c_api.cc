@@ -1050,6 +1050,44 @@ int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
   return 0;
 }
 
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = call("autograd_get_symbol", "(O)", obj(handle));
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+/* ---------------- Custom op C tier ----------------
+ * The marshalling (callback structs, handle manufacture for the
+ * frontend callbacks) lives in mxnet_tpu/c_custom.py via ctypes on
+ * this very library; the C entry points only ferry raw pointers as
+ * integers (ref: src/operator/custom/custom.cc:50-414). */
+
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator) {
+  Gil gil;
+  PyObject *r = call("custom_op_register", "(sK)", op_type,
+                     (unsigned long long)(uintptr_t)creator);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           struct MXCallbackList *callbacks) {
+  Gil gil;
+  PyObject *ins = handle_list(inputs, static_cast<mx_uint>(num_inputs));
+  PyObject *outs = handle_list(outputs, static_cast<mx_uint>(num_outputs));
+  PyObject *r = call("custom_function_record", "(OOK)", ins, outs,
+                     (unsigned long long)(uintptr_t)callbacks);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 /* ---------------- KVStore ---------------- */
 
 int MXKVStoreCreate(const char *type, KVStoreHandle *out) {

@@ -155,6 +155,85 @@ MXNET_DLL int MXAutogradBackwardEx(mx_uint num_output,
                                    NDArrayHandle *ograd_handles,
                                    int retain_graph, int train_mode);
 MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+/* Symbolize the autograd-recorded graph reaching `handle`
+ * (ref: MXAutogradGetSymbol, c_api.h:792). Leaf arrays become variables
+ * named var0, var1, ... in first-use order. */
+MXNET_DLL int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+
+/* Custom operator C tier (ref: c_api.h:130-182, 1966, 1975 — the ABI
+ * through which any frontend, not just Python, defines operators;
+ * src/operator/custom/custom.cc). Same callback layout as the
+ * reference:
+ *  - forward ptrs/tags: in_data(0), out_data(1), aux(4); reqs per output
+ *  - backward ptrs/tags: out_grad(3), in_data(0), out_data(1),
+ *    in_grad(2), aux(4); reqs per input
+ * Callbacks receive NDArrayHandles; write results through
+ * MXNDArraySyncCopyFromCPU (the supported mutation path). */
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+
+enum CustomOpCallbacks {
+  kCustomOpDelete,
+  kCustomOpForward,
+  kCustomOpBackward
+};
+
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType
+};
+
+enum CustomFunctionCallbacks {
+  kCustomFunctionBackward,
+  kCustomFunctionDelete
+};
+
+typedef int (*CustomOpFBFunc)(int /*size*/, void ** /*ptrs*/, int * /*tags*/,
+                              const int * /*reqs*/, const int /*is_train*/,
+                              void * /*state*/);
+typedef int (*CustomOpDelFunc)(void * /*state*/);
+typedef int (*CustomOpListFunc)(char *** /*args*/, void * /*state*/);
+typedef int (*CustomOpInferShapeFunc)(int /*num_input*/, int * /*ndims*/,
+                                      unsigned ** /*shapes*/,
+                                      void * /*state*/);
+typedef int (*CustomOpInferTypeFunc)(int /*num_input*/, int * /*types*/,
+                                     void * /*state*/);
+typedef int (*CustomOpBwdDepFunc)(const int * /*out_grad*/,
+                                  const int * /*in_data*/,
+                                  const int * /*out_data*/,
+                                  int * /*num_deps*/, int ** /*rdeps*/,
+                                  void * /*state*/);
+typedef int (*CustomOpCreateFunc)(const char * /*ctx*/, int /*num_inputs*/,
+                                  unsigned ** /*shapes*/,
+                                  const int * /*ndims*/,
+                                  const int * /*dtypes*/,
+                                  struct MXCallbackList * /*ret*/,
+                                  void * /*state*/);
+typedef int (*CustomOpPropCreator)(const char * /*op_type*/,
+                                   const int /*num_kwargs*/,
+                                   const char ** /*keys*/,
+                                   const char ** /*values*/,
+                                   struct MXCallbackList * /*ret*/);
+typedef int (*CustomFunctionBwdFunc)(int /*num_ograds*/, int /*num_igrads*/,
+                                     void ** /*ptrs*/, const int * /*reqs*/,
+                                     const int /*is_train*/,
+                                     void * /*state*/);
+typedef int (*CustomFunctionDelFunc)(void * /*state*/);
+
+MXNET_DLL int MXCustomOpRegister(const char *op_type,
+                                 CustomOpPropCreator creator);
+MXNET_DLL int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                                     int num_outputs, NDArrayHandle *outputs,
+                                     struct MXCallbackList *callbacks);
 
 /* KVStore (ref: MXKVStore*, c_api.cc) */
 MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);

@@ -1,0 +1,323 @@
+"""Custom-op C tier: a pure-C consumer registers an operator through
+MXCustomOpRegister (prop + op callback tables, ref c_api.h:1966 /
+src/operator/custom/custom.cc), drives it through the symbolic executor
+forward+backward, records a custom autograd function via
+MXCustomFunctionRecord (ref c_api.h:1975 / custom_function.cc), and
+symbolizes an imperative graph with MXAutogradGetSymbol (ref
+c_api.h:792). These are the last 3 of the reference's 158 MX* ABI
+functions — with them the name-set diff vs the reference C API is
+empty."""
+import os
+import subprocess
+import sysconfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_SRC = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "c_api.h"
+
+#define CHECK(x) do { if ((x) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; } \
+} while (0)
+
+/* ---- the custom op: out = 2.5 * in ---- */
+static const char *arg_names[] = {"data", NULL};
+static const char *out_names[] = {"output", NULL};
+static const char *no_names[] = {NULL};
+
+static int list_args(char ***out, void *st) {
+  (void)st; *out = (char **)arg_names; return 1;
+}
+static int list_outs(char ***out, void *st) {
+  (void)st; *out = (char **)out_names; return 1;
+}
+static int list_aux(char ***out, void *st) {
+  (void)st; *out = (char **)no_names; return 1;
+}
+
+static unsigned shape_buf[8];
+static int infer_shape(int num_input, int *ndims, unsigned **shapes,
+                       void *st) {
+  (void)st;
+  if (num_input < 2) return 0;
+  ndims[1] = ndims[0];
+  for (int j = 0; j < ndims[0]; ++j) shape_buf[j] = shapes[0][j];
+  shapes[1] = shape_buf;
+  return 1;
+}
+static int infer_type(int num_input, int *types, void *st) {
+  (void)st;
+  if (num_input < 2) return 0;
+  types[1] = types[0];
+  return 1;
+}
+static int bwd_dep(const int *out_grad, const int *in_data,
+                   const int *out_data, int *num_deps, int **rdeps,
+                   void *st) {
+  static int deps[3];
+  (void)st;
+  deps[0] = out_grad[0]; deps[1] = in_data[0]; deps[2] = out_data[0];
+  *num_deps = 3; *rdeps = deps;
+  return 1;
+}
+
+static int scale_apply(void *src, void *dst, float scale) {
+  mx_uint ndim; const mx_uint *sh;
+  if (MXNDArrayGetShape(src, &ndim, &sh) != 0) return 0;
+  size_t n = 1; mx_uint i;
+  for (i = 0; i < ndim; ++i) n *= sh[i];
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (MXNDArraySyncCopyToCPU(src, buf, n) != 0) { free(buf); return 0; }
+  for (size_t j = 0; j < n; ++j) buf[j] *= scale;
+  if (MXNDArraySyncCopyFromCPU(dst, buf, n) != 0) { free(buf); return 0; }
+  free(buf);
+  return 1;
+}
+
+static int fb_forward(int size, void **ptrs, int *tags, const int *reqs,
+                      const int is_train, void *st) {
+  void *in = NULL, *out = NULL;
+  (void)reqs; (void)is_train; (void)st;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 1) out = ptrs[i];
+  }
+  if (!in || !out) return 0;
+  return scale_apply(in, out, 2.5f);
+}
+static int fb_backward(int size, void **ptrs, int *tags, const int *reqs,
+                       const int is_train, void *st) {
+  void *ograd = NULL, *igrad = NULL;
+  (void)reqs; (void)is_train; (void)st;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 3 && !ograd) ograd = ptrs[i];
+    if (tags[i] == 2 && !igrad) igrad = ptrs[i];
+  }
+  if (!ograd || !igrad) return 0;
+  return scale_apply(ograd, igrad, 2.5f);
+}
+static int op_del(void *st) { (void)st; return 1; }
+
+static int (*op_cbs[3])(void);
+static void *op_ctxs[3] = {NULL, NULL, NULL};
+static int create_op(const char *ctx, int num_inputs, unsigned **shapes,
+                     const int *ndims, const int *dtypes,
+                     struct MXCallbackList *ret, void *st) {
+  (void)ctx; (void)num_inputs; (void)shapes; (void)ndims; (void)dtypes;
+  (void)st;
+  op_cbs[kCustomOpDelete] = (int (*)(void))op_del;
+  op_cbs[kCustomOpForward] = (int (*)(void))fb_forward;
+  op_cbs[kCustomOpBackward] = (int (*)(void))fb_backward;
+  ret->num_callbacks = 3;
+  ret->callbacks = op_cbs;
+  ret->contexts = op_ctxs;
+  return 1;
+}
+
+static int (*prop_cbs[8])(void);
+static void *prop_ctxs[8] = {0};
+static int prop_creator(const char *op_type, const int num_kwargs,
+                        const char **keys, const char **vals,
+                        struct MXCallbackList *ret) {
+  (void)op_type; (void)num_kwargs; (void)keys; (void)vals;
+  prop_cbs[kCustomOpPropDelete] = (int (*)(void))op_del;
+  prop_cbs[kCustomOpPropListArguments] = (int (*)(void))list_args;
+  prop_cbs[kCustomOpPropListOutputs] = (int (*)(void))list_outs;
+  prop_cbs[kCustomOpPropListAuxiliaryStates] = (int (*)(void))list_aux;
+  prop_cbs[kCustomOpPropInferShape] = (int (*)(void))infer_shape;
+  prop_cbs[kCustomOpPropDeclareBackwardDependency] = (int (*)(void))bwd_dep;
+  prop_cbs[kCustomOpPropCreateOperator] = (int (*)(void))create_op;
+  prop_cbs[kCustomOpPropInferType] = (int (*)(void))infer_type;
+  ret->num_callbacks = 8;
+  ret->callbacks = prop_cbs;
+  ret->contexts = prop_ctxs;
+  return 1;
+}
+
+/* ---- custom autograd function: igrad = 7 * ograd ---- */
+static int func_bwd(int num_ograds, int num_igrads, void **ptrs,
+                    const int *reqs, const int is_train, void *st) {
+  (void)reqs; (void)is_train; (void)st;
+  if (num_ograds != 1 || num_igrads != 1) return 0;
+  return scale_apply(ptrs[0], ptrs[1], 7.0f);
+}
+static int (*func_cbs[2])(void);
+static void *func_ctxs[2] = {NULL, NULL};
+
+int main(void) {
+  /* 1. register the C custom op */
+  CHECK(MXCustomOpRegister("cscale", prop_creator));
+
+  /* 2. symbolic graph through the executor */
+  SymbolHandle data, custom;
+  CHECK(MXSymbolCreateVariable("data", &data));
+  const char *ck[] = {"op_type"};
+  const char *cv[] = {"cscale"};
+  CHECK(MXSymbolCreateAtomicSymbol("Custom", 1, ck, cv, &custom));
+  SymbolHandle c_args[] = {data};
+  const char *c_arg_names[] = {"data"};
+  CHECK(MXSymbolCompose(custom, "cs", 1, c_arg_names, c_args));
+
+  const char *shape_names[] = {"data"};
+  mx_uint shape_data[] = {2, 3};
+  mx_uint shape_idx[] = {0, 2};
+  mx_uint num_in = 0, num_aux = 0;
+  NDArrayHandle *in_args = NULL, *arg_grads = NULL, *aux = NULL;
+  const char **upd_names = NULL;
+  NDArrayHandle *upd_handles = NULL;
+  int shared_len = 0;
+  ExecutorHandle exe = NULL;
+  const char *req_types[] = {"write"};
+  CHECK(MXExecutorSimpleBind(custom, 1, 0, 0, NULL, NULL, NULL, 0, NULL,
+                             req_types, 1, shape_names, shape_data,
+                             shape_idx, 0, NULL, NULL, 0, NULL, NULL, 0,
+                             NULL, &shared_len, NULL, NULL, &upd_names,
+                             &upd_handles, &num_in, &in_args, &arg_grads,
+                             &num_aux, &aux, NULL, &exe));
+  if (num_in != 1) { fprintf(stderr, "num_in=%u\n", num_in); return 1; }
+
+  float xs[6] = {1, 2, 3, 4, 5, 6};
+  CHECK(MXNDArraySyncCopyFromCPU(in_args[0], xs, 6));
+  CHECK(MXExecutorForward(exe, 1));
+  mx_uint n_outs = 0;
+  NDArrayHandle *eouts = NULL;
+  CHECK(MXExecutorOutputs(exe, &n_outs, &eouts));
+  float ys[6];
+  CHECK(MXNDArraySyncCopyToCPU(eouts[0], ys, 6));
+  for (int i = 0; i < 6; ++i) {
+    if (ys[i] < 2.5f * xs[i] - 1e-4 || ys[i] > 2.5f * xs[i] + 1e-4) {
+      fprintf(stderr, "fwd mismatch %d: %f\n", i, ys[i]);
+      return 1;
+    }
+  }
+  /* backward with ones: dx must be 2.5 everywhere */
+  NDArrayHandle ones = NULL;
+  {
+    mx_uint sh[2] = {2, 3};
+    CHECK(MXNDArrayCreateEx(sh, 2, 1, 0, 0, 0, &ones));
+    float o[6] = {1, 1, 1, 1, 1, 1};
+    CHECK(MXNDArraySyncCopyFromCPU(ones, o, 6));
+  }
+  CHECK(MXExecutorBackward(exe, 1, &ones));
+  float dx[6];
+  CHECK(MXNDArraySyncCopyToCPU(arg_grads[0], dx, 6));
+  for (int i = 0; i < 6; ++i) {
+    if (dx[i] < 2.5f - 1e-4 || dx[i] > 2.5f + 1e-4) {
+      fprintf(stderr, "bwd mismatch %d: %f\n", i, dx[i]);
+      return 1;
+    }
+  }
+  printf("C_CUSTOM_OP_OK\n");
+
+  /* 3. MXCustomFunctionRecord: custom backward on the autograd tape */
+  int prev = 0;
+  CHECK(MXAutogradSetIsRecording(1, &prev));
+  NDArrayHandle x = NULL, y = NULL, gx = NULL;
+  {
+    mx_uint sh[1] = {4};
+    CHECK(MXNDArrayCreateEx(sh, 1, 1, 0, 0, 0, &x));
+    CHECK(MXNDArrayCreateEx(sh, 1, 1, 0, 0, 0, &y));
+    CHECK(MXNDArrayCreateEx(sh, 1, 1, 0, 0, 0, &gx));
+    float v[4] = {1, 2, 3, 4};
+    float z[4] = {0, 0, 0, 0};
+    CHECK(MXNDArraySyncCopyFromCPU(x, v, 4));
+    CHECK(MXNDArraySyncCopyFromCPU(y, v, 4));
+    CHECK(MXNDArraySyncCopyFromCPU(gx, z, 4));
+  }
+  mx_uint req_write = 1;
+  CHECK(MXAutogradMarkVariables(1, &x, &req_write, &gx));
+  struct MXCallbackList fcb;
+  func_cbs[kCustomFunctionBackward] = (int (*)(void))func_bwd;
+  func_cbs[kCustomFunctionDelete] = (int (*)(void))op_del;
+  fcb.num_callbacks = 2;
+  fcb.callbacks = func_cbs;
+  fcb.contexts = func_ctxs;
+  CHECK(MXCustomFunctionRecord(1, &x, 1, &y, &fcb));
+  CHECK(MXAutogradBackwardEx(1, &y, NULL, 0, 1));
+  float gxv[4];
+  CHECK(MXNDArraySyncCopyToCPU(gx, gxv, 4));
+  for (int i = 0; i < 4; ++i) {
+    if (gxv[i] < 7.0f - 1e-4 || gxv[i] > 7.0f + 1e-4) {
+      fprintf(stderr, "func grad mismatch %d: %f\n", i, gxv[i]);
+      return 1;
+    }
+  }
+  printf("C_CUSTOM_FUNCTION_OK\n");
+
+  /* 4. MXAutogradGetSymbol on an imperative op chain */
+  NDArrayHandle exp_in[] = {x};
+  int n_out = 0;
+  NDArrayHandle *exp_out = NULL;
+  CHECK(MXImperativeInvoke("exp", 1, exp_in, &n_out, &exp_out, 0, NULL,
+                           NULL));
+  SymbolHandle recorded = NULL;
+  CHECK(MXAutogradGetSymbol(exp_out[0], &recorded));
+  mx_uint n_args = 0;
+  const char **arg_list = NULL;
+  CHECK(MXSymbolListArguments(recorded, &n_args, &arg_list));
+  if (n_args != 1) { fprintf(stderr, "n_args=%u\n", n_args); return 1; }
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(recorded, &json));
+  if (strstr(json, "exp") == NULL) {
+    fprintf(stderr, "json missing exp op\n");
+    return 1;
+  }
+  printf("C_AUTOGRAD_SYMBOL_OK\n");
+
+  CHECK(MXAutogradSetIsRecording(prev, &prev));
+  MXExecutorFree(exe);
+  MXNotifyShutdown();
+  return 0;
+}
+"""
+
+
+def _build_lib():
+    import tests.test_c_api as tc
+
+    tc._lib()
+
+
+def test_pure_c_custom_op(tmp_path):
+    _build_lib()
+    csrc = tmp_path / "custom.c"
+    csrc.write_text(C_SRC)
+    exe = str(tmp_path / "ccustom")
+    r = subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(ROOT, "src"),
+         "-L", os.path.join(ROOT, "mxnet_tpu", "lib"), "-lmxtpu_c_api",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu", "lib"), "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "C_CUSTOM_OP_OK" in out, out
+    assert "C_CUSTOM_FUNCTION_OK" in out, out
+    assert "C_AUTOGRAD_SYMBOL_OK" in out, out
+
+
+def test_abi_name_set_complete():
+    """158/158: every reference MX* function name appears in c_api.h."""
+    ref_header = "/root/reference/include/mxnet/c_api.h"
+    if not os.path.exists(ref_header):
+        import pytest
+
+        pytest.skip("reference checkout not present")
+    import re
+
+    def names(path):
+        text = open(path).read()
+        return set(re.findall(r"MXNET_DLL\s+\w+\s+(MX\w+)\s*\(", text))
+
+    missing = names(ref_header) - names(os.path.join(ROOT, "src", "c_api.h"))
+    assert not missing, sorted(missing)
