@@ -1,0 +1,264 @@
+"""Faster R-CNN building blocks: anchors, bbox transforms, RPN anchor
+targets, and the ProposalTarget custom op.
+
+Reference counterpart: ``example/rcnn/rcnn/processing/generate_anchor.py``
+(anchor enumeration), ``bbox_transform.py`` (encode/decode),
+``io/rpn.py`` assign_anchor (RPN targets) and ``rcnn/io/rcnn.py``
+sample_rois behind ``symbol/proposal_target.py`` (the Custom op). The
+math is the same; the implementations are vectorized numpy (they run
+host-side — target assignment is data-pipeline work, exactly where the
+reference keeps it) with static output shapes so the surrounding graph
+stays XLA-compilable.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def generate_anchors(stride=8, scales=(1, 2, 4), ratios=(1.0,)):
+    """Base anchors (k, 4) centered on one stride cell, side =
+    stride*scale*sqrt-ratio adjusted (ref generate_anchor.py:10-33)."""
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            anchors.append([cx - 0.5 * (ws * s - 1), cy - 0.5 * (hs * s - 1),
+                            cx + 0.5 * (ws * s - 1), cy + 0.5 * (hs * s - 1)])
+    return np.asarray(anchors, np.float32)
+
+
+def shift_anchors(base, stride, height, width):
+    """All anchors over an (height, width) feature map: (h*w*k, 4)."""
+    sx = np.arange(width) * stride
+    sy = np.arange(height) * stride
+    gx, gy = np.meshgrid(sx, sy)
+    shifts = np.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()], 1)
+    return (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+
+
+def bbox_overlaps(boxes, gts):
+    """IoU matrix (B, G)."""
+    lt = np.maximum(boxes[:, None, :2], gts[None, :, :2])
+    rb = np.minimum(boxes[:, None, 2:4], gts[None, :, 2:4])
+    wh = np.clip(rb - lt + 1.0, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_b = np.prod(boxes[:, 2:4] - boxes[:, :2] + 1.0, 1)
+    area_g = np.prod(gts[:, 2:4] - gts[:, :2] + 1.0, 1)
+    union = area_b[:, None] + area_g[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def bbox_transform(anchors, gts):
+    """Encode gt boxes against anchors (ref bbox_transform.py:12-35)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * (aw - 1)
+    ay = anchors[:, 1] + 0.5 * (ah - 1)
+    gw = gts[:, 2] - gts[:, 0] + 1.0
+    gh = gts[:, 3] - gts[:, 1] + 1.0
+    gx = gts[:, 0] + 0.5 * (gw - 1)
+    gy = gts[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gx - ax) / (aw + 1e-14), (gy - ay) / (ah + 1e-14),
+                     np.log(gw / aw), np.log(gh / ah)], 1).astype(np.float32)
+
+
+def bbox_pred(boxes, deltas):
+    """Decode deltas back to boxes (ref bbox_transform.py:38-65)."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1)
+    cy = boxes[:, 1] + 0.5 * (h - 1)
+    px = deltas[:, 0::4] * w[:, None] + cx[:, None]
+    py = deltas[:, 1::4] * h[:, None] + cy[:, None]
+    pw = np.exp(deltas[:, 2::4]) * w[:, None]
+    ph = np.exp(deltas[:, 3::4]) * h[:, None]
+    return np.stack([px - 0.5 * (pw - 1), py - 0.5 * (ph - 1),
+                     px + 0.5 * (pw - 1), py + 0.5 * (ph - 1)],
+                    2).reshape(boxes.shape[0], -1)
+
+
+def assign_anchor(feat_shape, gt_boxes, im_info, stride=8,
+                  scales=(1, 2, 4), ratios=(1.0,), allowed_border=0,
+                  num_samples=64, fg_fraction=0.5, rng=None):
+    """RPN anchor targets for ONE image (ref io/rpn.py:100-244).
+
+    gt_boxes: (M, 5) [x1, y1, x2, y2, cls], rows with cls < 0 are pads.
+    Returns label (A,), bbox_target (A, 4), bbox_weight (A, 4) with
+    A = h*w*k; label in {-1 ignore, 0 bg, 1 fg}, subsampled to
+    ``num_samples`` with at most ``fg_fraction`` positives.
+    """
+    rng = rng or np.random
+    h, w = feat_shape
+    base = generate_anchors(stride, scales, ratios)
+    anchors = shift_anchors(base, stride, h, w)
+    A = anchors.shape[0]
+    label = np.full((A,), -1.0, np.float32)
+    bbox_target = np.zeros((A, 4), np.float32)
+    bbox_weight = np.zeros((A, 4), np.float32)
+
+    inside = ((anchors[:, 0] >= -allowed_border)
+              & (anchors[:, 1] >= -allowed_border)
+              & (anchors[:, 2] < im_info[1] + allowed_border)
+              & (anchors[:, 3] < im_info[0] + allowed_border))
+    gts = gt_boxes[gt_boxes[:, 4] >= 0][:, :4]
+    idx_inside = np.nonzero(inside)[0]
+    if len(idx_inside) and len(gts):
+        ov = bbox_overlaps(anchors[idx_inside], gts)
+        argmax = ov.argmax(1)
+        maxov = ov[np.arange(len(idx_inside)), argmax]
+        label[idx_inside[maxov < 0.3]] = 0.0
+        # per-gt best anchor is always fg (ref rpn.py:168-173)
+        gt_best = ov.max(0)
+        for g in range(len(gts)):
+            label[idx_inside[ov[:, g] >= gt_best[g] - 1e-5]] = 1.0
+        label[idx_inside[maxov >= 0.7]] = 1.0
+        fg_rows = np.nonzero(label[idx_inside] == 1.0)[0]  # rows into ov
+        fg = idx_inside[fg_rows]
+        bbox_target[fg] = bbox_transform(anchors[fg], gts[argmax[fg_rows]])
+        bbox_weight[fg] = 1.0
+    elif len(idx_inside):
+        label[idx_inside] = 0.0
+
+    # subsample (ref rpn.py:186-204)
+    fg_inds = np.nonzero(label == 1.0)[0]
+    max_fg = int(num_samples * fg_fraction)
+    if len(fg_inds) > max_fg:
+        disable = rng.choice(fg_inds, len(fg_inds) - max_fg, replace=False)
+        label[disable] = -1.0
+    bg_inds = np.nonzero(label == 0.0)[0]
+    max_bg = num_samples - min(max_fg, (label == 1.0).sum())
+    if len(bg_inds) > max_bg:
+        disable = rng.choice(bg_inds, int(len(bg_inds) - max_bg),
+                             replace=False)
+        label[disable] = -1.0
+    bbox_weight[label != 1.0] = 0.0
+    return label, bbox_target, bbox_weight
+
+
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    """Sample RPN rois into RCNN training targets (ref
+    symbol/proposal_target.py + io/rcnn.py sample_rois)."""
+
+    def __init__(self, num_classes="3", batch_images="2", batch_rois="64",
+                 fg_fraction="0.25"):
+        super().__init__(need_top_grad=False)
+        self._num_classes = int(num_classes)
+        self._batch_images = int(batch_images)
+        self._batch_rois = int(batch_rois)
+        self._fg_fraction = float(fg_fraction)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        rpn_rois = in_shape[0]
+        gt = in_shape[1]
+        R = self._batch_rois
+        C = self._num_classes
+        return ([rpn_rois, gt],
+                [[R, 5], [R], [R, 4 * C], [R, 4 * C]], [])
+
+    def create_operator(self, ctx, in_shapes, in_dtypes=None):
+        return ProposalTargetOp(self._num_classes, self._batch_images,
+                                self._batch_rois, self._fg_fraction)
+
+
+class ProposalTargetOp(mx.operator.CustomOp):
+    def __init__(self, num_classes, batch_images, batch_rois, fg_fraction):
+        self._nc = num_classes
+        self._bi = batch_images
+        self._br = batch_rois
+        self._ff = fg_fraction
+        self._rng = np.random.RandomState(0)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()        # (R0, 5) [bidx, x1, y1, x2, y2]
+        gt_all = in_data[1].asnumpy()      # (N, M, 5)
+        per_im = self._br // self._bi
+        out_rois = np.zeros((self._br, 5), np.float32)
+        out_label = np.zeros((self._br,), np.float32)
+        out_target = np.zeros((self._br, 4 * self._nc), np.float32)
+        out_weight = np.zeros((self._br, 4 * self._nc), np.float32)
+        for b in range(self._bi):
+            gts = gt_all[b]
+            gts = gts[gts[:, 4] >= 0]
+            r = rois[rois[:, 0] == b][:, 1:]
+            if len(gts):
+                # gt boxes join the roi pool (ref rcnn.py:118)
+                r = np.concatenate([r, gts[:, :4]], 0)
+            sel_rois, label, target, weight = self._sample(r, gts)
+            sl = slice(b * per_im, (b + 1) * per_im)
+            out_rois[sl, 0] = b
+            out_rois[sl, 1:] = sel_rois
+            out_label[sl] = label
+            out_target[sl] = target
+            out_weight[sl] = weight
+        self.assign(out_data[0], req[0], mx.nd.array(out_rois))
+        self.assign(out_data[1], req[1], mx.nd.array(out_label))
+        self.assign(out_data[2], req[2], mx.nd.array(out_target))
+        self.assign(out_data[3], req[3], mx.nd.array(out_weight))
+
+    def _sample(self, rois, gts):
+        per_im = self._br // self._bi
+        n_fg_max = int(round(per_im * self._ff))
+        label = np.zeros((per_im,), np.float32)
+        target = np.zeros((per_im, 4 * self._nc), np.float32)
+        weight = np.zeros((per_im, 4 * self._nc), np.float32)
+        if len(rois) == 0:
+            return np.zeros((per_im, 4), np.float32), label, target, weight
+        if len(gts):
+            ov = bbox_overlaps(rois, gts[:, :4])
+            argmax = ov.argmax(1)
+            maxov = ov.max(1)
+            fg = np.nonzero(maxov >= 0.5)[0]
+            bg = np.nonzero((maxov < 0.5) & (maxov >= 0.0))[0]
+        else:
+            fg = np.zeros((0,), np.int64)
+            bg = np.arange(len(rois))
+        if len(fg) > n_fg_max:
+            fg = self._rng.choice(fg, n_fg_max, replace=False)
+        n_bg = per_im - len(fg)
+        if len(bg) >= n_bg:
+            bg = self._rng.choice(bg, n_bg, replace=False)
+        elif len(bg) > 0:
+            # too few backgrounds: resample with replacement (ref
+            # io/rcnn.py sample_rois)
+            bg = self._rng.choice(bg, n_bg, replace=True)
+        keep = np.concatenate([fg, bg]).astype(np.int64)
+        is_fg = np.concatenate([np.ones(len(fg), bool),
+                                np.zeros(len(bg), bool)])
+        # an all-foreground image (no bg-eligible rois at all): pad by
+        # resampling fg WITH its true labels — never relabel a
+        # high-IoU roi as background
+        while len(keep) < per_im:
+            n_pad = per_im - len(keep)
+            keep = np.concatenate([keep, keep[:n_pad]])
+            is_fg = np.concatenate([is_fg, is_fg[:n_pad]])
+        sel = rois[keep]
+        if len(gts):
+            cls = gts[argmax[keep], 4] + 1.0     # class ids shift over bg
+            cls[~is_fg] = 0.0
+            label = cls.astype(np.float32)
+            tgt = bbox_transform(sel, gts[argmax[keep], :4])
+            for i in np.nonzero(is_fg)[0]:
+                c = int(label[i])
+                target[i, 4 * c:4 * c + 4] = tgt[i]
+                weight[i, 4 * c:4 * c + 4] = 1.0
+        return sel, label, target, weight
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i], mx.nd.zeros(g.shape))
+
+
+mx.operator.register("proposal_target")(ProposalTargetProp)

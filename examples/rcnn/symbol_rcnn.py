@@ -1,0 +1,159 @@
+"""Faster R-CNN symbols: RPN + proposal + ROIPooling + RCNN heads.
+
+Reference counterpart: ``example/rcnn/rcnn/symbol/symbol_vgg.py``
+get_vgg_train / get_vgg_test — identical topology on a compact
+backbone (the reference's VGG16 conv stack swapped for three
+conv-pool blocks; everything from rpn_conv_3x3 down is structure-for-
+structure the reference graph, TPU-compiled end to end with the
+ProposalTarget Custom op crossing to host exactly where the
+reference's does).
+"""
+import os
+import sys
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import symbol as sym
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import rcnn_utils  # noqa: F401, E402  (registers proposal_target)
+
+NUM_ANCHORS = 3
+STRIDE = 8
+SCALES = (1, 2, 4)
+RATIOS = (1.0,)
+
+
+def _backbone(data):
+    """Three conv-pool blocks -> feature stride 8 (stand-in for the
+    reference's conv1_1..conv5_3, symbol_vgg.py:10-89)."""
+    body = data
+    for i, nf in enumerate((16, 32, 32)):
+        body = sym.Convolution(data=body, num_filter=nf, kernel=(3, 3),
+                               pad=(1, 1), name="conv%d" % (i + 1))
+        body = sym.Activation(data=body, act_type="relu",
+                              name="relu%d" % (i + 1))
+        body = sym.Pooling(data=body, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max", name="pool%d" % (i + 1))
+    return body
+
+
+def _rpn_head(feat):
+    rpn_conv = sym.Convolution(data=feat, num_filter=32, kernel=(3, 3),
+                               pad=(1, 1), name="rpn_conv_3x3")
+    rpn_relu = sym.Activation(data=rpn_conv, act_type="relu",
+                              name="rpn_relu")
+    rpn_cls_score = sym.Convolution(data=rpn_relu,
+                                    num_filter=2 * NUM_ANCHORS,
+                                    kernel=(1, 1), name="rpn_cls_score")
+    rpn_bbox_pred = sym.Convolution(data=rpn_relu,
+                                    num_filter=4 * NUM_ANCHORS,
+                                    kernel=(1, 1), name="rpn_bbox_pred")
+    return rpn_cls_score, rpn_bbox_pred
+
+
+def get_rcnn_train(num_classes=3, batch_images=2, batch_rois=64,
+                   rpn_batch_rois=300):
+    """Training symbol (ref get_vgg_train, symbol_vgg.py:219-300)."""
+    data = sym.var("data")
+    im_info = sym.var("im_info")
+    gt_boxes = sym.var("gt_boxes")
+    rpn_label = sym.var("label")
+    rpn_bbox_target = sym.var("bbox_target")
+    rpn_bbox_weight = sym.var("bbox_weight")
+
+    feat = _backbone(data)
+    rpn_cls_score, rpn_bbox_pred = _rpn_head(feat)
+
+    # RPN classification loss over anchors (ignore label -1)
+    # 4D round-trip exactly as the reference (symbol_vgg.py:246-259):
+    # (N, 2k, H, W) -> (N, 2, kH, W) for the loss/softmax -> back
+    rpn_cls_reshape = sym.Reshape(data=rpn_cls_score, shape=(0, 2, -1, 0),
+                                  name="rpn_cls_score_reshape")
+    rpn_cls_prob = sym.SoftmaxOutput(data=rpn_cls_reshape, label=rpn_label,
+                                     multi_output=True, normalization="valid",
+                                     use_ignore=True, ignore_label=-1,
+                                     name="rpn_cls_prob")
+    # RPN bbox regression (smooth L1 on fg anchors)
+    rpn_bbox_loss_t = rpn_bbox_weight * sym.smooth_l1(
+        data=(rpn_bbox_pred - rpn_bbox_target), scalar=3.0,
+        name="rpn_bbox_loss_")
+    rpn_bbox_loss = sym.MakeLoss(data=rpn_bbox_loss_t,
+                                 grad_scale=1.0 / rpn_batch_rois,
+                                 name="rpn_bbox_loss")
+
+    # proposals (nondiff — gradient stops here, matching the reference)
+    rpn_act = sym.SoftmaxActivation(data=rpn_cls_reshape, mode="channel",
+                                    name="rpn_cls_act")
+    rpn_act_reshape = sym.Reshape(data=rpn_act,
+                                  shape=(0, 2 * NUM_ANCHORS, -1, 0),
+                                  name="rpn_cls_act_reshape")
+    rois = sym.Proposal(cls_prob=rpn_act_reshape, bbox_pred=rpn_bbox_pred,
+                        im_info=im_info, feature_stride=STRIDE,
+                        scales=SCALES, ratios=RATIOS,
+                        rpn_pre_nms_top_n=600,
+                        rpn_post_nms_top_n=rpn_batch_rois,
+                        threshold=0.7, rpn_min_size=4, name="rois")
+
+    # sample rois into RCNN targets (Custom op, host side)
+    group = sym.Custom(rois=rois, gt_boxes=gt_boxes,
+                       op_type="proposal_target", num_classes=num_classes,
+                       batch_images=batch_images, batch_rois=batch_rois,
+                       name="ptarget")
+    sampled_rois = group[0]
+    rcnn_label = group[1]
+    rcnn_bbox_target = group[2]
+    rcnn_bbox_weight = group[3]
+
+    pooled = sym.ROIPooling(data=feat, rois=sampled_rois,
+                            pooled_size=(4, 4),
+                            spatial_scale=1.0 / STRIDE, name="roi_pool")
+    flat = sym.Flatten(data=pooled)
+    fc = sym.FullyConnected(data=flat, num_hidden=64, name="fc6")
+    fc_relu = sym.Activation(data=fc, act_type="relu", name="fc6_relu")
+    cls_score = sym.FullyConnected(data=fc_relu, num_hidden=num_classes,
+                                   name="cls_score")
+    bbox_pred = sym.FullyConnected(data=fc_relu,
+                                   num_hidden=4 * num_classes,
+                                   name="bbox_pred")
+    cls_prob = sym.SoftmaxOutput(data=cls_score, label=rcnn_label,
+                                 normalization="batch", name="cls_prob")
+    bbox_loss_t = rcnn_bbox_weight * sym.smooth_l1(
+        data=(bbox_pred - rcnn_bbox_target), scalar=1.0, name="bbox_loss_")
+    bbox_loss = sym.MakeLoss(data=bbox_loss_t, grad_scale=1.0 / batch_rois,
+                             name="bbox_loss")
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                      sym.BlockGrad(rcnn_label, name="rcnn_label_out")])
+
+
+def get_rcnn_test(num_classes=3, rpn_post_nms_top_n=16):
+    """Inference symbol (ref get_vgg_test, symbol_vgg.py:303-380):
+    proposals -> pooled features -> per-roi class prob + bbox deltas."""
+    data = sym.var("data")
+    im_info = sym.var("im_info")
+    feat = _backbone(data)
+    rpn_cls_score, rpn_bbox_pred = _rpn_head(feat)
+    rpn_cls_reshape = sym.Reshape(data=rpn_cls_score, shape=(0, 2, -1, 0),
+                                  name="rpn_cls_score_reshape")
+    rpn_act = sym.SoftmaxActivation(data=rpn_cls_reshape, mode="channel",
+                                    name="rpn_cls_act")
+    rpn_act_reshape = sym.Reshape(data=rpn_act,
+                                  shape=(0, 2 * NUM_ANCHORS, -1, 0),
+                                  name="rpn_cls_act_reshape")
+    rois = sym.Proposal(cls_prob=rpn_act_reshape, bbox_pred=rpn_bbox_pred,
+                        im_info=im_info, feature_stride=STRIDE,
+                        scales=SCALES, ratios=RATIOS,
+                        rpn_pre_nms_top_n=200,
+                        rpn_post_nms_top_n=rpn_post_nms_top_n,
+                        threshold=0.7, rpn_min_size=4, name="rois")
+    pooled = sym.ROIPooling(data=feat, rois=rois, pooled_size=(4, 4),
+                            spatial_scale=1.0 / STRIDE, name="roi_pool")
+    flat = sym.Flatten(data=pooled)
+    fc = sym.FullyConnected(data=flat, num_hidden=64, name="fc6")
+    fc_relu = sym.Activation(data=fc, act_type="relu", name="fc6_relu")
+    cls_score = sym.FullyConnected(data=fc_relu, num_hidden=num_classes,
+                                   name="cls_score")
+    bbox_pred = sym.FullyConnected(data=fc_relu,
+                                   num_hidden=4 * num_classes,
+                                   name="bbox_pred")
+    cls_prob = sym.softmax(data=cls_score, name="cls_prob_test")
+    return sym.Group([rois, cls_prob, bbox_pred])

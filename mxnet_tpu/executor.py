@@ -15,6 +15,8 @@ jitted JAX functions — XLA performs memory planning, fusion, scheduling and
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -216,6 +218,15 @@ def _solve_shapes(symbol, known_shapes, type_dict, partial=False):
                 node_out[i] = [(tuple(o.shape), o.dtype) for o in out]
                 progress = True
             except Exception:
+                # unresolved nodes are normal mid-fixpoint; set
+                # MXNET_INFER_DEBUG=1 to see what actually failed
+                if os.environ.get("MXNET_INFER_DEBUG"):
+                    import sys
+                    import traceback
+
+                    print("[infer_shape] node %r (%s) failed:\n%s"
+                          % (node.name, node.op.name,
+                             traceback.format_exc()), file=sys.stderr)
                 continue
 
     out_shapes = []

@@ -333,12 +333,16 @@ def softmax_output(
     def so_bwd(res, g):
         out, lab_arr = res
         if multi_output:
-            lab = lab_arr.astype(jnp.int32)
+            # trailing spatial dims flatten against the label (the
+            # reference accepts label (N, d1*d2...) for data
+            # (N, C, d1, d2...), softmax_output-inl.h:154-170)
+            out3 = out.reshape(out.shape[0], out.shape[1], -1)
+            lab = lab_arr.reshape(out.shape[0], -1).astype(jnp.int32)
             onehot = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype, axis=1)
             if smooth_alpha:
                 k = out.shape[1]
                 onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - onehot)
-            grad = out - onehot
+            grad = out3 - onehot
             if use_ignore:
                 mask = (lab != int(ignore_label)).astype(out.dtype)
                 grad = grad * jnp.expand_dims(mask, 1)
@@ -347,7 +351,7 @@ def softmax_output(
                 denom = out.shape[0]
             elif normalization == "valid" and use_ignore:
                 denom = jnp.maximum((lab_arr != ignore_label).sum().astype(out.dtype), 1.0)
-            grad = grad * (grad_scale / denom)
+            grad = (grad * (grad_scale / denom)).reshape(out.shape)
         else:
             flat = out.reshape(out.shape[0], -1)
             lab = lab_arr.reshape(-1).astype(jnp.int32)

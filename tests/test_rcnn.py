@@ -1,0 +1,91 @@
+"""Faster R-CNN example family (examples/rcnn): anchor-target math
+against hand-computed cases, bbox codec roundtrip, ProposalTarget
+sampling, and the end-to-end train/detect loop on the CPU mesh.
+
+Reference bar: example/rcnn — rcnn/io/rpn.py assign_anchor,
+rcnn/processing/bbox_transform.py, symbol/proposal_target.py,
+train_end2end.py."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "rcnn"))
+
+import rcnn_utils  # noqa: E402
+from rcnn_utils import (assign_anchor, bbox_overlaps, bbox_pred,  # noqa: E402
+                        bbox_transform, generate_anchors, shift_anchors)
+
+
+def test_anchor_enumeration():
+    base = generate_anchors(stride=8, scales=(1, 2), ratios=(1.0,))
+    assert base.shape == (2, 4)
+    # scale-1 anchor is the stride cell itself
+    np.testing.assert_allclose(base[0], [0, 0, 7, 7])
+    shifted = shift_anchors(base, 8, 2, 3)
+    assert shifted.shape == (2 * 3 * 2, 4)
+    # last cell's first anchor sits at (16, 8)
+    np.testing.assert_allclose(shifted[-2], [16, 8, 23, 15])
+
+
+def test_bbox_codec_roundtrip():
+    rng = np.random.RandomState(0)
+    anchors = np.abs(rng.rand(20, 2)) * 30
+    anchors = np.concatenate([anchors, anchors + 10 + rng.rand(20, 2) * 20],
+                             1).astype(np.float32)
+    gts = anchors + rng.randn(20, 4).astype(np.float32) * 3
+    gts[:, 2:] = np.maximum(gts[:, 2:], gts[:, :2] + 2)
+    deltas = bbox_transform(anchors, gts)
+    rec = bbox_pred(anchors, deltas)
+    np.testing.assert_allclose(rec, gts, atol=1e-3)
+
+
+def test_assign_anchor_exact_match():
+    """A gt box equal to an anchor: that anchor is fg with ~zero
+    regression target (ref io/rpn.py:160-185)."""
+    base = generate_anchors(stride=8, scales=(2,), ratios=(1.0,))
+    anchors = shift_anchors(base, 8, 4, 4)
+    gt_idx = 5
+    gt = np.concatenate([anchors[gt_idx], [0.0]]).astype(np.float32)[None]
+    label, target, weight = assign_anchor(
+        (4, 4), gt, (32, 32, 1.0), stride=8, scales=(2,), ratios=(1.0,),
+        rng=np.random.RandomState(0))
+    assert label[gt_idx] == 1.0
+    np.testing.assert_allclose(target[gt_idx], 0.0, atol=1e-5)
+    np.testing.assert_allclose(weight[gt_idx], 1.0)
+    # far-away in-image anchors are background or disabled, never fg
+    ov = bbox_overlaps(anchors, gt[:, :4])
+    assert not np.any(label[(ov[:, 0] < 0.3)] == 1.0)
+
+
+def test_proposal_target_sampling():
+    op = rcnn_utils.ProposalTargetOp(num_classes=3, batch_images=1,
+                                     batch_rois=16, fg_fraction=0.25)
+    gts = np.asarray([[10, 10, 30, 30, 1]], np.float32)
+    rois = np.asarray([[11, 11, 31, 31],   # IoU ~0.9 -> fg
+                       [40, 40, 60, 60]],  # IoU 0 -> bg
+                      np.float32)
+    sel, label, target, weight = op._sample(rois, gts)
+    assert sel.shape == (16, 4) and label.shape == (16,)
+    fg = label > 0
+    assert fg.sum() >= 1
+    assert np.all(label[fg] == 2.0)        # class 1 shifts over background
+    # per-class slot layout: weights only in the labeled class's 4-slot
+    row = np.nonzero(fg)[0][0]
+    assert weight[row, 8:12].sum() == 4.0 and weight[row, :8].sum() == 0.0
+
+
+def test_rcnn_end_to_end_train():
+    from train_rcnn import detect, train
+
+    net, exe, hist = train(epochs=4, iters_per_epoch=14,
+                           seed=0)
+    assert hist[-1][0] < hist[0][0] * 0.7, hist   # rpn cls loss fell
+    assert hist[-1][1] < hist[0][1] * 0.8, hist   # rcnn cls loss fell
+    arg_map = dict(zip(net.list_arguments(), exe.arg_arrays))
+    dets, gt = detect(arg_map, score_thresh=0.3)
+    # detections decode to plausible boxes inside the image
+    if len(dets):
+        assert np.all(dets[:, 2:] >= -8) and np.all(dets[:, 2:] <= 72)
