@@ -1,0 +1,346 @@
+"""Hardened operator grids: conv/pool/deconv parameter sweeps with
+forward AND backward pinned to torch, boundary-index ops, degenerate
+reductions, and a reduced-precision forward matrix — plus a mutation
+test proving the grid actually catches planted kernel bugs.
+
+Reference model: ``tests/python/unittest/test_operator.py`` (the
+reference grids conv/pool over kernel/stride/pad/dilate and checks
+degenerate shapes; 4,673 LoC) with torch CPU standing in for the
+reference's CPU kernels as the independent implementation
+(test_utils.py:1203 check_consistency).
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402,F401
+from mxnet_tpu import nd  # noqa: E402
+
+
+def _np(t):
+    return t.detach().numpy()
+
+
+# ---------------------------------------------------------------------------
+# convolution grid: fwd + input/weight grads vs torch autograd
+# ---------------------------------------------------------------------------
+CONV_GRID = [
+    # (in_shape, nf, kernel, stride, pad, dilate, groups)
+    ((2, 4, 9, 9), 6, (3, 3), (1, 1), (0, 0), (1, 1), 1),
+    ((2, 4, 9, 9), 6, (3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((2, 4, 9, 9), 6, (2, 3), (1, 1), (1, 1), (1, 1), 1),   # asymmetric k
+    ((2, 4, 10, 9), 6, (3, 3), (2, 1), (1, 1), (1, 1), 1),  # asymmetric s
+    ((2, 4, 9, 9), 6, (3, 3), (1, 1), (0, 2), (1, 1), 1),   # asymmetric p
+    ((2, 4, 11, 11), 6, (3, 3), (1, 1), (2, 2), (2, 1), 1),  # asym dilate
+    ((2, 4, 9, 9), 4, (3, 3), (1, 1), (1, 1), (1, 1), 2),   # grouped
+    ((2, 4, 9, 9), 4, (1, 1), (2, 2), (0, 0), (1, 1), 4),   # 1x1 depth-ish
+    ((1, 2, 3, 3), 3, (3, 3), (1, 1), (0, 0), (1, 1), 1),   # out = 1x1
+    ((1, 3, 5, 1), 2, (3, 1), (1, 1), (1, 0), (1, 1), 1),   # W = 1 strip
+    ((2, 3, 7, 7), 5, (5, 5), (3, 3), (2, 2), (1, 1), 1),   # stride > half
+]
+
+
+def _check_conv_case(in_shape, nf, kernel, stride, pad, dilate, groups,
+                     seed=0):
+    """Forward + grads of the registered Convolution vs torch. Raises
+    AssertionError on any mismatch (shape or value)."""
+    rng = np.random.RandomState(seed)
+    ci = in_shape[1]
+    x = rng.randn(*in_shape).astype(np.float32)
+    w = rng.randn(nf, ci // groups, *kernel).astype(np.float32)
+    b = rng.randn(nf).astype(np.float32)
+
+    xn, wn, bn = nd.array(x), nd.array(w), nd.array(b)
+    for a in (xn, wn, bn):
+        a.attach_grad()
+    with mx.autograd.record():
+        out = nd.Convolution(xn, wn, bn, kernel=kernel, num_filter=nf,
+                             stride=stride, pad=pad, dilate=dilate,
+                             num_group=groups)
+    xt = torch.tensor(x, requires_grad=True)
+    wt = torch.tensor(w, requires_grad=True)
+    bt = torch.tensor(b, requires_grad=True)
+    want = F.conv2d(xt, wt, bt, stride=stride, padding=pad,
+                    dilation=dilate, groups=groups)
+    assert out.shape == tuple(want.shape), (out.shape, tuple(want.shape))
+    np.testing.assert_allclose(out.asnumpy(), _np(want), rtol=1e-4,
+                               atol=1e-4)
+    cot = rng.randn(*out.shape).astype(np.float32)
+    out.backward(nd.array(cot))
+    want.backward(torch.tensor(cot))
+    np.testing.assert_allclose(xn.grad.asnumpy(), _np(xt.grad), rtol=1e-3,
+                               atol=1e-3, err_msg="dgrad")
+    np.testing.assert_allclose(wn.grad.asnumpy(), _np(wt.grad), rtol=1e-3,
+                               atol=1e-3, err_msg="wgrad")
+    np.testing.assert_allclose(bn.grad.asnumpy(), _np(bt.grad), rtol=1e-3,
+                               atol=1e-3, err_msg="bias grad")
+
+
+@pytest.mark.parametrize("case", CONV_GRID)
+def test_convolution_grid(case):
+    _check_conv_case(*case)
+
+
+DECONV_GRID = [
+    # (in_shape, nf, kernel, stride, pad, adj)
+    ((2, 4, 5, 5), 3, (3, 3), (1, 1), (0, 0), (0, 0)),
+    ((2, 4, 5, 5), 3, (3, 3), (2, 2), (1, 1), (0, 0)),
+    ((2, 4, 5, 5), 3, (3, 3), (2, 2), (1, 1), (1, 1)),
+    ((2, 4, 6, 4), 3, (2, 3), (2, 1), (0, 1), (1, 0)),      # all asymmetric
+    ((1, 2, 1, 1), 2, (4, 4), (4, 4), (0, 0), (0, 0)),      # from 1x1
+]
+
+
+@pytest.mark.parametrize("case", DECONV_GRID)
+def test_deconvolution_grid(case):
+    in_shape, nf, kernel, stride, pad, adj = case
+    rng = np.random.RandomState(1)
+    ci = in_shape[1]
+    x = rng.randn(*in_shape).astype(np.float32)
+    w = rng.randn(ci, nf, *kernel).astype(np.float32)
+
+    xn, wn = nd.array(x), nd.array(w)
+    for a in (xn, wn):
+        a.attach_grad()
+    with mx.autograd.record():
+        out = nd.Deconvolution(xn, wn, kernel=kernel, num_filter=nf,
+                               stride=stride, pad=pad, adj=adj,
+                               no_bias=True)
+    xt = torch.tensor(x, requires_grad=True)
+    wt = torch.tensor(w, requires_grad=True)
+    want = F.conv_transpose2d(xt, wt, stride=stride, padding=pad,
+                              output_padding=adj)
+    assert out.shape == tuple(want.shape), (out.shape, tuple(want.shape))
+    np.testing.assert_allclose(out.asnumpy(), _np(want), rtol=1e-4,
+                               atol=1e-4)
+    cot = rng.randn(*out.shape).astype(np.float32)
+    out.backward(nd.array(cot))
+    want.backward(torch.tensor(cot))
+    np.testing.assert_allclose(xn.grad.asnumpy(), _np(xt.grad), rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(wn.grad.asnumpy(), _np(wt.grad), rtol=1e-3,
+                               atol=1e-3)
+
+
+POOL_GRID = [
+    # (pool_type, in_shape, kernel, stride, pad)
+    ("max", (2, 3, 8, 8), (2, 2), (2, 2), (0, 0)),
+    ("max", (2, 3, 9, 9), (3, 3), (2, 2), (1, 1)),
+    ("max", (2, 3, 8, 6), (2, 3), (2, 1), (1, 1)),          # asymmetric
+    ("avg", (2, 3, 8, 8), (2, 2), (2, 2), (0, 0)),
+    ("avg", (2, 3, 9, 9), (3, 3), (2, 2), (1, 1)),
+    ("avg", (2, 3, 7, 5), (3, 2), (1, 2), (1, 1)),
+    ("max", (1, 2, 3, 3), (3, 3), (1, 1), (0, 0)),          # kernel = input
+]
+
+
+@pytest.mark.parametrize("case", POOL_GRID)
+def test_pooling_grid(case):
+    pool_type, in_shape, kernel, stride, pad = case
+    rng = np.random.RandomState(2)
+    x = rng.randn(*in_shape).astype(np.float32)
+    xn = nd.array(x)
+    xn.attach_grad()
+    with mx.autograd.record():
+        out = nd.Pooling(xn, kernel=kernel, stride=stride, pad=pad,
+                         pool_type=pool_type)
+    xt = torch.tensor(x, requires_grad=True)
+    if pool_type == "max":
+        want = F.max_pool2d(xt, kernel, stride=stride, padding=pad)
+    else:
+        want = F.avg_pool2d(xt, kernel, stride=stride, padding=pad,
+                            count_include_pad=True)
+    assert out.shape == tuple(want.shape), (out.shape, tuple(want.shape))
+    np.testing.assert_allclose(out.asnumpy(), _np(want), rtol=1e-4,
+                               atol=1e-4)
+    cot = rng.randn(*out.shape).astype(np.float32)
+    out.backward(nd.array(cot))
+    want.backward(torch.tensor(cot))
+    np.testing.assert_allclose(xn.grad.asnumpy(), _np(xt.grad), rtol=1e-3,
+                               atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# boundary indices
+# ---------------------------------------------------------------------------
+def test_take_boundary_and_clip():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # first/last valid rows
+    got = nd.take(nd.array(a), nd.array([0, 3], dtype="float32")).asnumpy()
+    np.testing.assert_allclose(got, a[[0, 3]])
+    # out-of-range clips (reference take mode='clip' default)
+    got = nd.take(nd.array(a), nd.array([-5, 99], dtype="float32")).asnumpy()
+    np.testing.assert_allclose(got, a[[0, 3]])
+    # wrap mode
+    got = nd.take(nd.array(a), nd.array([-1, 4], dtype="float32"),
+                  mode="wrap").asnumpy()
+    np.testing.assert_allclose(got, a[[3, 0]])
+
+
+def test_gather_nd_corners():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    # the four extreme corners of the index space
+    idx = np.array([[0, 0, 1, 1],
+                    [0, 2, 0, 2],
+                    [0, 3, 0, 3]], dtype=np.float32)
+    got = nd.gather_nd(nd.array(a), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(got, [a[0, 0, 0], a[0, 2, 3],
+                                     a[1, 0, 0], a[1, 2, 3]])
+    # gradient scatters into exactly those corners
+    xn = nd.array(a)
+    xn.attach_grad()
+    with mx.autograd.record():
+        out = nd.gather_nd(xn, nd.array(idx))
+    out.backward(nd.array(np.ones(4, np.float32)))
+    g = xn.grad.asnumpy()
+    assert g.sum() == 4.0
+    assert g[0, 0, 0] == 1.0 and g[1, 2, 3] == 1.0
+
+
+def test_embedding_boundary_rows():
+    w = np.arange(20, dtype=np.float32).reshape(5, 4)
+    got = nd.Embedding(nd.array(np.array([0, 4], np.float32)), nd.array(w),
+                       input_dim=5, output_dim=4).asnumpy()
+    np.testing.assert_allclose(got, w[[0, 4]])
+
+
+# ---------------------------------------------------------------------------
+# degenerate reductions
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opname", ["sum", "max", "min", "prod", "mean"])
+def test_reduction_degenerate_axes(opname):
+    a = np.random.RandomState(3).rand(2, 1, 3).astype(np.float32) + 0.5
+    op = getattr(nd, opname)
+    npop = {"sum": np.sum, "max": np.max, "min": np.min,
+            "prod": np.prod, "mean": np.mean}[opname]
+    # full reduction (no axis)
+    np.testing.assert_allclose(op(nd.array(a)).asnumpy(),
+                               npop(a), rtol=1e-5)
+    # size-1 axis, keepdims both ways
+    np.testing.assert_allclose(
+        op(nd.array(a), axis=1).asnumpy(), npop(a, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        op(nd.array(a), axis=1, keepdims=True).asnumpy(),
+        npop(a, axis=1, keepdims=True), rtol=1e-5)
+    # negative axis
+    np.testing.assert_allclose(
+        op(nd.array(a), axis=-1).asnumpy(), npop(a, axis=-1), rtol=1e-5)
+    # multi-axis tuple
+    np.testing.assert_allclose(
+        op(nd.array(a), axis=(0, 2)).asnumpy(), npop(a, axis=(0, 2)),
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# reduced-precision forward matrix
+# ---------------------------------------------------------------------------
+_DTYPE_TOL = {"float32": 1e-5, "float16": 2e-2, "bfloat16": 8e-2}
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16"])
+@pytest.mark.parametrize("opcase", ["Convolution", "FullyConnected",
+                                    "BatchNorm", "softmax"])
+def test_reduced_precision_forward(dtype, opcase):
+    """fp16/bf16 forwards: correct output dtype, values within the
+    dtype's noise floor of the fp32 result (ref: fp16 support tier,
+    NEWS.md:18 'up to 3.5x faster on Volta')."""
+    rng = np.random.RandomState(4)
+    tol = _DTYPE_TOL[dtype]
+
+    def run(dt):
+        if opcase == "Convolution":
+            x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32),
+                         dtype=dt)
+            w = nd.array(rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2,
+                         dtype=dt)
+            out = nd.Convolution(x, w, kernel=(3, 3), num_filter=4,
+                                 no_bias=True, pad=(1, 1))
+        elif opcase == "FullyConnected":
+            x = nd.array(rng.randn(4, 8).astype(np.float32), dtype=dt)
+            w = nd.array(rng.randn(5, 8).astype(np.float32) * 0.2,
+                         dtype=dt)
+            b = nd.array(rng.randn(5).astype(np.float32), dtype=dt)
+            out = nd.FullyConnected(x, w, b, num_hidden=5)
+        elif opcase == "BatchNorm":
+            x = nd.array(rng.randn(4, 3, 5, 5).astype(np.float32),
+                         dtype=dt)
+            g = nd.array(np.ones(3, np.float32), dtype=dt)
+            b = nd.array(np.zeros(3, np.float32), dtype=dt)
+            mm = nd.array(np.zeros(3, np.float32), dtype=dt)
+            mv = nd.array(np.ones(3, np.float32), dtype=dt)
+            out = nd.BatchNorm(x, g, b, mm, mv, fix_gamma=False)
+        else:
+            x = nd.array(rng.randn(4, 10).astype(np.float32), dtype=dt)
+            out = nd.softmax(x)
+        return out
+
+    rng = np.random.RandomState(4)
+    ref = run("float32").asnumpy().astype(np.float32)
+    rng = np.random.RandomState(4)
+    out = run(dtype)
+    assert np.dtype(out.dtype).name == dtype
+    val = out.asnumpy().astype(np.float32)
+    assert np.all(np.isfinite(val))
+    scale = np.abs(ref).max() + 1e-6
+    assert np.abs(val - ref).max() / scale < tol, (
+        "%s %s deviates %.4f" % (opcase, dtype,
+                                 np.abs(val - ref).max() / scale))
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: the grid must CATCH planted kernel bugs
+# ---------------------------------------------------------------------------
+def _planted(fn_wrapper):
+    """Context manager temporarily replacing the Convolution kernel.
+    The jitted-apply cache is keyed on (op name, attrs) and closes over
+    op.fn — clear it around the swap or the planted bug never runs."""
+    from mxnet_tpu.ops import registry
+
+    op = registry.get("Convolution")
+    orig = op.fn
+
+    class _Ctx:
+        def __enter__(self):
+            registry._jitted.cache_clear()
+            op.fn = fn_wrapper(orig)
+
+        def __exit__(self, *exc):
+            op.fn = orig
+            registry._jitted.cache_clear()
+
+    return _Ctx()
+
+
+def test_grid_catches_swapped_stride():
+    """Plant stride (sh, sw) -> (sw, sh): the asymmetric-stride grid
+    case must fail on output shape."""
+    def wrap(orig):
+        def buggy(data, weight, bias=None, **kw):
+            s = tuple(kw.get("stride", ()) or ())
+            if len(s) == 2:
+                kw["stride"] = (s[1], s[0])
+            return orig(data, weight, bias, **kw)
+        return buggy
+
+    with _planted(wrap):
+        with pytest.raises(AssertionError):
+            for case in CONV_GRID:
+                _check_conv_case(*case)
+            pytest.fail("planted stride bug survived the grid")
+
+
+def test_grid_catches_flipped_kernel():
+    """Plant a spatially flipped kernel (correlation vs convolution —
+    the classic silent bug: shapes identical, values wrong)."""
+    def wrap(orig):
+        def buggy(data, weight, bias=None, **kw):
+            return orig(data, weight[..., ::-1, ::-1], bias, **kw)
+        return buggy
+
+    with _planted(wrap):
+        with pytest.raises(AssertionError):
+            for case in CONV_GRID:
+                _check_conv_case(*case)
+            pytest.fail("planted kernel-flip bug survived the grid")
