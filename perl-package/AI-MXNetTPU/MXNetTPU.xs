@@ -1,0 +1,399 @@
+/* AI::MXNetTPU — Perl XS binding over the libmxtpu_c_api.so C ABI.
+ *
+ * Reference counterpart: perl-package/AI-MXNetCAPI (the SWIG-generated
+ * layer under AI::MXNet, ~28k LoC perl surface). Same design: a thin
+ * typemap layer over the MX* C functions; the OO surface lives in pure
+ * perl (lib/AI/MXNetTPU/*.pm). Handles cross as IVs; tensors cross as
+ * packed float32 strings (perl's native bulk-binary idiom).
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "c_api.h"
+
+#define MXCHECK(call) do { \
+  if ((call) != 0) croak("mxnet_tpu: %s", MXGetLastError()); \
+} while (0)
+
+static void *iv_handle(pTHX_ SV *sv) {
+  return INT2PTR(void *, SvIV(sv));
+}
+
+/* AV of SVs -> C handle array (caller frees) */
+static void **av_handles(pTHX_ AV *av, int *n) {
+  *n = av_len(av) + 1;
+  void **out = (void **)malloc(sizeof(void *) * (*n > 0 ? *n : 1));
+  int i;
+  for (i = 0; i < *n; ++i) out[i] = iv_handle(aTHX_ *av_fetch(av, i, 0));
+  return out;
+}
+
+static const char **av_strings(pTHX_ AV *av, int *n) {
+  *n = av_len(av) + 1;
+  const char **out =
+      (const char **)malloc(sizeof(char *) * (*n > 0 ? *n : 1));
+  int i;
+  for (i = 0; i < *n; ++i) out[i] = SvPV_nolen(*av_fetch(av, i, 0));
+  return out;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU  PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+void
+mxtpu_list_all_op_names()
+  PPCODE:
+    {
+      mx_uint n = 0, i;
+      const char **names = NULL;
+      MXCHECK(MXListAllOpNames(&n, &names));
+      EXTEND(SP, n);
+      for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+    }
+
+IV
+mxtpu_nd_create(shape_av, dtype_id)
+    AV *shape_av
+    int dtype_id
+  CODE:
+    {
+      int n = av_len(shape_av) + 1, i;
+      mx_uint shape[8];
+      NDArrayHandle h = NULL;
+      if (n > 8) croak("ndim > 8");
+      for (i = 0; i < n; ++i)
+        shape[i] = (mx_uint)SvIV(*av_fetch(shape_av, i, 0));
+      MXCHECK(MXNDArrayCreateEx(shape, (mx_uint)n, 1, 0, 0, dtype_id, &h));
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_nd_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(void *, h));
+
+void
+mxtpu_nd_copy_from_packed(h, data_sv)
+    IV h
+    SV *data_sv
+  CODE:
+    {
+      STRLEN len;
+      const char *p = SvPV(data_sv, len);
+      MXCHECK(MXNDArraySyncCopyFromCPU(INT2PTR(void *, h), p,
+                                       len / sizeof(float)));
+    }
+
+SV *
+mxtpu_nd_copy_to_packed(h, n_elem)
+    IV h
+    IV n_elem
+  CODE:
+    {
+      SV *out = newSV(n_elem * sizeof(float));
+      SvPOK_on(out);
+      SvCUR_set(out, n_elem * sizeof(float));
+      MXCHECK(MXNDArraySyncCopyToCPU(INT2PTR(void *, h), SvPVX(out),
+                                     (size_t)n_elem));
+      RETVAL = out;
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_nd_shape(h)
+    IV h
+  PPCODE:
+    {
+      mx_uint ndim = 0, i;
+      const mx_uint *dims = NULL;
+      MXCHECK(MXNDArrayGetShape(INT2PTR(void *, h), &ndim, &dims));
+      EXTEND(SP, ndim);
+      for (i = 0; i < ndim; ++i) PUSHs(sv_2mortal(newSViv(dims[i])));
+    }
+
+void
+mxtpu_nd_copy_from_nd(dst, src)
+    IV dst
+    IV src
+  CODE:
+    MXCHECK(MXNDArraySyncCopyFromNDArray(INT2PTR(void *, dst),
+                                         INT2PTR(void *, src), -1));
+
+void
+mxtpu_imperative_invoke(op_name, ins_av, outs_av, keys_av, vals_av)
+    const char *op_name
+    AV *ins_av
+    SV *outs_av
+    AV *keys_av
+    AV *vals_av
+  PPCODE:
+    {
+      int n_in, n_keys, n_vals, i;
+      int n_out = 0;
+      NDArrayHandle *outs = NULL;
+      NDArrayHandle fixed[16];
+      /* output-count check precedes every allocation: croak longjmps */
+      if (SvOK(outs_av) && SvROK(outs_av)
+          && av_len((AV *)SvRV(outs_av)) + 1 > 16)
+        croak("too many outputs");
+      void **ins = av_handles(aTHX_ ins_av, &n_in);
+      const char **keys = av_strings(aTHX_ keys_av, &n_keys);
+      const char **vals = av_strings(aTHX_ vals_av, &n_vals);
+      if (SvOK(outs_av) && SvROK(outs_av)) {
+        AV *oav = (AV *)SvRV(outs_av);
+        int no;
+        void **oh = av_handles(aTHX_ oav, &no);
+        for (i = 0; i < no; ++i) fixed[i] = oh[i];
+        free(oh);
+        n_out = no;
+        outs = fixed;
+      }
+      int rc = MXImperativeInvoke(op_name, n_in, ins, &n_out, &outs,
+                                  n_keys, keys, vals);
+      free(ins); free(keys); free(vals);
+      if (rc != 0) croak("mxnet_tpu: %s", MXGetLastError());
+      EXTEND(SP, n_out);
+      for (i = 0; i < n_out; ++i)
+        PUSHs(sv_2mortal(newSViv(PTR2IV(outs[i]))));
+    }
+
+IV
+mxtpu_sym_variable(name)
+    const char *name
+  CODE:
+    {
+      SymbolHandle h = NULL;
+      MXCHECK(MXSymbolCreateVariable(name, &h));
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_sym_create(op_name, keys_av, vals_av)
+    const char *op_name
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    {
+      int nk, nv;
+      const char **keys = av_strings(aTHX_ keys_av, &nk);
+      const char **vals = av_strings(aTHX_ vals_av, &nv);
+      SymbolHandle h = NULL;
+      int rc = MXSymbolCreateAtomicSymbol(op_name, (mx_uint)nk, keys, vals,
+                                          &h);
+      free(keys); free(vals);
+      if (rc != 0) croak("mxnet_tpu: %s", MXGetLastError());
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_sym_compose(h, name, keys_av, args_av)
+    IV h
+    const char *name
+    AV *keys_av
+    AV *args_av
+  CODE:
+    {
+      int nk, na;
+      const char **keys = av_strings(aTHX_ keys_av, &nk);
+      void **args = av_handles(aTHX_ args_av, &na);
+      int rc = MXSymbolCompose(INT2PTR(void *, h), name, (mx_uint)na,
+                               nk ? keys : NULL, args);
+      free(keys); free(args);
+      if (rc != 0) croak("mxnet_tpu: %s", MXGetLastError());
+    }
+
+void
+mxtpu_sym_list_arguments(h)
+    IV h
+  PPCODE:
+    {
+      mx_uint n = 0, i;
+      const char **names = NULL;
+      MXCHECK(MXSymbolListArguments(INT2PTR(void *, h), &n, &names));
+      EXTEND(SP, n);
+      for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+    }
+
+SV *
+mxtpu_sym_to_json(h)
+    IV h
+  CODE:
+    {
+      const char *json = NULL;
+      MXCHECK(MXSymbolSaveToJSON(INT2PTR(void *, h), &json));
+      RETVAL = newSVpv(json, 0);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_executor_simple_bind(sym, shape_names_av, shape_data_av, shape_idx_av)
+    IV sym
+    AV *shape_names_av
+    AV *shape_data_av
+    AV *shape_idx_av
+  PPCODE:
+    {
+      int nn, i;
+      const char **names = av_strings(aTHX_ shape_names_av, &nn);
+      int nd = av_len(shape_data_av) + 1;
+      int ni = av_len(shape_idx_av) + 1;
+      mx_uint *data = (mx_uint *)malloc(sizeof(mx_uint) * (nd > 0 ? nd : 1));
+      mx_uint *idx = (mx_uint *)malloc(sizeof(mx_uint) * (ni > 0 ? ni : 1));
+      for (i = 0; i < nd; ++i)
+        data[i] = (mx_uint)SvIV(*av_fetch(shape_data_av, i, 0));
+      for (i = 0; i < ni; ++i)
+        idx[i] = (mx_uint)SvIV(*av_fetch(shape_idx_av, i, 0));
+      const char *req_types[] = {"write"};
+      mx_uint num_in = 0, num_aux = 0;
+      NDArrayHandle *in_args = NULL, *arg_grads = NULL, *aux = NULL;
+      const char **upd_names = NULL;
+      NDArrayHandle *upd_handles = NULL;
+      int shared_len = 0;
+      ExecutorHandle exe = NULL;
+      int rc = MXExecutorSimpleBind(
+          INT2PTR(void *, sym), 1, 0, 0, NULL, NULL, NULL, 0, NULL,
+          req_types, (mx_uint)nn, names, data, idx, 0, NULL, NULL, 0, NULL,
+          NULL, 0, NULL, &shared_len, NULL, NULL, &upd_names, &upd_handles,
+          &num_in, &in_args, &arg_grads, &num_aux, &aux, NULL, &exe);
+      free(names); free(data); free(idx);
+      if (rc != 0) croak("mxnet_tpu: %s", MXGetLastError());
+      /* returns (exe, \@in_args, \@arg_grads, \@aux) */
+      {
+        AV *a_in = newAV(), *a_gr = newAV(), *a_aux = newAV();
+        mx_uint j;
+        for (j = 0; j < num_in; ++j) {
+          av_push(a_in, newSViv(PTR2IV(in_args[j])));
+          av_push(a_gr, arg_grads[j] ? newSViv(PTR2IV(arg_grads[j]))
+                                     : newSV(0));
+        }
+        for (j = 0; j < num_aux; ++j)
+          av_push(a_aux, newSViv(PTR2IV(aux[j])));
+        EXTEND(SP, 4);
+        PUSHs(sv_2mortal(newSViv(PTR2IV(exe))));
+        PUSHs(sv_2mortal(newRV_noinc((SV *)a_in)));
+        PUSHs(sv_2mortal(newRV_noinc((SV *)a_gr)));
+        PUSHs(sv_2mortal(newRV_noinc((SV *)a_aux)));
+      }
+    }
+
+void
+mxtpu_executor_forward(exe, is_train)
+    IV exe
+    int is_train
+  CODE:
+    MXCHECK(MXExecutorForward(INT2PTR(void *, exe), is_train));
+
+void
+mxtpu_executor_backward(exe)
+    IV exe
+  CODE:
+    MXCHECK(MXExecutorBackward(INT2PTR(void *, exe), 0, NULL));
+
+void
+mxtpu_executor_outputs(exe)
+    IV exe
+  PPCODE:
+    {
+      mx_uint n = 0, i;
+      NDArrayHandle *outs = NULL;
+      MXCHECK(MXExecutorOutputs(INT2PTR(void *, exe), &n, &outs));
+      EXTEND(SP, n);
+      for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSViv(PTR2IV(outs[i]))));
+    }
+
+void
+mxtpu_executor_free(exe)
+    IV exe
+  CODE:
+    MXExecutorFree(INT2PTR(void *, exe));
+
+IV
+mxtpu_dataiter_create(iter_name, keys_av, vals_av)
+    const char *iter_name
+    AV *keys_av
+    AV *vals_av
+  CODE:
+    {
+      mx_uint n = 0, i;
+      DataIterCreator *iters = NULL;
+      DataIterCreator found = NULL;
+      MXCHECK(MXListDataIters(&n, &iters));
+      for (i = 0; i < n; ++i) {
+        const char *nm, *desc;
+        mx_uint na;
+        const char **an, **at, **ad;
+        MXCHECK(MXDataIterGetIterInfo(iters[i], &nm, &desc, &na, &an, &at,
+                                      &ad));
+        if (strcmp(nm, iter_name) == 0) { found = iters[i]; break; }
+      }
+      if (found == NULL) croak("mxnet_tpu: no data iter %s", iter_name);
+      int nk, nv;
+      const char **keys = av_strings(aTHX_ keys_av, &nk);
+      const char **vals = av_strings(aTHX_ vals_av, &nv);
+      DataIterHandle it = NULL;
+      int rc = MXDataIterCreateIter(found, (mx_uint)nk, keys, vals, &it);
+      free(keys); free(vals);
+      if (rc != 0) croak("mxnet_tpu: %s", MXGetLastError());
+      RETVAL = PTR2IV(it);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_dataiter_before_first(it)
+    IV it
+  CODE:
+    MXCHECK(MXDataIterBeforeFirst(INT2PTR(void *, it)));
+
+int
+mxtpu_dataiter_next(it)
+    IV it
+  CODE:
+    {
+      int more = 0;
+      MXCHECK(MXDataIterNext(INT2PTR(void *, it), &more));
+      RETVAL = more;
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_dataiter_data(it)
+    IV it
+  CODE:
+    {
+      NDArrayHandle h = NULL;
+      MXCHECK(MXDataIterGetData(INT2PTR(void *, it), &h));
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_dataiter_label(it)
+    IV it
+  CODE:
+    {
+      NDArrayHandle h = NULL;
+      MXCHECK(MXDataIterGetLabel(INT2PTR(void *, it), &h));
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_notify_shutdown()
+  CODE:
+    MXNotifyShutdown();

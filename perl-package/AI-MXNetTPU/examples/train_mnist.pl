@@ -1,0 +1,81 @@
+#!/usr/bin/perl
+# MNIST softmax regression in pure Perl through libmxtpu_c_api.so.
+#
+# Reference counterpart: perl-package/AI-MXNet/examples/mnist.pl — the
+# same flow (MNISTIter -> symbol -> executor -> sgd_update) with no
+# Python in the consumer. Usage:
+#   train_mnist.pl <train-images-file> <train-labels-file>
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use lib "$FindBin::Bin/../blib/lib";
+use lib "$FindBin::Bin/../blib/arch";
+use AI::MXNetTPU;
+
+my ( $images, $labels ) = @ARGV;
+die "usage: $0 <images> <labels>\n" unless $labels;
+
+my $batch = 32;
+my $it    = AI::MXNetTPU::IO->new(
+    'MNISTIter',
+    image      => $images,
+    label      => $labels,
+    batch_size => $batch,
+    flat       => 'True',
+    shuffle    => 'False',
+);
+
+my $data  = AI::MXNetTPU::Symbol->variable('data');
+my $label = AI::MXNetTPU::Symbol->variable('softmax_label');
+my $fc    = AI::MXNetTPU::Symbol->create( 'FullyConnected',
+    { num_hidden => 10 }, { data => $data }, 'fc' );
+my $net = AI::MXNetTPU::Symbol->create( 'SoftmaxOutput', {},
+    { data => $fc, label => $label }, 'softmax' );
+
+my $exe = AI::MXNetTPU::Executor->simple_bind( $net,
+    { data => [ $batch, 784 ], softmax_label => [$batch] } );
+
+my $args  = $exe->arg_dict;
+my $grads = $exe->grad_dict;
+
+# tiny deterministic init
+{
+    my $w = $args->{fc_weight};
+    my $n = $w->size;
+    $w->set( [ map { ( ( $_ * 37 ) % 101 - 50 ) / 5000.0 } 0 .. $n - 1 ] );
+    $args->{fc_bias}->set( [ (0) x $args->{fc_bias}->size ] );
+}
+
+my $acc = 0;
+for my $epoch ( 0 .. 11 ) {
+    $it->reset;
+    my ( $correct, $total ) = ( 0, 0 );
+    while ( $it->next ) {
+        $args->{data}->copy_from( $it->data );
+        $args->{softmax_label}->copy_from( $it->label );
+        my $outs = $exe->forward(1);
+        $exe->backward;
+        for my $p (qw(fc_weight fc_bias)) {
+            $args->{$p}->sgd_update( $grads->{$p},
+                lr => 0.1, rescale_grad => 1.0 / $batch );
+        }
+        my $probs = $outs->[0]->aslist;
+        my $labs  = $it->label->aslist;
+        for my $i ( 0 .. $batch - 1 ) {
+            my ( $best, $bp ) = ( 0, -1 );
+            for my $c ( 0 .. 9 ) {
+                my $v = $probs->[ $i * 10 + $c ];
+                ( $best, $bp ) = ( $c, $v ) if $v > $bp;
+            }
+            $correct++ if $best == int( $labs->[$i] );
+            $total++;
+        }
+    }
+    $acc = $correct / $total;
+    printf "epoch %d accuracy %.3f\n", $epoch, $acc;
+}
+
+die "final accuracy $acc too low\n" if $acc < 0.85;
+print "PERL_MNIST_OK acc=$acc\n";
+AI::MXNetTPU::notify_shutdown();

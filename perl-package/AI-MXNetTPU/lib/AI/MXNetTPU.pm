@@ -1,0 +1,47 @@
+package AI::MXNetTPU;
+
+# Perl binding for the mxnet_tpu framework.
+#
+# Reference counterpart: perl-package/AI-MXNet (the reference's full
+# perl frontend). Same layering: this XS module is the AI-MXNetCAPI
+# tier (raw MX* ABI), and the OO modules under AI::MXNetTPU::* are the
+# AI::MXNet tier. Everything crosses through libmxtpu_c_api.so only —
+# no Python in the consumer.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load( 'AI::MXNetTPU', $VERSION );
+
+use AI::MXNetTPU::NDArray;
+use AI::MXNetTPU::Symbol;
+use AI::MXNetTPU::Executor;
+use AI::MXNetTPU::IO;
+
+sub nd  { 'AI::MXNetTPU::NDArray' }
+sub sym { 'AI::MXNetTPU::Symbol' }
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl interface to the mxnet_tpu deep learning framework
+
+=head1 SYNOPSIS
+
+    use AI::MXNetTPU;
+    my $data  = AI::MXNetTPU::Symbol->variable('data');
+    my $fc    = AI::MXNetTPU::Symbol->create('FullyConnected',
+                    { num_hidden => 10 }, { data => $data }, 'fc');
+    my $net   = AI::MXNetTPU::Symbol->create('SoftmaxOutput',
+                    {}, { data => $fc }, 'softmax');
+    my $exe   = AI::MXNetTPU::Executor->simple_bind($net,
+                    { data => [ 32, 784 ], softmax_label => [32] });
+    $exe->forward(1);
+    $exe->backward;
+
+=cut

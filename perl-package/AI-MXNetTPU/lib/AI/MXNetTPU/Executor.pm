@@ -1,0 +1,75 @@
+package AI::MXNetTPU::Executor;
+
+# Executor surface (ref: perl-package/AI-MXNet/lib/AI/MXNet/Executor.pm)
+# over MXExecutorSimpleBind/Forward/Backward/Outputs.
+
+use strict;
+use warnings;
+use AI::MXNetTPU;
+use AI::MXNetTPU::NDArray;
+
+sub simple_bind {
+    my ( $class, $symbol, $shapes ) = @_;
+    my ( @names, @data, @idx );
+    push @idx, 0;
+    for my $n ( sort keys %$shapes ) {
+        push @names, $n;
+        push @data,  @{ $shapes->{$n} };
+        push @idx,   scalar(@data);
+    }
+    my ( $exe, $in_args, $arg_grads, $aux ) =
+      AI::MXNetTPU::executor_simple_bind( $symbol->handle, \@names, \@data,
+        \@idx );
+    my $self = bless {
+        handle    => $exe,
+        symbol    => $symbol,
+        arg_names => $symbol->list_arguments,
+    }, $class;
+    # SimpleBind transfers handle ownership to the caller
+    $self->{in_args} =
+      [ map { AI::MXNetTPU::NDArray->new_from_handle($_) } @$in_args ];
+    $self->{arg_grads} = [
+        map {
+            defined($_)
+              ? AI::MXNetTPU::NDArray->new_from_handle($_)
+              : undef
+        } @$arg_grads
+    ];
+    $self->{aux} =
+      [ map { AI::MXNetTPU::NDArray->new_from_handle($_) } @$aux ];
+    return $self;
+}
+
+sub arg_dict {
+    my ($self) = @_;
+    my %d;
+    @d{ @{ $self->{arg_names} } } = @{ $self->{in_args} };
+    return \%d;
+}
+
+sub grad_dict {
+    my ($self) = @_;
+    my %d;
+    @d{ @{ $self->{arg_names} } } = @{ $self->{arg_grads} };
+    return \%d;
+}
+
+sub forward {
+    my ( $self, $is_train ) = @_;
+    AI::MXNetTPU::executor_forward( $self->{handle}, $is_train ? 1 : 0 );
+    # ExecutorOutputs transfers ownership: freed when the wrappers drop
+    return [ map { AI::MXNetTPU::NDArray->new_from_handle($_) }
+          AI::MXNetTPU::executor_outputs( $self->{handle} ) ];
+}
+
+sub backward {
+    my ($self) = @_;
+    AI::MXNetTPU::executor_backward( $self->{handle} );
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::executor_free( $self->{handle} ) if $self->{handle};
+}
+
+1;

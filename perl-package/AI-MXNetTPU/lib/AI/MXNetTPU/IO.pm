@@ -1,0 +1,35 @@
+package AI::MXNetTPU::IO;
+
+# Data-iterator surface (ref: perl-package/AI-MXNet/lib/AI/MXNet/IO.pm)
+# over the MXDataIter* ABI (MNISTIter, CSVIter, ImageRecordIter, ...).
+
+use strict;
+use warnings;
+use AI::MXNetTPU;
+use AI::MXNetTPU::NDArray;
+
+sub new {
+    my ( $class, $iter_name, %params ) = @_;
+    my @keys = sort keys %params;
+    my $h    = AI::MXNetTPU::dataiter_create( $iter_name, \@keys,
+        [ map { "" . $params{$_} } @keys ] );
+    return bless { handle => $h }, $class;
+}
+
+sub reset { AI::MXNetTPU::dataiter_before_first( $_[0]{handle} ) }
+
+sub next { AI::MXNetTPU::dataiter_next( $_[0]{handle} ) }
+
+# GetData/GetLabel return caller-owned handles (c_api.cc ownership
+# contract): wrap owned so DESTROY frees them per batch
+sub data {
+    AI::MXNetTPU::NDArray->new_from_handle(
+        AI::MXNetTPU::dataiter_data( $_[0]{handle} ) );
+}
+
+sub label {
+    AI::MXNetTPU::NDArray->new_from_handle(
+        AI::MXNetTPU::dataiter_label( $_[0]{handle} ) );
+}
+
+1;

@@ -1,0 +1,81 @@
+package AI::MXNetTPU::NDArray;
+
+# NDArray surface (ref: perl-package/AI-MXNet/lib/AI/MXNet/NDArray.pm).
+# Tensors cross the ABI as packed float32 strings (pack 'f*').
+
+use strict;
+use warnings;
+use AI::MXNetTPU;
+
+sub new_from_handle {
+    my ( $class, $handle, $owned ) = @_;
+    return bless { handle => $handle, owned => ( $owned // 1 ) }, $class;
+}
+
+# AI::MXNetTPU::NDArray->array([...values...], [shape])
+sub array {
+    my ( $class, $values, $shape ) = @_;
+    $shape //= [ scalar @$values ];
+    my $h = AI::MXNetTPU::nd_create( $shape, 0 );    # dtype 0 = float32
+    AI::MXNetTPU::nd_copy_from_packed( $h, pack( 'f*', @$values ) );
+    return $class->new_from_handle($h);
+}
+
+sub zeros {
+    my ( $class, $shape ) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    return $class->array( [ (0) x $n ], $shape );
+}
+
+sub handle { $_[0]{handle} }
+
+sub shape { [ AI::MXNetTPU::nd_shape( $_[0]{handle} ) ] }
+
+sub size {
+    my $n = 1;
+    $n *= $_ for @{ $_[0]->shape };
+    return $n;
+}
+
+sub aslist {
+    my ($self) = @_;
+    my $packed = AI::MXNetTPU::nd_copy_to_packed( $self->{handle},
+        $self->size );
+    return [ unpack( 'f*', $packed ) ];
+}
+
+sub copy_from {
+    my ( $self, $other ) = @_;
+    AI::MXNetTPU::nd_copy_from_nd( $self->{handle}, $other->handle );
+    return $self;
+}
+
+sub set {
+    my ( $self, $values ) = @_;
+    AI::MXNetTPU::nd_copy_from_packed( $self->{handle},
+        pack( 'f*', @$values ) );
+    return $self;
+}
+
+# in-place SGD step through the registered optimizer op, exactly the
+# reference Module update path (sgd_update kernel)
+sub sgd_update {
+    my ( $self, $grad, %opt ) = @_;
+    my @keys = sort keys %opt;
+    AI::MXNetTPU::imperative_invoke(
+        'sgd_update',
+        [ $self->{handle}, $grad->handle ],
+        [ $self->{handle} ],
+        \@keys, [ map { "" . $opt{$_} } @keys ]
+    );
+    return $self;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::nd_free( $self->{handle} )
+      if $self->{owned} && $self->{handle};
+}
+
+1;

@@ -1,0 +1,43 @@
+package AI::MXNetTPU::Symbol;
+
+# Symbol surface (ref: perl-package/AI-MXNet/lib/AI/MXNet/Symbol.pm):
+# compose graph nodes through the atomic-symbol ABI.
+
+use strict;
+use warnings;
+use AI::MXNetTPU;
+
+sub new_from_handle {
+    my ( $class, $handle ) = @_;
+    return bless { handle => $handle }, $class;
+}
+
+sub variable {
+    my ( $class, $name ) = @_;
+    return $class->new_from_handle( AI::MXNetTPU::sym_variable($name) );
+}
+
+# Symbol->create('FullyConnected', {num_hidden=>10}, {data=>$sym}, 'fc1')
+sub create {
+    my ( $class, $op, $attrs, $inputs, $name ) = @_;
+    $attrs  //= {};
+    $inputs //= {};
+    $name   //= lc($op);
+    my @keys = sort keys %$attrs;
+    my $h    = AI::MXNetTPU::sym_create( $op, \@keys,
+        [ map { "" . $attrs->{$_} } @keys ] );
+    my @in_names = sort keys %$inputs;
+    AI::MXNetTPU::sym_compose( $h, $name, \@in_names,
+        [ map { $inputs->{$_}{handle} } @in_names ] );
+    return $class->new_from_handle($h);
+}
+
+sub handle { $_[0]{handle} }
+
+sub list_arguments {
+    return [ AI::MXNetTPU::sym_list_arguments( $_[0]{handle} ) ];
+}
+
+sub tojson { AI::MXNetTPU::sym_to_json( $_[0]{handle} ) }
+
+1;

@@ -15,6 +15,11 @@
 #endif
 #include <Python.h>
 
+#ifdef __linux__
+#include <dlfcn.h>
+#include <stdio.h>
+#endif
+
 #include <string>
 
 namespace mxtpu_embed {
@@ -61,9 +66,26 @@ inline const char *safe_utf8(PyObject *o) {
 
 /* Interpreter bring-up. Must run before any PyGILState_Ensure: the init
  * leaves the GIL held on the calling thread, so it is released right
- * away and every entry point balances it via the Gil guard below. */
+ * away and every entry point balances it via the Gil guard below.
+ *
+ * When this library is itself dlopen'd (perl/R/java FFI consumers
+ * rather than a C program linked against it), libpython is loaded in
+ * LOCAL scope — python C extensions (numpy's _multiarray_umath, ...)
+ * rely on interpreter symbols being global and fail to import
+ * otherwise. Re-open libpython with RTLD_GLOBAL | RTLD_NOLOAD to
+ * promote the already-mapped image before Py_Initialize. */
 inline void ensure_python() {
   if (!Py_IsInitialized()) {
+#ifdef __linux__
+    char soname[64];
+    snprintf(soname, sizeof(soname), "libpython%d.%d.so.1.0",
+             PY_MAJOR_VERSION, PY_MINOR_VERSION);
+    void *h = dlopen(soname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+    if (h == nullptr) {
+      /* not yet mapped (static-linked python?): best-effort load */
+      dlopen(soname, RTLD_NOW | RTLD_GLOBAL);
+    }
+#endif
     Py_InitializeEx(0);
     (void)PyEval_SaveThread();
   }

@@ -1,0 +1,79 @@
+"""Perl language binding: build AI::MXNetTPU (XS over libmxtpu_c_api.so)
+and train MNIST from pure Perl — the second full non-C++ binding proving
+the C ABI beyond its home language.
+
+Reference bar: perl-package/AI-MXNet (the reference's Perl frontend,
+AI-MXNetCAPI raw tier + AI::MXNet OO tier); the example mirrors its
+mnist flow. No Python appears in the consumer — the script drives
+MNISTIter, symbol composition, SimpleBind, forward/backward, and
+sgd_update entirely through the shared library."""
+import os
+import shutil
+import struct
+import subprocess
+import sysconfig
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
+
+
+def _have_perl_xs():
+    if shutil.which("perl") is None:
+        return False
+    r = subprocess.run(["perl", "-MExtUtils::MakeMaker", "-e1"],
+                       capture_output=True)
+    return r.returncode == 0
+
+
+def _write_mnist(tmp_path, n=512):
+    """Synthetic separable MNIST in IDX format (same task as the C ABI
+    test: class k lights pixel block [78k, 78k+78))."""
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    imgs = (rng.randint(0, 16, (n, 784))).astype(np.uint8)
+    for i, lab in enumerate(labels):
+        lo = 78 * int(lab)
+        imgs[i, lo:lo + 78] += 200
+    img_path = str(tmp_path / "train-images")
+    lbl_path = str(tmp_path / "train-labels")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path
+
+
+@pytest.mark.skipif(not _have_perl_xs(), reason="perl XS toolchain absent")
+def test_perl_trains_mnist(tmp_path):
+    import tests.test_c_api as tc
+
+    tc._lib()  # ensure libmxtpu_c_api.so is built
+
+    build = tmp_path / "build"
+    shutil.copytree(PKG, build)
+    env = dict(os.environ)
+    env["MXTPU_ROOT"] = ROOT
+    env["MXNET_TPU_HOME"] = ROOT
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=build, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    r = subprocess.run(["make"], cwd=build, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+
+    imgs, lbls = _write_mnist(tmp_path)
+    r = subprocess.run(
+        ["perl", str(build / "examples" / "train_mnist.pl"), imgs, lbls],
+        env=env, capture_output=True, text=True, timeout=600)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "PERL_MNIST_OK" in out, out[-2000:]
