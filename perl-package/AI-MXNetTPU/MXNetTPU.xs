@@ -96,12 +96,14 @@ mxtpu_nd_copy_to_packed(h, n_elem)
     IV n_elem
   CODE:
     {
+      if (n_elem <= 0) { RETVAL = newSVpvn("", 0); goto done; }
       SV *out = newSV(n_elem * sizeof(float));
       SvPOK_on(out);
       SvCUR_set(out, n_elem * sizeof(float));
       MXCHECK(MXNDArraySyncCopyToCPU(INT2PTR(void *, h), SvPVX(out),
                                      (size_t)n_elem));
       RETVAL = out;
+      done: ;
     }
   OUTPUT:
     RETVAL
@@ -392,6 +394,18 @@ mxtpu_dataiter_label(it)
     }
   OUTPUT:
     RETVAL
+
+void
+mxtpu_sym_free(h)
+    IV h
+  CODE:
+    MXSymbolFree(INT2PTR(void *, h));
+
+void
+mxtpu_dataiter_free(it)
+    IV it
+  CODE:
+    MXDataIterFree(INT2PTR(void *, it));
 
 void
 mxtpu_notify_shutdown()

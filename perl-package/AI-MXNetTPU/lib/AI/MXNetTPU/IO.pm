@@ -32,4 +32,9 @@ sub label {
         AI::MXNetTPU::dataiter_label( $_[0]{handle} ) );
 }
 
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::dataiter_free( $self->{handle} ) if $self->{handle};
+}
+
 1;

@@ -40,4 +40,9 @@ sub list_arguments {
 
 sub tojson { AI::MXNetTPU::sym_to_json( $_[0]{handle} ) }
 
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::sym_free( $self->{handle} ) if $self->{handle};
+}
+
 1;

@@ -21,11 +21,18 @@ PKG = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
 
 
 def _have_perl_xs():
-    if shutil.which("perl") is None:
+    if shutil.which("perl") is None or shutil.which("make") is None:
         return False
     r = subprocess.run(["perl", "-MExtUtils::MakeMaker", "-e1"],
                        capture_output=True)
-    return r.returncode == 0
+    if r.returncode != 0:
+        return False
+    # the XS build also needs the compiler perl was configured with
+    r = subprocess.run(
+        ["perl", "-MConfig", "-e", "print $Config{cc}"],
+        capture_output=True, text=True)
+    return bool(r.stdout.strip()) and \
+        shutil.which(r.stdout.strip().split()[0]) is not None
 
 
 def _write_mnist(tmp_path, n=512):
@@ -59,8 +66,10 @@ def test_perl_trains_mnist(tmp_path):
     env = dict(os.environ)
     env["MXTPU_ROOT"] = ROOT
     env["MXNET_TPU_HOME"] = ROOT
+    paths = sysconfig.get_paths()
     env["PYTHONPATH"] = os.pathsep.join(
-        [ROOT, sysconfig.get_paths()["purelib"], env.get("PYTHONPATH", "")])
+        p for p in [ROOT, paths["purelib"], paths["platlib"],
+                    env.get("PYTHONPATH", "")] if p)
     env["JAX_PLATFORMS"] = "cpu"
 
     r = subprocess.run(["perl", "Makefile.PL"], cwd=build, env=env,
