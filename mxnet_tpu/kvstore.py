@@ -412,14 +412,17 @@ class DistKVStore(TPUKVStore):
                     self._store[k] += agg
 
     def _flush_row_sparse(self, rsp):
-        """Cross-worker aggregation of pending row-sparse gradients
-        without densifying: workers exchange only their stored
-        (row_id, values) pairs, padded per key to the max nnz (ref
-        kvstore_dist.h EncodeRowSparseKey — the wire carries nnz*width,
-        not the dense shape; nightly invariant
-        dist_sync_kvstore.py:28-50). All keys batch into one max-nnz
-        reduction, one id gather, and one value gather per dtype —
-        the same few-collective discipline as the dense flush.
+        """Cross-worker aggregation of pending row-sparse gradients.
+
+        Each worker's ACTUAL (row_id, values) payload crosses the wire
+        (ref kvstore_dist.h:147-346 EncodeRowSparseKey — the reference
+        sends per-worker real nnz, never a padded maximum): one small
+        nnz-matrix allgather, then one id gather and one value gather
+        per dtype, padded only to the largest TOTAL payload across
+        workers. A key whose combined nnz reaches its dense row count
+        switches to a dense allreduce instead — degraded sparsity must
+        never cost more than the dense flush (the round-3 tier paid
+        nworkers x max_nnz x width per key).
 
         Row ids cross the wire as int32 (JAX canonicalizes int64 down
         anyway without x64); tables beyond 2^31 rows are rejected
@@ -436,40 +439,14 @@ class DistKVStore(TPUKVStore):
                     "row-sparse dist push: %r has %d rows; the int32 "
                     "wire format supports up to 2^31-1"
                     % (k, rsp[k][3][0]))
-        nnzs = np.asarray([rsp[k][2].shape[0] for k in keys], np.int64)
-        max_nnzs = dist.allreduce(nnzs, op="max")
-        # pad ids with -1 / values with 0, concat across keys
-        id_parts, val_parts_by_dtype, layouts = [], {}, []
-        for k, m in zip(keys, max_nnzs):
-            _tag, vals, ids, shape, ctx = rsp[k]
-            m = int(m)
-            width = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-            pid = np.full((m,), -1, np.int32)
-            pid[:ids.shape[0]] = ids.astype(np.int32)
-            id_parts.append(pid)
-            dt = np.dtype(vals.dtype)
-            pval = np.zeros((m, width), dt)
-            pval[:ids.shape[0]] = vals.reshape(ids.shape[0], width)
-            val_parts_by_dtype.setdefault(dt, []).append(pval.reshape(-1))
-            layouts.append((k, m, width, dt, shape, ctx))
-        gathered_ids = dist.allgather(np.concatenate(id_parts))
-        gathered_vals = {dt: dist.allgather(np.concatenate(parts))
-                         for dt, parts in val_parts_by_dtype.items()}
-        nworkers = gathered_ids.shape[0]
-        id_off = 0
-        val_off = {dt: 0 for dt in gathered_vals}
-        for k, m, width, dt, shape, ctx in layouts:
-            ids_w = gathered_ids[:, id_off:id_off + m]
-            id_off += m
-            vals_w = gathered_vals[dt][:, val_off[dt]:val_off[dt] + m * width]
-            val_off[dt] += m * width
-            flat_ids = ids_w.reshape(-1)
-            flat_vals = vals_w.reshape(nworkers * m, width)
-            keep = flat_ids >= 0
-            all_ids = jnp.asarray(flat_ids[keep].astype(np.int64))
-            all_vals = jnp.asarray(
-                flat_vals[keep].reshape((-1,) + tuple(shape[1:])))
-            m_vals, m_ids = _canonicalize(all_vals, all_ids)
+        my_nnz = np.asarray([rsp[k][2].shape[0] for k in keys], np.int64)
+        nnz_all = np.asarray(dist.allgather(my_nnz), np.int64)  # (W, K)
+        nworkers = nnz_all.shape[0]
+        combined = nnz_all.sum(axis=0)
+
+        def _emit(k, all_vals, all_ids, shape, ctx):
+            m_vals, m_ids = _canonicalize(jnp.asarray(all_vals),
+                                          jnp.asarray(all_ids))
             agg = RowSparseNDArray(NDArray(m_vals, ctx=ctx),
                                    NDArray(m_ids.astype("int64"), ctx=ctx),
                                    shape, ctx=ctx)
@@ -477,6 +454,96 @@ class DistKVStore(TPUKVStore):
                 self._updater(self._normalize_key(k), agg, self._store[k])
             else:
                 self._accumulate_rsp(k, agg)
+
+        dense_keys = [k for k, c in zip(keys, combined)
+                      if c >= rsp[k][3][0]]
+        sparse_keys = [k for k in keys if k not in set(dense_keys)]
+
+        # degraded keys: densify locally, sum with ONE dense allreduce
+        # per dtype, emit as an all-rows row-sparse aggregate
+        by_dtype = {}
+        for k in dense_keys:
+            _tag, vals, ids, shape, ctx = rsp[k]
+            dense = np.zeros(shape, vals.dtype)
+            if ids.size:
+                dense[ids] = vals.reshape((ids.shape[0],) + tuple(shape[1:]))
+            by_dtype.setdefault(np.dtype(vals.dtype), []).append(
+                (k, dense, shape, ctx))
+        for dt, entries in by_dtype.items():
+            flat = np.concatenate([d.reshape(-1) for _k, d, _s, _c in entries])
+            total = dist.allreduce(flat)
+            off = 0
+            for k, d, shape, ctx in entries:
+                agg = total[off:off + d.size].reshape(shape)
+                off += d.size
+                _emit(k, agg, np.arange(shape[0], dtype=np.int64),
+                      shape, ctx)
+
+        if not sparse_keys:
+            return
+        sp_idx = [keys.index(k) for k in sparse_keys]
+        widths = {}
+        for k in sparse_keys:
+            shape = rsp[k][3]
+            widths[k] = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+
+        # ids: one gather, padded to the max TOTAL nnz across workers
+        tot_per_worker = nnz_all[:, sp_idx].sum(axis=1)
+        max_tot = int(tot_per_worker.max())
+        pid = np.full((max(max_tot, 1),), -1, np.int32)
+        my_ids = np.concatenate(
+            [rsp[k][2] for k in sparse_keys]) if sparse_keys else []
+        pid[:len(my_ids)] = np.asarray(my_ids, np.int32)
+        gathered_ids = dist.allgather(pid)
+
+        # values: one gather per dtype, padded to that dtype's max total
+        dtypes = sorted({np.dtype(rsp[k][1].dtype) for k in sparse_keys},
+                        key=str)
+        gathered_vals = {}
+        val_elems = {}  # dtype -> (W, K_dt) per-key element counts
+        for dt in dtypes:
+            dt_keys = [k for k in sparse_keys
+                       if np.dtype(rsp[k][1].dtype) == dt]
+            counts = np.stack(
+                [nnz_all[:, keys.index(k)] * widths[k] for k in dt_keys],
+                axis=1)  # (W, K_dt)
+            val_elems[dt] = (dt_keys, counts)
+            max_v = int(counts.sum(axis=1).max())
+            buf = np.zeros((max(max_v, 1),), dt)
+            my_flat = np.concatenate(
+                [np.asarray(rsp[k][1]).reshape(-1) for k in dt_keys])
+            buf[:my_flat.size] = my_flat
+            gathered_vals[dt] = dist.allgather(buf)
+
+        # reassemble per key from the nnz matrix offsets
+        id_offsets = np.zeros((nworkers,), np.int64)
+        val_offsets = {dt: np.zeros((nworkers,), np.int64) for dt in dtypes}
+        per_key = {k: ([], []) for k in sparse_keys}  # ids, vals
+        for k in sparse_keys:
+            ki = keys.index(k)
+            dt = np.dtype(rsp[k][1].dtype)
+            w_k = widths[k]
+            shape = rsp[k][3]
+            for wrk in range(nworkers):
+                n = int(nnz_all[wrk, ki])
+                io = int(id_offsets[wrk])
+                vo = int(val_offsets[dt][wrk])
+                if n:
+                    per_key[k][0].append(
+                        gathered_ids[wrk, io:io + n].astype(np.int64))
+                    per_key[k][1].append(
+                        gathered_vals[dt][wrk, vo:vo + n * w_k]
+                        .reshape((n,) + tuple(shape[1:])))
+                id_offsets[wrk] += n
+                val_offsets[dt][wrk] += n * w_k
+        for k in sparse_keys:
+            _tag, vals, ids, shape, ctx = rsp[k]
+            id_parts, val_parts = per_key[k]
+            if not id_parts:
+                id_parts = [np.zeros((0,), np.int64)]
+                val_parts = [np.zeros((0,) + tuple(shape[1:]), vals.dtype)]
+            _emit(k, np.concatenate(val_parts), np.concatenate(id_parts),
+                  shape, ctx)
 
     def _accumulate_rsp(self, k, agg):
         """store[k] += row-sparse agg (server DataHandleRowSparse add)."""

@@ -145,6 +145,21 @@ def main():
         expect[g + 1] += g + 1
     assert np.allclose(out.asnumpy(), expect), (r, out.asnumpy(), expect)
 
+    # degraded-sparsity fallback: a key whose combined nnz reaches the
+    # dense row count crosses as ONE dense allreduce (never more wire
+    # than the dense flush), same aggregate
+    kvr.init("rsp_dense", nd.zeros(shape_r))
+    many_rows = np.arange(4, dtype=np.int64) + r  # 4 of 6 rows each
+    kvr.push("rsp_dense", nd_sparse.row_sparse_array(
+        (np.full((4, 3), float(r + 1), np.float32), many_rows),
+        shape=shape_r))
+    out3 = nd.zeros(shape_r)
+    kvr.pull("rsp_dense", out=out3)
+    expect3 = np.zeros(shape_r, np.float32)
+    for g in range(n):
+        expect3[g:g + 4] += g + 1
+    assert np.allclose(out3.asnumpy(), expect3), (r, out3.asnumpy(), expect3)
+
     # row_sparse_pull of selected rows after a sparse dist update
     rsp_out = nd.sparse.zeros("row_sparse", shape_r)
     kvr.row_sparse_pull("rsp", out=rsp_out,
