@@ -417,22 +417,25 @@ class DistKVStore(TPUKVStore):
         Each worker's ACTUAL (row_id, values) payload crosses the wire
         (ref kvstore_dist.h:147-346 EncodeRowSparseKey — the reference
         sends per-worker real nnz, never a padded maximum): one small
-        nnz-matrix allgather, then one id gather and one value gather
-        per dtype, padded only to the largest TOTAL payload across
-        workers. A key whose combined nnz reaches its dense row count
-        switches to a dense allreduce instead — degraded sparsity must
-        never cost more than the dense flush (the round-3 tier paid
-        nworkers x max_nnz x width per key).
+        nnz-matrix allgather, one id gather covering every key (ids are
+        int32, cheap next to values), then one value gather per dtype,
+        padded only to the largest TOTAL payload across workers. A key
+        whose combined nnz reaches its dense row count ships its VALUES
+        as a dense allreduce instead — degraded sparsity must never
+        cost more wire than the dense flush (the round-3 tier paid
+        nworkers x max_nnz x width per key). Either way the emitted
+        aggregate carries exactly the UNION of rows workers touched, so
+        lazy sparse optimizers (optimizer.py lazy_update) never see
+        phantom rows.
 
         Row ids cross the wire as int32 (JAX canonicalizes int64 down
         anyway without x64); tables beyond 2^31 rows are rejected
         rather than silently corrupted."""
-        import jax.numpy as jnp
-
         from . import dist
         from .ndarray.sparse import RowSparseNDArray, _canonicalize
 
         keys = sorted(rsp)
+        kidx = {k: i for i, k in enumerate(keys)}
         for k in keys:
             if rsp[k][3][0] > np.iinfo(np.int32).max:
                 raise MXNetError(
@@ -444,7 +447,29 @@ class DistKVStore(TPUKVStore):
         nworkers = nnz_all.shape[0]
         combined = nnz_all.sum(axis=0)
 
+        # ids: ONE gather over all keys, padded to the max total nnz
+        max_tot = int(nnz_all.sum(axis=1).max())
+        pid = np.full((max(max_tot, 1),), -1, np.int32)
+        my_ids = (np.concatenate([rsp[k][2] for k in keys])
+                  if len(keys) else np.zeros((0,), np.int64))
+        pid[:len(my_ids)] = np.asarray(my_ids, np.int32)
+        gathered_ids = dist.allgather(pid)
+
+        # per (worker, key) id slices from the nnz matrix
+        id_slices = {}
+        offs = np.zeros((nworkers,), np.int64)
+        for k in keys:
+            ki = kidx[k]
+            for wrk in range(nworkers):
+                n = int(nnz_all[wrk, ki])
+                io = int(offs[wrk])
+                id_slices[(wrk, k)] = (
+                    gathered_ids[wrk, io:io + n].astype(np.int64))
+                offs[wrk] += n
+
         def _emit(k, all_vals, all_ids, shape, ctx):
+            import jax.numpy as jnp
+
             m_vals, m_ids = _canonicalize(jnp.asarray(all_vals),
                                           jnp.asarray(all_ids))
             agg = RowSparseNDArray(NDArray(m_vals, ctx=ctx),
@@ -455,14 +480,15 @@ class DistKVStore(TPUKVStore):
             else:
                 self._accumulate_rsp(k, agg)
 
-        dense_keys = [k for k, c in zip(keys, combined)
-                      if c >= rsp[k][3][0]]
-        sparse_keys = [k for k in keys if k not in set(dense_keys)]
+        # wire heuristic only — semantics are identical on both paths
+        dense_set = {k for k, c in zip(keys, combined)
+                     if c >= rsp[k][3][0]}
+        sparse_keys = [k for k in keys if k not in dense_set]
 
-        # degraded keys: densify locally, sum with ONE dense allreduce
-        # per dtype, emit as an all-rows row-sparse aggregate
+        # degraded keys: VALUES cross as one dense allreduce per dtype;
+        # the emitted rows are still exactly the cross-worker union
         by_dtype = {}
-        for k in dense_keys:
+        for k in sorted(dense_set):
             _tag, vals, ids, shape, ctx = rsp[k]
             dense = np.zeros(shape, vals.dtype)
             if ids.size:
@@ -476,38 +502,28 @@ class DistKVStore(TPUKVStore):
             for k, d, shape, ctx in entries:
                 agg = total[off:off + d.size].reshape(shape)
                 off += d.size
-                _emit(k, agg, np.arange(shape[0], dtype=np.int64),
-                      shape, ctx)
+                union = np.unique(np.concatenate(
+                    [id_slices[(wrk, k)] for wrk in range(nworkers)]))
+                union = union.astype(np.int64)
+                _emit(k, agg[union], union, shape, ctx)
 
         if not sparse_keys:
             return
-        sp_idx = [keys.index(k) for k in sparse_keys]
         widths = {}
         for k in sparse_keys:
             shape = rsp[k][3]
             widths[k] = int(np.prod(shape[1:])) if len(shape) > 1 else 1
 
-        # ids: one gather, padded to the max TOTAL nnz across workers
-        tot_per_worker = nnz_all[:, sp_idx].sum(axis=1)
-        max_tot = int(tot_per_worker.max())
-        pid = np.full((max(max_tot, 1),), -1, np.int32)
-        my_ids = np.concatenate(
-            [rsp[k][2] for k in sparse_keys]) if sparse_keys else []
-        pid[:len(my_ids)] = np.asarray(my_ids, np.int32)
-        gathered_ids = dist.allgather(pid)
-
         # values: one gather per dtype, padded to that dtype's max total
         dtypes = sorted({np.dtype(rsp[k][1].dtype) for k in sparse_keys},
                         key=str)
         gathered_vals = {}
-        val_elems = {}  # dtype -> (W, K_dt) per-key element counts
         for dt in dtypes:
             dt_keys = [k for k in sparse_keys
                        if np.dtype(rsp[k][1].dtype) == dt]
             counts = np.stack(
-                [nnz_all[:, keys.index(k)] * widths[k] for k in dt_keys],
+                [nnz_all[:, kidx[k]] * widths[k] for k in dt_keys],
                 axis=1)  # (W, K_dt)
-            val_elems[dt] = (dt_keys, counts)
             max_v = int(counts.sum(axis=1).max())
             buf = np.zeros((max(max_v, 1),), dt)
             my_flat = np.concatenate(
@@ -515,30 +531,23 @@ class DistKVStore(TPUKVStore):
             buf[:my_flat.size] = my_flat
             gathered_vals[dt] = dist.allgather(buf)
 
-        # reassemble per key from the nnz matrix offsets
-        id_offsets = np.zeros((nworkers,), np.int64)
+        # reassemble per key; value offsets walk sparse_keys order per
+        # dtype, matching the concatenation above
         val_offsets = {dt: np.zeros((nworkers,), np.int64) for dt in dtypes}
-        per_key = {k: ([], []) for k in sparse_keys}  # ids, vals
-        for k in sparse_keys:
-            ki = keys.index(k)
-            dt = np.dtype(rsp[k][1].dtype)
-            w_k = widths[k]
-            shape = rsp[k][3]
-            for wrk in range(nworkers):
-                n = int(nnz_all[wrk, ki])
-                io = int(id_offsets[wrk])
-                vo = int(val_offsets[dt][wrk])
-                if n:
-                    per_key[k][0].append(
-                        gathered_ids[wrk, io:io + n].astype(np.int64))
-                    per_key[k][1].append(
-                        gathered_vals[dt][wrk, vo:vo + n * w_k]
-                        .reshape((n,) + tuple(shape[1:])))
-                id_offsets[wrk] += n
-                val_offsets[dt][wrk] += n * w_k
         for k in sparse_keys:
             _tag, vals, ids, shape, ctx = rsp[k]
-            id_parts, val_parts = per_key[k]
+            dt = np.dtype(vals.dtype)
+            w_k = widths[k]
+            id_parts, val_parts = [], []
+            for wrk in range(nworkers):
+                n = int(nnz_all[wrk, kidx[k]])
+                vo = int(val_offsets[dt][wrk])
+                if n:
+                    id_parts.append(id_slices[(wrk, k)])
+                    val_parts.append(
+                        gathered_vals[dt][wrk, vo:vo + n * w_k]
+                        .reshape((n,) + tuple(shape[1:])))
+                val_offsets[dt][wrk] += n * w_k
             if not id_parts:
                 id_parts = [np.zeros((0,), np.int64)]
                 val_parts = [np.zeros((0,) + tuple(shape[1:]), vals.dtype)]

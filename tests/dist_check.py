@@ -149,7 +149,7 @@ def main():
     # dense row count crosses as ONE dense allreduce (never more wire
     # than the dense flush), same aggregate
     kvr.init("rsp_dense", nd.zeros(shape_r))
-    many_rows = np.arange(4, dtype=np.int64) + r  # 4 of 6 rows each
+    many_rows = (np.arange(4, dtype=np.int64) + r) % shape_r[0]
     kvr.push("rsp_dense", nd_sparse.row_sparse_array(
         (np.full((4, 3), float(r + 1), np.float32), many_rows),
         shape=shape_r))
@@ -157,7 +157,8 @@ def main():
     kvr.pull("rsp_dense", out=out3)
     expect3 = np.zeros(shape_r, np.float32)
     for g in range(n):
-        expect3[g:g + 4] += g + 1
+        for j in range(4):
+            expect3[(g + j) % shape_r[0]] += g + 1
     assert np.allclose(out3.asnumpy(), expect3), (r, out3.asnumpy(), expect3)
 
     # row_sparse_pull of selected rows after a sparse dist update
