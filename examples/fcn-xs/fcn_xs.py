@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Fully-convolutional semantic segmentation (FCN-xs).
+
+Reference counterpart: ``example/fcn-xs`` (symbol_fcnxs.py fcn32s —
+conv encoder, 1x1 score head, Deconvolution upsample, per-pixel
+SoftmaxOutput with multi_output). Same topology on a compact encoder;
+the synthetic task segments bright rectangles of two classes from
+background, so the whole pipeline (per-pixel loss, transposed-conv
+upsampling, pixel-accuracy metric) runs end to end offline.
+
+Run: python examples/fcn-xs/fcn_xs.py [--epochs 4]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+N_CLS = 3  # background + 2 object classes
+SIZE = 32
+
+
+def get_fcn32s(num_classes=N_CLS):
+    """Encoder (stride 4) -> 1x1 score -> 4x deconv upsample (the
+    fcn32s pattern, symbol_fcnxs.py:24-88 at 1/8 scale)."""
+    data = sym.var("data")
+    body = data
+    for i, nf in enumerate((16, 32)):
+        body = sym.Convolution(data=body, num_filter=nf, kernel=(3, 3),
+                               pad=(1, 1), name="conv%d" % (i + 1))
+        body = sym.Activation(data=body, act_type="relu",
+                              name="relu%d" % (i + 1))
+        body = sym.Pooling(data=body, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max", name="pool%d" % (i + 1))
+    score = sym.Convolution(data=body, num_filter=num_classes,
+                            kernel=(1, 1), name="score")
+    # bilinear-initializable 2x-stride transposed conv, twice = 4x
+    up = sym.Deconvolution(data=score, num_filter=num_classes,
+                           kernel=(4, 4), stride=(4, 4), no_bias=True,
+                           name="bigscore")
+    return sym.SoftmaxOutput(data=up, multi_output=True,
+                             normalization="valid", use_ignore=True,
+                             ignore_label=-1, name="softmax")
+
+
+def make_batch(rng, n=8):
+    x = rng.randn(n, 3, SIZE, SIZE).astype(np.float32) * 0.2
+    y = np.zeros((n, SIZE, SIZE), np.float32)
+    for i in range(n):
+        for cls in (1, 2):
+            w, h = rng.randint(8, 16, 2)
+            x1, y1 = rng.randint(0, SIZE - w), rng.randint(0, SIZE - h)
+            x[i, cls - 1, y1:y1 + h, x1:x1 + w] += 2.0
+            y[i, y1:y1 + h, x1:x1 + w] = cls
+    return x, y.reshape(n, -1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    net = get_fcn32s()
+    n = 8
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(n, 3, SIZE, SIZE),
+                          softmax_label=(n, SIZE * SIZE))
+    init = mx.initializer.Xavier()
+    for name, arr in zip(net.list_arguments(), exe.arg_arrays):
+        if name not in ("data", "softmax_label"):
+            init(mx.initializer.InitDesc(name), arr)
+    opt = mx.optimizer.create("adam", learning_rate=0.003,
+                              rescale_grad=1.0 / n)
+    upd = mx.optimizer.get_updater(opt)
+
+    accs = []
+    for epoch in range(args.epochs):
+        correct = total = 0
+        for _ in range(12):
+            x, y = make_batch(rng, n)
+            out = exe.forward(is_train=True, data=x, softmax_label=y)[0]
+            exe.backward()
+            for i, name in enumerate(net.list_arguments()):
+                g = exe.grad_arrays[i]
+                if g is not None and name not in ("data", "softmax_label"):
+                    upd(i, g, exe.arg_arrays[i])
+            pred = out.asnumpy().reshape(n, N_CLS, -1).argmax(1)
+            correct += (pred == y).sum()
+            total += y.size
+        accs.append(correct / total)
+        print("epoch %d pixel-acc %.3f" % (epoch, accs[-1]))
+    assert accs[-1] > accs[0] and accs[-1] > 0.85, accs
+    print("FCN_XS_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Neural style transfer by input optimization.
+
+Reference counterpart: ``example/neural-style`` — optimize the pixels
+of an image so a fixed convnet's deep features match a content image
+while its Gram matrices match a style image (Gatys et al.). The
+reference uses pretrained VGG weights (no downloads offline); here the
+feature extractor is a fixed random convnet — random features are a
+known-sufficient basis for Gram-style texture matching — so the full
+loop (feature Grams, autograd to the INPUT, Adam on pixels) runs as
+published.
+
+Run: python examples/neural-style/neural_style.py [--iters 60]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+SIZE = 32
+
+
+def make_extractor(rng, channels=(8, 16)):
+    ws = []
+    cin = 3
+    for c in channels:
+        ws.append(nd.array(rng.randn(c, cin, 3, 3).astype(np.float32)
+                           * np.sqrt(2.0 / (cin * 9))))
+        cin = c
+    return ws
+
+
+def features(x, ws):
+    feats = []
+    h = x
+    for w in ws:
+        h = nd.Convolution(h, w, kernel=(3, 3), pad=(1, 1),
+                           num_filter=w.shape[0], no_bias=True)
+        h = nd.Activation(h, act_type="relu")
+        feats.append(h)
+    return feats
+
+
+def gram(f):
+    c = f.shape[1]
+    flat = f.reshape((0, c, -1))
+    return nd.batch_dot(flat, flat, transpose_b=True) / float(
+        f.shape[2] * f.shape[3])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--style-weight", type=float, default=10.0)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    ws = make_extractor(rng)
+
+    # content: a centered bright square; style: diagonal stripes
+    content = np.zeros((1, 3, SIZE, SIZE), np.float32)
+    content[:, :, 8:24, 8:24] = 1.0
+    gy, gx = np.meshgrid(np.arange(SIZE), np.arange(SIZE), indexing="ij")
+    style = np.tile(np.sin((gx + gy) * 0.8)[None, None], (1, 3, 1, 1)) \
+        .astype(np.float32)
+
+    c_feats = features(nd.array(content), ws)
+    s_grams = [gram(f) for f in features(nd.array(style), ws)]
+
+    img = nd.array(rng.randn(1, 3, SIZE, SIZE).astype(np.float32) * 0.1)
+    img.attach_grad()
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    state = opt.create_state(0, img)
+    losses = []
+    for it in range(args.iters):
+        with mx.autograd.record():
+            feats = features(img, ws)
+            content_loss = nd.mean((feats[-1] - c_feats[-1]) ** 2)
+            style_loss = sum(nd.mean((gram(f) - g) ** 2)
+                             for f, g in zip(feats, s_grams))
+            loss = content_loss + args.style_weight * style_loss
+        loss.backward()
+        opt.update(0, img, img.grad, state)
+        img.grad[:] = 0
+        losses.append(float(loss.asnumpy()))
+        if it % 20 == 19:
+            print("iter %d loss %.5f" % (it, losses[-1]))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    print("NEURAL_STYLE_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender.
+
+Reference counterpart: ``example/recommenders`` (demo1-MF: user/item
+embeddings, dot-product rating, L2 loss, trained through Module on
+MovieLens). Offline stand-in: a synthetic low-rank rating matrix with
+noise — the exact recoverability makes the example self-verifying.
+
+Run: python examples/recommenders/matrix_fact.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+N_USERS = 120
+N_ITEMS = 80
+RANK = 6
+
+
+def build_net(factor=RANK):
+    """user/item embedding -> dot (ref recommenders/matrix_fact.py)."""
+    user = sym.var("user")
+    item = sym.var("item")
+    score = sym.var("score")
+    u = sym.Embedding(data=user, input_dim=N_USERS, output_dim=factor,
+                      name="user_embed")
+    i = sym.Embedding(data=item, input_dim=N_ITEMS, output_dim=factor,
+                      name="item_embed")
+    pred = sym.sum(u * i, axis=1)
+    return sym.LinearRegressionOutput(data=pred, label=score, name="lro")
+
+
+def make_ratings(rng, n=6000):
+    gu = rng.randn(N_USERS, RANK).astype(np.float32) / np.sqrt(RANK)
+    gi = rng.randn(N_ITEMS, RANK).astype(np.float32) / np.sqrt(RANK)
+    users = rng.randint(0, N_USERS, n)
+    items = rng.randint(0, N_ITEMS, n)
+    scores = (gu[users] * gi[items]).sum(1) + \
+        rng.randn(n).astype(np.float32) * 0.05
+    return (users.astype(np.float32), items.astype(np.float32),
+            scores.astype(np.float32))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    users, items, scores = make_ratings(rng)
+    batch = 200
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score": scores}, batch, shuffle=True)
+    mod = mx.mod.Module(build_net(), context=mx.cpu(),
+                        data_names=("user", "item"),
+                        label_names=("score",))
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Normal(0.2), eval_metric="mse")
+    it.reset()
+    mse = mod.score(it, "mse")[0][1]
+    print("final train mse: %.4f" % mse)
+    assert mse < 0.05, mse  # noise floor is 0.0025; low-rank recovered
+    print("MATRIX_FACT_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient on a cartpole-style balancing task.
+
+Reference counterpart: ``example/reinforcement-learning`` (the a3c /
+ddpg / parallel_actor_critic family — gym-backed there; offline here a
+minimal cart-pole dynamics sim stands in). The learning loop is the
+published REINFORCE recipe: sample trajectories from a softmax policy,
+scale log-prob gradients by normalized returns, ascend.
+
+Run: python examples/reinforcement-learning/reinforce_pole.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+class PoleEnv:
+    """Minimal cart-pole: state (x, x', th, th'), discrete push."""
+
+    def reset(self, rng):
+        self.s = rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + 0.05 * thd ** 2 * sinth) / 1.1
+        thacc = (9.8 * sinth - costh * temp) / \
+            (0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        xacc = temp - 0.05 * thacc * costh / 1.1
+        dt = 0.02
+        self.s = np.asarray([x + dt * xd, xd + dt * xacc,
+                             th + dt * thd, thd + dt * thacc], np.float32)
+        done = abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.21
+        return self.s, 1.0, done
+
+
+def main():
+    rng = np.random.RandomState(0)
+    w1 = nd.array(rng.randn(4, 24).astype(np.float32) * 0.5)
+    b1 = nd.zeros((24,))
+    w2 = nd.array(rng.randn(24, 2).astype(np.float32) * 0.5)
+    params = [w1, b1, w2]
+    for p in params:
+        p.attach_grad()
+    env = PoleEnv()
+    lr = 0.03
+    gamma = 0.98
+    returns_log = []
+    for episode in range(400):
+        states, actions, rewards = [], [], []
+        s = env.reset(rng)
+        for _t in range(200):
+            h = np.tanh(s @ w1.asnumpy() + b1.asnumpy())
+            logits = h @ w2.asnumpy()
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = rng.choice(2, p=p)
+            states.append(s.copy())
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+            if done:
+                break
+        G = np.zeros(len(rewards), np.float32)
+        run = 0.0
+        for t in reversed(range(len(rewards))):
+            run = rewards[t] + gamma * run
+            G[t] = run
+        G = (G - G.mean()) / (G.std() + 1e-6)
+        sb = nd.array(np.asarray(states))
+        ab = nd.array(np.asarray(actions, np.float32))
+        gb = nd.array(G)
+        with mx.autograd.record():
+            h = nd.tanh(nd.dot(sb, w1) + b1)
+            logits = nd.dot(h, w2)
+            logp = nd.log_softmax(logits, axis=-1)
+            picked = nd.pick(logp, ab, axis=1)
+            loss = -nd.mean(picked * gb)
+        loss.backward()
+        for p in params:
+            p -= lr * p.grad
+            p.grad[:] = 0
+        returns_log.append(len(rewards))
+        if episode % 50 == 49:
+            print("episode %d mean return (last 50): %.1f"
+                  % (episode, np.mean(returns_log[-50:])))
+    early = np.mean(returns_log[:50])
+    late = np.mean(returns_log[-50:])
+    print("mean return early %.1f -> late %.1f" % (early, late))
+    assert late > early * 2.0, (early, late)
+    print("REINFORCE_OK")
+
+
+if __name__ == "__main__":
+    main()

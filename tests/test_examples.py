@@ -99,3 +99,29 @@ def test_autoencoder():
     out = _run("autoencoder/autoencoder.py", "--pretrain-epochs", "4",
                "--finetune-epochs", "10", "--num-examples", "1024")
     assert "AE_OK" in out, out[-1500:]
+
+
+@pytest.mark.parametrize("script,marker", [
+    ("fcn-xs/fcn_xs.py", "FCN_XS_OK"),
+    ("multi-task/example_multi_task.py", "MULTI_TASK_OK"),
+    ("neural-style/neural_style.py", "NEURAL_STYLE_OK"),
+    ("recommenders/matrix_fact.py", "MATRIX_FACT_OK"),
+])
+def test_example_domain(script, marker):
+    """Round-4 domain families (ref example/<domain>): each script is
+    self-verifying (asserts its own learning outcome) and prints a
+    marker on success."""
+    out = _run(script, timeout=900)
+    assert marker in out, out[-1500:]
+
+
+@pytest.mark.nightly
+@pytest.mark.parametrize("script,marker", [
+    ("nce-loss/toy_nce.py", "NCE_OK"),
+    ("reinforcement-learning/reinforce_pole.py", "REINFORCE_OK"),
+])
+def test_example_domain_nightly(script, marker):
+    """The minutes-long trainings (60-epoch NCE, 400-episode
+    REINFORCE) run on the nightly tier."""
+    out = _run(script, timeout=900)
+    assert marker in out, out[-1500:]
