@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""CNN text classification (Kim 2014).
+
+Reference counterpart: ``example/cnn_text_classification/text_cnn.py``
+— embedding, parallel conv branches over n-gram windows, max-over-time
+pooling, concat, dropout, softmax. Same symbol structure; the offline
+task classifies synthetic token sequences by which trigram pattern
+they contain, which only the n-gram filters can detect.
+
+Run: python examples/cnn_text_classification/text_cnn.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+VOCAB = 50
+SEQ = 24
+EMBED = 16
+N_CLS = 3
+PATTERNS = [(7, 11, 13), (21, 22, 23), (31, 3, 31)]
+
+
+def build_net(filter_sizes=(2, 3, 4), num_filter=16):
+    data = sym.var("data")  # (N, SEQ)
+    embed = sym.Embedding(data=data, input_dim=VOCAB, output_dim=EMBED,
+                          name="embed")
+    conv_in = sym.Reshape(embed, shape=(0, 1, SEQ, EMBED))
+    pooled = []
+    for fs in filter_sizes:
+        c = sym.Convolution(data=conv_in, num_filter=num_filter,
+                            kernel=(fs, EMBED), name="conv%d" % fs)
+        a = sym.Activation(c, act_type="relu")
+        p = sym.Pooling(a, kernel=(SEQ - fs + 1, 1), pool_type="max",
+                        name="pool%d" % fs)
+        pooled.append(p)
+    h = sym.Concat(*pooled, dim=1)
+    h = sym.Flatten(h)
+    h = sym.Dropout(h, p=0.3)
+    fc = sym.FullyConnected(data=h, num_hidden=N_CLS, name="cls")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def make_data(rng, n):
+    xs = rng.randint(0, VOCAB, (n, SEQ))
+    ys = rng.randint(0, N_CLS, n)
+    for i, y in enumerate(ys):
+        pos = rng.randint(0, SEQ - 3)
+        xs[i, pos:pos + 3] = PATTERNS[y]
+    return xs.astype(np.float32), ys.astype(np.float32)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    xs, ys = make_data(rng, 2048)
+    it = mx.io.NDArrayIter(xs, ys, 64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(build_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005},
+            initializer=mx.initializer.Xavier(), eval_metric="acc")
+    tx, ty = make_data(np.random.RandomState(99), 512)
+    tit = mx.io.NDArrayIter(tx, ty, 64, label_name="softmax_label")
+    acc = mod.score(tit, "acc")[0][1]
+    print("held-out accuracy %.3f" % acc)
+    assert acc > 0.9, acc
+    print("TEXT_CNN_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""CTC sequence transcription (OCR-style).
+
+Reference counterpart: ``example/ctc/lstm_ocr.py`` — an LSTM reads a
+rendered sequence image column by column and CTC loss aligns the
+per-column class posteriors with the unsegmented label string. Offline
+stand-in: "images" whose columns carry digit-block patterns of varying
+width, so alignment is genuinely unknown and CTC's marginalization is
+exercised; decoding is best-path (greedy) collapse.
+
+Run: python examples/ctc/lstm_ocr.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+N_DIGITS = 5      # classes 0..4; CTC blank = last (5)
+HEIGHT = 8
+WIDTH = 24
+LABEL_LEN = 3
+HID = 32
+
+
+def render(rng, digits):
+    """Each digit occupies 4-8 columns lighting row block [d, d+3]."""
+    img = rng.randn(HEIGHT, WIDTH).astype(np.float32) * 0.1
+    col = rng.randint(0, 3)
+    for d in digits:
+        w = rng.randint(4, 9)
+        img[d:d + 4, col:col + w] += 1.5
+        col += w
+        if col >= WIDTH:
+            break
+    return img
+
+
+def make_data(rng, n):
+    xs = np.zeros((n, WIDTH, HEIGHT), np.float32)   # columns as timesteps
+    ys = np.zeros((n, LABEL_LEN), np.float32)
+    for i in range(n):
+        digits = rng.randint(0, N_DIGITS, LABEL_LEN)
+        xs[i] = render(rng, digits).T
+        ys[i] = digits
+    return xs, ys
+
+
+def greedy_decode(post):
+    """Best-path CTC collapse (blank = last class)."""
+    path = post.argmax(-1)
+    out = []
+    prev = -1
+    for p in path:
+        if p != prev and p != N_DIGITS:
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def rnn_forward(xb, batch, w_in, w_h, b_h, w_out, b_out):
+    """Column-by-column recurrence -> (T, N, C) activations; shared by
+    train and eval so both always run the same network."""
+    h = nd.zeros((batch, HID))
+    outs = []
+    for t in range(WIDTH):
+        h = nd.tanh(nd.dot(xb[:, t, :], w_in) + nd.dot(h, w_h) + b_h)
+        outs.append(nd.dot(h, w_out) + b_out)
+    return nd.stack(*outs, axis=0)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 1024
+    xs, ys = make_data(rng, n)
+
+    w_in = nd.array(rng.randn(HEIGHT, HID).astype(np.float32) * 0.3)
+    w_h = nd.array(rng.randn(HID, HID).astype(np.float32) * 0.3)
+    b_h = nd.zeros((HID,))
+    w_out = nd.array(rng.randn(HID, N_DIGITS + 1).astype(np.float32) * 0.3)
+    b_out = nd.zeros((N_DIGITS + 1,))
+    params = [w_in, w_h, b_h, w_out, b_out]
+    for p in params:
+        p.attach_grad()
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    states = [opt.create_state(i, p) for i, p in enumerate(params)]
+
+    batch = 64
+    for epoch in range(12):
+        tot = 0.0
+        for s in range(n // batch):
+            xb = nd.array(xs[s * batch:(s + 1) * batch])
+            yb = nd.array(ys[s * batch:(s + 1) * batch])
+            with mx.autograd.record():
+                acts = rnn_forward(xb, batch, w_in, w_h, b_h, w_out,
+                                   b_out)                # (T, N, C)
+                loss = nd.mean(nd.CTCLoss(acts, yb))
+            loss.backward()
+            for i, p in enumerate(params):
+                opt.update(i, p, p.grad, states[i])
+                p.grad[:] = 0
+            tot += float(loss.asnumpy())
+        if epoch % 4 == 3:
+            print("epoch %d ctc loss %.4f" % (epoch, tot / (n // batch)))
+
+    # greedy decode on held-out renders
+    tx, ty = make_data(np.random.RandomState(99), 128)
+    correct = 0
+    post = rnn_forward(nd.array(tx), 128, w_in, w_h, b_h, w_out,
+                       b_out).asnumpy()
+    for i in range(128):
+        if greedy_decode(post[:, i]) == list(ty[i].astype(int)):
+            correct += 1
+    rate = correct / 128.0
+    print("exact transcription rate: %.3f" % rate)
+    assert rate > 0.6, rate
+    print("CTC_OCR_OK")
+
+
+if __name__ == "__main__":
+    main()

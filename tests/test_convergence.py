@@ -110,6 +110,8 @@ def test_module_fit_tpu_kvstore_matches_local():
     reaches the same accuracy bar as the single-device path — the
     dist-convergence-parity claim of BASELINE.md in miniature."""
     np.random.seed(13)
+    mx.random.seed(13)  # pin the framework RNG: initializer draws from
+    # it, so suite ordering must not change this test's starting point
     xt, yt = _synth_images(2000, seed=4)
     xv, yv = _synth_images(400, seed=5)
     train = mx.io.NDArrayIter(xt, yt, batch_size=64, shuffle=True,

@@ -119,6 +119,9 @@ def test_example_domain(script, marker):
 @pytest.mark.parametrize("script,marker", [
     ("nce-loss/toy_nce.py", "NCE_OK"),
     ("reinforcement-learning/reinforce_pole.py", "REINFORCE_OK"),
+    ("bi-lstm-sort/sort_io.py", "BI_LSTM_SORT_OK"),
+    ("cnn_text_classification/text_cnn.py", "TEXT_CNN_OK"),
+    ("ctc/lstm_ocr.py", "CTC_OCR_OK"),
 ])
 def test_example_domain_nightly(script, marker):
     """The minutes-long trainings (60-epoch NCE, 400-episode

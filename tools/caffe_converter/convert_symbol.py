@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Caffe prototxt -> mxnet_tpu Symbol converter.
+
+Reference counterpart: ``tools/caffe_converter/convert_symbol.py`` —
+the bridge tier that lets users carry Caffe model definitions over
+(plugin/README.md). The reference parses prototxt through caffe's
+generated protobuf classes; deployment prototxt is protobuf TEXT
+format, which a compact recursive parser covers without a caffe
+install, so the converter runs in this offline image. Layer mapping
+follows the reference table (convert_symbol.py _parse_proto):
+Convolution, Pooling, InnerProduct, ReLU/Sigmoid/TanH, LRN, Dropout,
+Softmax/SoftmaxWithLoss, Concat, Eltwise, BatchNorm(+Scale), Flatten.
+
+Usage:
+    python tools/caffe_converter/convert_symbol.py net.prototxt out.json
+or  from convert_symbol import convert_symbol; sym = convert_symbol(text)
+"""
+import re
+import sys
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf-text parser: blocks { } and key: value pairs
+# ---------------------------------------------------------------------------
+def parse_prototxt(text):
+    """Parse protobuf text format into a dict of lists (repeated fields
+    stay lists; nested messages become dicts)."""
+    text = re.sub(r"#[^\n]*", "", text)
+    tokens = re.findall(r"\"[^\"]*\"|'[^']*'|[\w./+-]+|[{}:]", text)
+    pos = [0]
+
+    def parse_block():
+        out = {}
+        while pos[0] < len(tokens):
+            tok = tokens[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return out
+            name = tok
+            pos[0] += 1
+            if tokens[pos[0]] == ":":
+                pos[0] += 1
+                val = tokens[pos[0]]
+                pos[0] += 1
+                if val.startswith('"') or val.startswith("'"):
+                    val = val[1:-1]
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            pass  # enum / bool keyword stays a string
+                out.setdefault(name, []).append(val)
+            elif tokens[pos[0]] == "{":
+                pos[0] += 1
+                out.setdefault(name, []).append(parse_block())
+            else:
+                raise ValueError("parse error near %r" % tokens[pos[0]])
+        return out
+
+    return parse_block()
+
+
+def _one(msg, key, default=None):
+    v = msg.get(key)
+    return v[0] if v else default
+
+
+def _pair(msg, key, key_h, key_w, default):
+    """Caffe's size/size_h+size_w convention -> (h, w)."""
+    if key in msg:
+        v = msg[key]
+        return (v[0], v[0]) if len(v) == 1 else (v[0], v[1])
+    return (_one(msg, key_h, default), _one(msg, key_w, default))
+
+
+def _bool(v, default=False):
+    if v is None:
+        return default
+    return v in (True, "true", 1, "True")
+
+
+# ---------------------------------------------------------------------------
+# layer mapping (ref convert_symbol.py:73-260)
+# ---------------------------------------------------------------------------
+def convert_symbol(text, input_name="data"):
+    """Convert deployment-prototxt text to a Symbol. Returns
+    (symbol, input_dim or None)."""
+    from mxnet_tpu import symbol as sym
+
+    proto = parse_prototxt(text)
+    layers = proto.get("layer", proto.get("layers", []))
+    input_dim = None
+    if "input_dim" in proto:
+        input_dim = tuple(proto["input_dim"])
+    elif "input_shape" in proto:
+        input_dim = tuple(proto["input_shape"][0]["dim"])
+
+    blobs = {}
+    # the converted symbol's input is always named "data" (reference
+    # convention, convert_symbol.py); the caffe blob name keys the
+    # blob table so bottoms resolve
+    name0 = _one(proto, "input", input_name)
+    blobs[name0] = sym.var("data")
+
+    def top(layer):
+        return layer.get("top", [layer["name"][0]])[0]
+
+    def bottoms(layer):
+        return [blobs[b] for b in layer.get("bottom", [])]
+
+    for layer in layers:
+        ltype = _one(layer, "type")
+        name = _one(layer, "name")
+        if ltype == "Input":
+            if "input_param" in layer:
+                input_dim = tuple(layer["input_param"][0]["shape"][0]["dim"])
+            blobs[top(layer)] = blobs[name0]
+            continue
+        bots = bottoms(layer)
+        if ltype == "Convolution":
+            p = layer["convolution_param"][0]
+            kh, kw = _pair(p, "kernel_size", "kernel_h", "kernel_w", 1)
+            sh, sw = _pair(p, "stride", "stride_h", "stride_w", 1)
+            ph, pw = _pair(p, "pad", "pad_h", "pad_w", 0)
+            out = sym.Convolution(
+                data=bots[0], num_filter=_one(p, "num_output"),
+                kernel=(kh, kw), stride=(sh, sw), pad=(ph, pw),
+                num_group=_one(p, "group", 1),
+                no_bias=not _bool(_one(p, "bias_term"), True), name=name)
+        elif ltype == "Pooling":
+            p = layer["pooling_param"][0]
+            kh, kw = _pair(p, "kernel_size", "kernel_h", "kernel_w", 1)
+            sh, sw = _pair(p, "stride", "stride_h", "stride_w", 1)
+            ph, pw = _pair(p, "pad", "pad_h", "pad_w", 0)
+            kind = _one(p, "pool", "MAX")
+            pools = {"MAX": "max", "AVE": "avg", 0: "max", 1: "avg"}
+            if kind not in pools:
+                raise ValueError(
+                    "caffe pooling type %r not supported (layer %s)"
+                    % (kind, name))
+            pool = pools[kind]
+            if _bool(_one(p, "global_pooling")):
+                out = sym.Pooling(data=bots[0], global_pool=True,
+                                  kernel=(1, 1), pool_type=pool, name=name)
+            else:
+                # caffe pooling uses ceil output sizing -> 'full'
+                out = sym.Pooling(data=bots[0], kernel=(kh, kw),
+                                  stride=(sh, sw), pad=(ph, pw),
+                                  pool_type=pool,
+                                  pooling_convention="full", name=name)
+        elif ltype == "InnerProduct":
+            p = layer["inner_product_param"][0]
+            out = sym.FullyConnected(
+                data=bots[0], num_hidden=_one(p, "num_output"),
+                no_bias=not _bool(_one(p, "bias_term"), True), name=name)
+        elif ltype in ("ReLU", "Sigmoid", "TanH"):
+            act = {"ReLU": "relu", "Sigmoid": "sigmoid", "TanH": "tanh"}
+            out = sym.Activation(data=bots[0], act_type=act[ltype],
+                                 name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", [{}])[0]
+            out = sym.LRN(data=bots[0], alpha=_one(p, "alpha", 1e-4),
+                          beta=_one(p, "beta", 0.75),
+                          knorm=_one(p, "k", 1.0),
+                          nsize=_one(p, "local_size", 5), name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", [{}])[0]
+            out = sym.Dropout(data=bots[0],
+                              p=_one(p, "dropout_ratio", 0.5), name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = sym.SoftmaxOutput(data=bots[0], name=name)
+        elif ltype == "Concat":
+            p = layer.get("concat_param", [{}])[0]
+            out = sym.Concat(*bots, dim=_one(p, "axis", 1), name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", [{}])[0]
+            op = _one(p, "operation", "SUM")
+            if op in ("SUM", 1):
+                out = bots[0]
+                for b in bots[1:]:
+                    out = out + b
+            elif op in ("PROD", 0):
+                out = bots[0]
+                for b in bots[1:]:
+                    out = out * b
+            else:
+                out = bots[0]
+                for b in bots[1:]:
+                    out = sym.broadcast_maximum(out, b)
+        elif ltype == "BatchNorm":
+            # fix_gamma=False: the learnable gamma/beta stand in for the
+            # Scale layer caffe pairs with BatchNorm (the Scale below
+            # maps to identity because its affine lives here)
+            p = layer.get("batch_norm_param", [{}])[0]
+            out = sym.BatchNorm(data=bots[0], fix_gamma=False,
+                                eps=_one(p, "eps", 1e-5),
+                                use_global_stats=_bool(
+                                    _one(p, "use_global_stats"), True),
+                                name=name)
+        elif ltype == "Scale":
+            # the preceding BatchNorm's gamma/beta (fix_gamma=False)
+            # absorb caffe's Scale layer (ref convert_symbol.py:229) —
+            # emit an identity so the blob chain stays intact
+            out = sym.identity(data=bots[0], name=name)
+        elif ltype == "Flatten":
+            out = sym.Flatten(data=bots[0], name=name)
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise ValueError("caffe layer type %r not supported (layer %s)"
+                             % (ltype, name))
+        blobs[top(layer)] = out
+
+    return blobs[top(layers[-1])], input_dim
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        raise SystemExit(1)
+    sys.path.insert(0, __file__.rsplit("/", 3)[0])
+    with open(sys.argv[1]) as f:
+        s, input_dim = convert_symbol(f.read())
+    s.save(sys.argv[2])
+    print("converted -> %s (input_dim=%s)" % (sys.argv[2], input_dim))
+
+
+if __name__ == "__main__":
+    main()
