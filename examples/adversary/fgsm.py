@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Adversarial examples by the fast gradient sign method.
+
+Reference counterpart: ``example/adversary`` — train a classifier,
+then perturb inputs along sign(dL/dx) and watch accuracy collapse
+while the perturbation stays imperceptible. Exercises input-side
+gradients through the executor (grad_req on data).
+
+Run: python examples/adversary/fgsm.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def make_data(rng, n):
+    ys = rng.randint(0, 10, n)
+    xs = rng.randn(n, 784).astype(np.float32) * 0.3
+    for i, y in enumerate(ys):
+        xs[i, y * 78:(y + 1) * 78] += 0.7
+    return xs, ys.astype(np.float32)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    xs, ys = make_data(rng, 2048)
+
+    w1 = nd.array(rng.randn(784, 128).astype(np.float32) * 0.05)
+    b1 = nd.zeros((128,))
+    w2 = nd.array(rng.randn(128, 10).astype(np.float32) * 0.05)
+    b2 = nd.zeros((10,))
+    params = [w1, b1, w2, b2]
+    for p in params:
+        p.attach_grad()
+
+    def forward(x):
+        h = nd.relu(nd.dot(x, w1) + b1)
+        return nd.dot(h, w2) + b2
+
+    opt = mx.optimizer.create("adam", learning_rate=0.005)
+    states = [opt.create_state(i, p) for i, p in enumerate(params)]
+    batch = 128
+    for epoch in range(6):
+        for s in range(len(xs) // batch):
+            xb = nd.array(xs[s * batch:(s + 1) * batch])
+            yb = nd.array(ys[s * batch:(s + 1) * batch])
+            with mx.autograd.record():
+                logits = forward(xb)
+                logp = nd.log_softmax(logits, axis=-1)
+                loss = -nd.mean(nd.pick(logp, yb, axis=1))
+            loss.backward()
+            for i, p in enumerate(params):
+                opt.update(i, p, p.grad, states[i])
+                p.grad[:] = 0
+
+    tx, ty = make_data(np.random.RandomState(9), 512)
+    clean = forward(nd.array(tx)).asnumpy().argmax(1)
+    clean_acc = (clean == ty).mean()
+
+    # FGSM: x' = x + eps * sign(dL/dx)
+    xadv = nd.array(tx)
+    xadv.attach_grad()
+    with mx.autograd.record():
+        logits = forward(xadv)
+        logp = nd.log_softmax(logits, axis=-1)
+        loss = -nd.mean(nd.pick(logp, nd.array(ty), axis=1))
+    loss.backward()
+    eps = 0.4
+    perturbed = nd.array(tx) + eps * nd.sign(xadv.grad)
+    adv = forward(perturbed).asnumpy().argmax(1)
+    adv_acc = (adv == ty).mean()
+    print("clean accuracy %.3f -> adversarial accuracy %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, eps))
+    assert clean_acc > 0.9, clean_acc
+    assert adv_acc < clean_acc - 0.3, (clean_acc, adv_acc)
+    print("FGSM_OK")
+
+
+if __name__ == "__main__":
+    main()

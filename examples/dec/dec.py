@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Deep embedded clustering (DEC).
+
+Reference counterpart: ``example/dec/dec.py`` (Xie et al.) — pretrain
+an autoencoder, then refine cluster assignments by matching the
+Student-t soft assignment q to its sharpened target p while
+fine-tuning the encoder. Same three phases on a synthetic
+mixture-of-blobs dataset; success = unsupervised cluster accuracy via
+a greedy label matching.
+
+Run: python examples/dec/dec.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+DIM = 32
+LATENT = 4
+K = 4
+
+
+def make_data(rng, n):
+    centers = rng.randn(K, DIM).astype(np.float32) * 2.0
+    ys = rng.randint(0, K, n)
+    xs = centers[ys] + rng.randn(n, DIM).astype(np.float32) * 0.4
+    return xs, ys
+
+
+def cluster_acc(assign, ys):
+    """Greedy cluster→label matching accuracy."""
+    acc = 0
+    for c in range(K):
+        members = ys[assign == c]
+        if len(members):
+            acc += np.bincount(members, minlength=K).max()
+    return acc / len(ys)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    xs, ys = make_data(rng, 1024)
+
+    # --- phase 1: autoencoder pretrain (ref dec.py uses layerwise AE) --
+    w_e = nd.array(rng.randn(DIM, LATENT).astype(np.float32) * 0.1)
+    b_e = nd.zeros((LATENT,))
+    w_d = nd.array(rng.randn(LATENT, DIM).astype(np.float32) * 0.1)
+    b_d = nd.zeros((DIM,))
+    params = [w_e, b_e, w_d, b_d]
+    for p in params:
+        p.attach_grad()
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    st = [opt.create_state(i, p) for i, p in enumerate(params)]
+    batch = 128
+    for epoch in range(15):
+        for s in range(len(xs) // batch):
+            xb = nd.array(xs[s * batch:(s + 1) * batch])
+            with mx.autograd.record():
+                z = nd.dot(xb, w_e) + b_e
+                rec = nd.dot(z, w_d) + b_d
+                loss = nd.mean((rec - xb) ** 2)
+            loss.backward()
+            for i, p in enumerate(params):
+                opt.update(i, p, p.grad, st[i])
+                p.grad[:] = 0
+
+    # --- phase 2: k-means init of centroids in latent space -----------
+    z = (nd.dot(nd.array(xs), w_e) + b_e).asnumpy()
+    # k-means with multiple restarts (plain init can collapse clusters)
+    best_mu, best_inertia = None, np.inf
+    for trial in range(8):
+        idx = rng.choice(len(z), K, replace=False)
+        mu = z[idx].copy()
+        for _ in range(25):
+            d = ((z[:, None] - mu[None]) ** 2).sum(2)
+            a = d.argmin(1)
+            for c in range(K):
+                if (a == c).any():
+                    mu[c] = z[a == c].mean(0)
+        a = ((z[:, None] - mu[None]) ** 2).sum(2).argmin(1)
+        inertia = ((z - mu[a]) ** 2).sum()
+        if inertia < best_inertia:
+            best_mu, best_inertia = mu.copy(), inertia
+    mu = best_mu
+
+    # --- phase 3: DEC refinement: sharpen q -> p, KL fine-tune --------
+    mu_nd = nd.array(mu)
+    mu_nd.attach_grad()
+    all_p = params[:2] + [mu_nd]           # encoder + centroids
+    opt2 = mx.optimizer.create("adam", learning_rate=0.01)
+    st2 = [opt2.create_state(i, p) for i, p in enumerate(all_p)]
+    for it in range(40):
+        xb = nd.array(xs)
+        with mx.autograd.record():
+            zb = nd.dot(xb, w_e) + b_e
+            d2 = nd.sum((zb.reshape((-1, 1, LATENT)) - mu_nd) ** 2, axis=2)
+            q = 1.0 / (1.0 + d2)
+            q = q / nd.sum(q, axis=1, keepdims=True)
+            qn = q.asnumpy()
+            f = qn.sum(0)
+            pt = (qn ** 2) / f
+            pt = pt / pt.sum(1, keepdims=True)
+            p_target = nd.array(pt)
+            loss = nd.mean(nd.sum(
+                p_target * (nd.log(p_target + 1e-10) - nd.log(q + 1e-10)),
+                axis=1))
+        loss.backward()
+        for i, p in enumerate(all_p):
+            opt2.update(i, p, p.grad, st2[i])
+            p.grad[:] = 0
+
+    z = (nd.dot(nd.array(xs), w_e) + b_e).asnumpy()
+    assign = ((z[:, None] - mu_nd.asnumpy()[None]) ** 2).sum(2).argmin(1)
+    acc = cluster_acc(assign, ys)
+    print("unsupervised cluster accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("DEC_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Stochastic-depth residual training.
+
+Reference counterpart: ``example/stochastic-depth/sd_cifar10.py`` —
+residual units whose bodies are randomly dropped during training
+(survival probability decaying with depth) and scaled by p at test
+time. Built imperatively with gluon blocks so the per-batch coin flips
+stay host-side, exactly like the reference's DataParallelExecutorGroup
+callback trick.
+
+Run: python examples/stochastic-depth/sd_cifar.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+_UNIT_SEQ = [0]
+
+
+class SDResUnit(gluon.HybridBlock):
+    """Residual unit dropped with prob 1-p_survive during training."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super().__init__(**kw)
+        self.p_survive = float(p_survive)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(channels, 3, padding=1, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.Conv2D(channels, 3, padding=1, use_bias=False),
+                          nn.BatchNorm())
+        # per-unit seed: the units' coin flips must be INDEPENDENT
+        # (a shared seed would make the surviving set a nested prefix)
+        _UNIT_SEQ[0] += 1
+        self._rng = np.random.RandomState(42 + _UNIT_SEQ[0])
+        self._warm = False
+
+    def forward(self, x):
+        # host-side coin flip per call (ref sd_module.py); the FIRST
+        # training call always runs the body so its deferred-shape
+        # params initialize before any drop can skip them
+        if mx.autograd.is_training():
+            first, self._warm = not self._warm, True
+            if first or self._rng.rand() < self.p_survive:
+                return mx.nd.relu(x + self.body(x))
+            return x
+        return mx.nd.relu(x + self.p_survive * self.body(x))
+
+
+def build_net(n_units=4, channels=16, p_last=0.5):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(channels, 3, padding=1), nn.Activation("relu"))
+    for i in range(n_units):
+        # linearly decaying survival (ref: p_l = 1 - l/L * (1 - pL))
+        p = 1.0 - (i + 1) / n_units * (1.0 - p_last)
+        net.add(SDResUnit(channels, p))
+    net.add(nn.GlobalAvgPool2D(), nn.Dense(4))
+    return net
+
+
+def make_data(rng, n):
+    ys = rng.randint(0, 4, n)
+    xs = rng.randn(n, 3, 16, 16).astype(np.float32) * 0.3
+    for i, y in enumerate(ys):
+        xs[i, y % 3, 4 * (y // 2):4 * (y // 2) + 8, 4:12] += 1.5
+    return xs, ys.astype(np.float32)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    xs, ys = make_data(rng, 1024)
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batch = 64
+    for epoch in range(8):
+        tot = 0.0
+        for s in range(len(xs) // batch):
+            xb = mx.nd.array(xs[s * batch:(s + 1) * batch])
+            yb = mx.nd.array(ys[s * batch:(s + 1) * batch])
+            with mx.autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(batch)
+            tot += float(loss.mean().asnumpy())
+        if epoch % 4 == 3:
+            print("epoch %d loss %.4f" % (epoch, tot / (len(xs) // batch)))
+
+    tx, ty = make_data(np.random.RandomState(9), 256)
+    preds = net(mx.nd.array(tx)).asnumpy().argmax(1)
+    acc = (preds == ty).mean()
+    print("held-out accuracy (expected-depth inference): %.3f" % acc)
+    assert acc > 0.8, acc
+    print("STOCHASTIC_DEPTH_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Torch bridge plugin: run torch functions as framework operators.
+
+Reference counterpart: ``plugin/torch`` — the reference embeds (lua)
+Torch modules and criteria as mxnet operators (torch_module-inl.h),
+letting users graft kernels from the other framework into a graph.
+Same capability against today's torch: ``TorchOp`` wraps any
+``torch.nn.functional`` (or ``torch.*``) function as a Custom op —
+forward runs the torch kernel on host tensors, backward flows through
+``torch.autograd`` — so it composes with the executor, autograd, and
+Module like any native operator.
+
+Usage::
+
+    import plugin.torch.torch_module  # registers op_type='torch_op'
+    y = mx.sym.Custom(x, op_type="torch_op", fn="relu")
+    z = mx.sym.Custom(a, b, op_type="torch_op", fn="mul", num_args=2)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _resolve(fn_name):
+    import torch
+    import torch.nn.functional as F
+
+    if hasattr(F, fn_name):
+        return getattr(F, fn_name)
+    if hasattr(torch, fn_name):
+        return getattr(torch, fn_name)
+    raise mx.MXNetError(
+        "torch plugin: %r not found in torch.nn.functional or torch"
+        % fn_name)
+
+
+class TorchOp(mx.operator.CustomOp):
+    def __init__(self, fn, n_in, kwargs):
+        self._fn = fn
+        self._n_in = n_in
+        self._kwargs = kwargs
+        self._saved = None
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        import torch
+
+        if not is_train:
+            # inference: no autograd graph, no residuals pinned
+            with torch.no_grad():
+                out = self._fn(*[torch.tensor(x.asnumpy())
+                                 for x in in_data], **self._kwargs)
+            self._saved = None
+            self.assign(out_data[0], req[0], mx.nd.array(out.numpy()))
+            return
+        tins = [torch.tensor(x.asnumpy(), requires_grad=True)
+                for x in in_data]
+        out = self._fn(*tins, **self._kwargs)
+        self._saved = (tins, out)
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(out.detach().numpy()))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        import torch
+
+        tins, out = self._saved
+        grads = torch.autograd.grad(
+            out, tins, torch.tensor(out_grad[0].asnumpy()),
+            allow_unused=True)
+        for i, g in enumerate(grads):
+            if g is None:
+                self.assign(in_grad[i], req[i],
+                            mx.nd.zeros(in_data[i].shape))
+            else:
+                self.assign(in_grad[i], req[i], mx.nd.array(g.numpy()))
+
+
+@mx.operator.register("torch_op")
+class TorchOpProp(mx.operator.CustomOpProp):
+    def __init__(self, fn="relu", num_args="1", **kwargs):
+        super().__init__(need_top_grad=True)
+        self._fn_name = str(fn)
+        self._n_in = int(num_args)
+        # remaining kwargs forward to the torch callable, parsed from
+        # their string form (the Custom-op attr convention)
+        self._kwargs = {}
+        for k, v in kwargs.items():
+            try:
+                self._kwargs[k] = int(v)
+            except ValueError:
+                try:
+                    self._kwargs[k] = float(v)
+                except ValueError:
+                    self._kwargs[k] = v
+
+    def list_arguments(self):
+        return ["data%d" % i for i in range(self._n_in)]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        import torch
+
+        fn = _resolve(self._fn_name)
+        outs = fn(*[torch.zeros(tuple(s)) for s in in_shape],
+                  **self._kwargs)
+        return in_shape, [list(outs.shape)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes=None):
+        return TorchOp(_resolve(self._fn_name), self._n_in, self._kwargs)

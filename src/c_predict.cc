@@ -102,7 +102,11 @@ int create_impl(const char *symbol_json_str, const void *param_bytes,
 
 extern "C" {
 
+/* also exported by c_api.cc — guarded out when both compile as one
+ * translation unit (amalgamation/amalgamation.py) */
+#ifndef MXTPU_SINGLE_TU
 const char *MXGetLastError() { return g_last_error.c_str(); }
+#endif
 
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,

@@ -1,0 +1,108 @@
+"""Amalgamation single-TU build + the torch plugin bridge.
+
+Reference bars: ``amalgamation/amalgamation.py`` (one-file build whose
+library serves the predict consumers unchanged) and ``plugin/torch``
+(foreign-framework operators inside the graph)."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_amalgamation_builds_and_serves_predict(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "amalgamation",
+                                      "amalgamation.py"),
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+    so = tmp_path / "libmxtpu_amalgamated.so"
+    assert so.exists()
+    # the amalgamated lib must export BOTH ABIs
+    syms = subprocess.run(["nm", "-D", str(so)], capture_output=True,
+                          text=True).stdout
+    for name in ("MXPredCreate", "MXPredForward", "MXNDArrayCreateEx",
+                 "MXExecutorSimpleBind", "MXCustomOpRegister"):
+        assert name in syms, "missing %s in amalgamated exports" % name
+
+    # drive it end to end with the existing pure-C predict consumer,
+    # relinked against the amalgamated library
+    import tests.test_c_predict as tcp
+
+    csrc = tmp_path / "consumer.c"
+    csrc.write_text(tcp.C_MAIN)
+    exe = str(tmp_path / "consumer")
+    r = subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(ROOT, "src"),
+         "-L", str(tmp_path), "-lmxtpu_amalgamated",
+         "-Wl,-rpath," + str(tmp_path), "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # checkpoint fixture for the consumer (same setup as test_c_predict)
+    prefix, _x, _expect = tcp._export_model(tmp_path)
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    paths = sysconfig.get_paths()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [ROOT, paths["purelib"], paths["platlib"],
+                    env.get("PYTHONPATH", "")] if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe, prefix + "-symbol.json",
+                        prefix + "-0000.params"], capture_output=True,
+                       text=True, env=env, timeout=600)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "C_PREDICT_OK" in out, out
+
+
+def test_torch_plugin_forward_backward():
+    torch = pytest.importorskip("torch")
+    sys.path.insert(0, ROOT)
+    import plugin.torch.torch_module  # noqa: F401  (registers torch_op)
+
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    xn = mx.nd.array(x)
+    xn.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(xn, op_type="torch_op", fn="gelu")
+    cot = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    y.backward(mx.nd.array(cot))
+
+    xt = torch.tensor(x, requires_grad=True)
+    want = torch.nn.functional.gelu(xt)
+    want.backward(torch.tensor(cot))
+    np.testing.assert_allclose(y.asnumpy(), want.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(xn.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_torch_plugin_in_symbol_graph():
+    pytest.importorskip("torch")
+    sys.path.insert(0, ROOT)
+    import plugin.torch.torch_module  # noqa: F401
+
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    t = mx.sym.Custom(h, op_type="torch_op", fn="silu", name="tact")
+    out = mx.sym.SoftmaxOutput(t, name="softmax")
+    ex = out.simple_bind(mx.cpu(), grad_req="write", data=(2, 5),
+                         softmax_label=(2,))
+    rng = np.random.RandomState(0)
+    for name, arr in zip(out.list_arguments(), ex.arg_arrays):
+        if name not in ("data", "softmax_label"):
+            arr[:] = mx.nd.array(rng.randn(*arr.shape).astype(np.float32))
+    res = ex.forward(is_train=True, data=rng.randn(2, 5).astype(np.float32),
+                     softmax_label=np.array([0.0, 1.0], np.float32))[0]
+    assert res.shape == (2, 8)
+    ex.backward()
+    gw = dict(zip(out.list_arguments(), ex.grad_arrays))["fc_weight"]
+    assert np.abs(gw.asnumpy()).sum() > 0

@@ -106,6 +106,8 @@ def test_autoencoder():
     ("multi-task/example_multi_task.py", "MULTI_TASK_OK"),
     ("neural-style/neural_style.py", "NEURAL_STYLE_OK"),
     ("recommenders/matrix_fact.py", "MATRIX_FACT_OK"),
+    ("adversary/fgsm.py", "FGSM_OK"),
+    ("dec/dec.py", "DEC_OK"),
 ])
 def test_example_domain(script, marker):
     """Round-4 domain families (ref example/<domain>): each script is
@@ -122,6 +124,7 @@ def test_example_domain(script, marker):
     ("bi-lstm-sort/sort_io.py", "BI_LSTM_SORT_OK"),
     ("cnn_text_classification/text_cnn.py", "TEXT_CNN_OK"),
     ("ctc/lstm_ocr.py", "CTC_OCR_OK"),
+    ("stochastic-depth/sd_cifar.py", "STOCHASTIC_DEPTH_OK"),
 ])
 def test_example_domain_nightly(script, marker):
     """The minutes-long trainings (60-epoch NCE, 400-episode
