@@ -30,12 +30,27 @@ def main():
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--kv-store", default="tpu")
     p.add_argument("--num-examples", type=int, default=4096)
+    p.add_argument("--image-shape", default="3,32,32",
+                   help="e.g. 3,224,224 for the imagenet-style stack")
+    p.add_argument("--fused", action="store_true",
+                   help="Pallas fused-bottleneck residual units "
+                        "(bottleneck depths, kernels/fused_block.py)")
     args = p.parse_args()
 
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+    if args.fused and shape[1] <= 32:
+        p.error("--fused needs the bottleneck (imagenet-style) stack: "
+                "pass --image-shape 3,64,64 or larger with a bottleneck "
+                "depth (50/101/...); cifar depths < 164 are basic-block")
     sym = resnet.get_symbol(num_classes=10, num_layers=args.num_layers,
-                            image_shape=(3, 32, 32))
+                            image_shape=shape, fused=args.fused)
     xt, yt = synth_cifar(args.num_examples, 0)
     xv, yv = synth_cifar(args.num_examples // 8, 1)
+    if shape[1:] != (32, 32):
+        rh = (shape[1] + 31) // 32
+        rw = (shape[2] + 31) // 32
+        xt = np.tile(xt, (1, 1, rh, rw))[:, :, :shape[1], :shape[2]]
+        xv = np.tile(xv, (1, 1, rh, rw))[:, :, :shape[1], :shape[2]]
     train = mx.io.NDArrayIter(xt, yt, args.batch_size, shuffle=True,
                               label_name="softmax_label")
     val = mx.io.NDArrayIter(xv, yv, args.batch_size,

@@ -85,6 +85,10 @@ class TorchOpProp(mx.operator.CustomOpProp):
         # their string form (the Custom-op attr convention)
         self._kwargs = {}
         for k, v in kwargs.items():
+            if v in ("True", "False", "None"):   # bool/None survive the
+                self._kwargs[k] = {"True": True, "False": False,
+                                   "None": None}[v]   # attr stringification
+                continue
             try:
                 self._kwargs[k] = int(v)
             except ValueError:
@@ -105,6 +109,11 @@ class TorchOpProp(mx.operator.CustomOpProp):
         fn = _resolve(self._fn_name)
         outs = fn(*[torch.zeros(tuple(s)) for s in in_shape],
                   **self._kwargs)
+        if not torch.is_tensor(outs):
+            raise mx.MXNetError(
+                "torch plugin: %r returns %s — only single-tensor-output "
+                "functions can be wrapped as torch_op"
+                % (self._fn_name, type(outs).__name__))
         return in_shape, [list(outs.shape)], []
 
     def create_operator(self, ctx, in_shapes, in_dtypes=None):
