@@ -15,7 +15,9 @@ The parent process measures each variant in a FRESH subprocess (the axon
 TPU tunnel can wedge; a wedged child is killed and retried — round-2/3
 lost their bench numbers to exactly this) and reports the best success.
 
-Prints exactly one JSON line:
+Prints a best-so-far result JSON line after every successful
+measurement (the driver reads the LAST line, so a mid-run kill still
+lands a number):
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
 """
 import json
@@ -122,17 +124,33 @@ def _measure(variant):
     print(json.dumps({"error": "%s: all batch sizes OOM" % variant}))
 
 
+def _report(results):
+    best = max(results.values(), key=lambda r: r["img_s"])
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": best["img_s"],
+        "unit": "img/s",
+        "vs_baseline": round(best["img_s"] / BASELINE_IMG_S, 3),
+        "variant": best["variant"],
+        "all": {k: v["img_s"] for k, v in results.items()},
+    }))
+    sys.stdout.flush()
+
+
 def main():
     deadline = time.time() + PARENT_BUDGET
     results = {}
     errors = []
-    # fused is the headline; unfused is the safety net. Two tries each —
-    # a wedged tunnel sometimes recovers between attempts.
-    for variant in ("fused", "unfused", "fused", "unfused"):
+    # unfused first (the known-compiling banker), then the fused
+    # headline; two tries each — a wedged tunnel sometimes recovers.
+    # A best-so-far line prints after EVERY success: the driver reads
+    # the LAST json line, so even if it kills this process mid-attempt
+    # the round still lands a number.
+    for variant in ("unfused", "fused", "unfused", "fused"):
         if variant in results:
             continue
         if time.time() > deadline - 60:
-            break
+            break  # per-success reports already printed the best
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
@@ -154,6 +172,7 @@ def main():
                     line = parsed
             if line and "img_s" in line:
                 results[variant] = line
+                _report(results)
             else:
                 stderr_tail = (proc.stderr or "").strip()[-300:]
                 errors.append((line or {}).get(
@@ -162,17 +181,7 @@ def main():
                 time.sleep(30)  # give a flaky tunnel a moment
         except subprocess.TimeoutExpired:
             errors.append("%s: child timeout" % variant)
-    if results:
-        best = max(results.values(), key=lambda r: r["img_s"])
-        print(json.dumps({
-            "metric": "resnet50_imagenet_train_throughput",
-            "value": best["img_s"],
-            "unit": "img/s",
-            "vs_baseline": round(best["img_s"] / BASELINE_IMG_S, 3),
-            "variant": best["variant"],
-            "all": {k: v["img_s"] for k, v in results.items()},
-        }))
-    else:
+    if not results:
         print(json.dumps({
             "metric": "resnet50_imagenet_train_throughput",
             "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
