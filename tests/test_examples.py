@@ -108,6 +108,7 @@ def test_autoencoder():
     ("recommenders/matrix_fact.py", "MATRIX_FACT_OK"),
     ("adversary/fgsm.py", "FGSM_OK"),
     ("dec/dec.py", "DEC_OK"),
+    ("bayesian-methods/sgld_logistic.py", "SGLD_OK"),
 ])
 def test_example_domain(script, marker):
     """Round-4 domain families (ref example/<domain>): each script is
