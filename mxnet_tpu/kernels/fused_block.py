@@ -37,11 +37,15 @@ flash_attention.py).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
 
 
 def _need_interpret(interpret):
@@ -591,25 +595,39 @@ def _finalize_stats(stats, count, eps):
 
 
 def _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
-              stride, eps, interpret):
+              stride, eps, interpret, axis=None, axis_size=1):
     """Training forward. Weights HWIO; data NHWC. Returns out, batch
-    stats (mean/var per BN), and the VJP residuals."""
+    stats (mean/var per BN), and the VJP residuals.
+
+    ``axis``: when run inside ``shard_map`` with the batch sharded over
+    mesh axes ``axis``, the BN statistic sums are psum'd over it (global
+    batch statistics — the same semantics the unfused pjit graph gets
+    from XLA partitioning its batch reductions) and counts are scaled by
+    the static ``axis_size``.
+    """
     n, h, wd, _ci = data.shape
-    n1 = n * h * wd
+    n1 = n * h * wd * axis_size
     xf = data.astype(jnp.float32)
     s0 = jnp.sum(xf, axis=(0, 1, 2))
     s1 = jnp.sum(xf * xf, axis=(0, 1, 2))
-    mean1, var1, inv1 = _finalize_stats(jnp.stack([s0, s1]), n1, eps)
+    s01 = jnp.stack([s0, s1])
+    if axis is not None:
+        s01 = jax.lax.psum(s01, axis)
+    mean1, var1, inv1 = _finalize_stats(s01, n1, eps)
     sc1, bi1 = _bn_consts(g1, b1, mean1, inv1)
 
     y1, st1 = conv_fwd(data, w1, stride=1, prologue=(sc1, bi1, True),
                        emit_stats=True, interpret=interpret)
+    if axis is not None:
+        st1 = jax.lax.psum(st1, axis)
     mean2, var2, inv2 = _finalize_stats(st1, n1, eps)
     sc2, bi2 = _bn_consts(g2, b2, mean2, inv2)
 
     y2, st2 = conv_fwd(y1, w2, stride=stride, prologue=(sc2, bi2, True),
                        emit_stats=True, interpret=interpret)
-    n2 = n * (h // stride) * (wd // stride)
+    if axis is not None:
+        st2 = jax.lax.psum(st2, axis)
+    n2 = n * (h // stride) * (wd // stride) * axis_size
     mean3, var3, inv3 = _finalize_stats(st2, n2, eps)
     sc3, bi3 = _bn_consts(g3, b3, mean3, inv3)
 
@@ -627,12 +645,16 @@ def _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
     return out, stats, res
 
 
-def _unit_bwd(stride, eps, interpret, res, g):
+def _unit_bwd(stride, eps, interpret, res, g, axis=None, axis_size=1):
     (data, y1, y2, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
      mean1, inv1, mean2, inv2, mean3, inv3) = res
     n, h, wd, _ci = data.shape
-    n1 = float(n * h * wd)
-    n2 = float(n * (h // stride) * (wd // stride))
+    n1 = float(n * h * wd * axis_size)
+    n2 = float(n * (h // stride) * (wd // stride) * axis_size)
+
+    def _allreduce(v):
+        return v if axis is None else jax.lax.psum(v, axis)
+
     sc1, bi1 = _bn_consts(g1, b1, mean1, inv1)
     sc2, bi2 = _bn_consts(g2, b2, mean2, inv2)
     sc3, bi3 = _bn_consts(g3, b3, mean3, inv3)
@@ -641,6 +663,7 @@ def _unit_bwd(stride, eps, interpret, res, g):
     e2, st3 = conv_dgrad(g, w3, y2.shape, stride=1,
                          out_mask=(y2, g3, b3, mean3, inv3),
                          interpret=interpret)
+    st3 = _allreduce(st3)
     dbeta3, dgamma3 = st3[0], st3[1]
     dw3 = conv_wgrad(y2, g, w3.shape, stride=1,
                      x_prologue=(sc3, bi3, True), interpret=interpret)
@@ -654,6 +677,7 @@ def _unit_bwd(stride, eps, interpret, res, g):
     e1, st2 = conv_dgrad((e2, y2), w2, y1.shape, stride=stride, g_bnbwd=cb2,
                          out_mask=(y1, g2, b2, mean2, inv2),
                          interpret=interpret)
+    st2 = _allreduce(st2)
     dbeta2, dgamma2 = st2[0], st2[1]
     cb1 = (g2.astype(jnp.float32) * inv2, mean2, inv2,
            dbeta2 / n1, dgamma2 / n1)
@@ -666,13 +690,19 @@ def _unit_bwd(stride, eps, interpret, res, g):
     e0, st1 = conv_dgrad((e1, y1), w1, data.shape, stride=1, g_bnbwd=cb1,
                          out_mask=(data, g1, b1, mean1, inv1), extra=extra,
                          interpret=interpret)
+    st1 = _allreduce(st1)
     dbeta1, dgamma1 = st1[0], st1[1]
 
+    # weight grads: each shard holds its batch slice's contribution;
+    # under shard_map the all-reduce happens here (f32, pre-cast) so the
+    # replicated out_specs of the spmd wrapper are genuinely replicated
+    dw1, dw2, dw3 = _allreduce(dw1), _allreduce(dw2), _allreduce(dw3)
     dwsc = None
     if wsc is not None:
-        dwsc = conv_wgrad(data, g, wsc.shape, stride=stride,
-                          x_prologue=(sc1, bi1, True),
-                          interpret=interpret).astype(wsc.dtype)
+        dwsc = _allreduce(conv_wgrad(
+            data, g, wsc.shape, stride=stride,
+            x_prologue=(sc1, bi1, True),
+            interpret=interpret)).astype(wsc.dtype)
 
     # bn1 backward to the unit input (elementwise; XLA fuses it with the
     # dim-match shortcut add)
@@ -739,3 +769,127 @@ def bottleneck_infer(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
         shortcut, _ = conv_fwd(data, wsc, stride=stride, prologue=p1,
                                interpret=interpret)
     return y3 + shortcut
+
+
+# ---------------------------------------------------------------------------
+# multi-chip: explicit shard_map partitioning of the Pallas kernels
+# ---------------------------------------------------------------------------
+# pjit can freely partition the *interpret-mode* fused graph (it is plain
+# jax ops), but real Mosaic kernels are opaque to the partitioner: on TPU
+# the batch-sharded fused step must place each kernel inside shard_map
+# with the batch axis manual. The wrappers below do that with an explicit
+# custom VJP — fwd and bwd are each their own shard_map region, and every
+# cross-shard reduction (BN statistic sums, weight grads) is an explicit
+# psum over the data axes, so ``check_rep=False`` is sound. Reference
+# counterpart of the reduction this replaces: src/kvstore/comm.h:484-690
+# (device-tree gradient reduce); here it rides ICI inside the step.
+
+_SPMD_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def spmd_scope(mesh, axes):
+    """Trace-time marker: fused ops built inside this scope partition
+    their Pallas kernels over ``mesh`` with the batch sharded on mesh
+    axes ``axes`` (via shard_map). Set by TrainStep around its step
+    invocation; consulted by ops/fused.py at trace time."""
+    prev = getattr(_SPMD_SCOPE, "value", None)
+    _SPMD_SCOPE.value = (mesh, tuple(axes))
+    try:
+        yield
+    finally:
+        _SPMD_SCOPE.value = prev
+
+
+def current_spmd_scope():
+    return getattr(_SPMD_SCOPE, "value", None)
+
+
+def _spmd_parts(mesh, axes):
+    ax = tuple(axes)
+    asize = int(_np.prod([mesh.shape[a] for a in ax]))
+    dspec = P(ax if len(ax) > 1 else ax[0], None, None, None)
+    return ax, asize, dspec
+
+
+_RES_NSHARDED = 3   # res = (data, y1, y2, then 16 replicated leaves)
+_RES_NREP = 16
+
+
+def _res_specs(dspec):
+    return (dspec,) * _RES_NSHARDED + (P(),) * _RES_NREP
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13, 14, 15))
+def bottleneck_train_spmd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                          stride, eps, interpret, mesh, axes):
+    """``bottleneck_train`` with the batch sharded over mesh ``axes``.
+
+    Same math and return convention as :func:`bottleneck_train` with
+    global-batch BN statistics (matching what XLA's partitioner gives
+    the unfused graph); out is sharded like data, stats/weight grads
+    are replicated.
+    """
+    (out, stats), _ = _spmd_train_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2,
+                                      g3, b3, stride, eps, interpret, mesh,
+                                      axes)
+    return out, stats
+
+
+def _spmd_train_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                    stride, eps, interpret, mesh, axes):
+    ax, asize, dspec = _spmd_parts(mesh, axes)
+    rep = P()
+
+    def local(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3):
+        return _unit_fwd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                         stride, eps, interpret, axis=ax, axis_size=asize)
+
+    f = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(dspec,) + (rep,) * 10,
+        out_specs=(dspec, (rep,) * 6, _res_specs(dspec)),
+        check_vma=False)
+    out, stats, res = f(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3)
+    return (out, stats), res
+
+
+def _spmd_train_bwd(stride, eps, interpret, mesh, axes, res, cotangents):
+    g, _gstats = cotangents
+    ax, asize, dspec = _spmd_parts(mesh, axes)
+    rep = P()
+
+    def local(res, g):
+        return _unit_bwd(stride, eps, interpret, res, g,
+                         axis=ax, axis_size=asize)
+
+    f = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(_res_specs(dspec), dspec),
+        out_specs=(dspec,) + (rep,) * 10,
+        check_vma=False)
+    return f(res, g)
+
+
+bottleneck_train_spmd.defvjp(_spmd_train_fwd, _spmd_train_bwd)
+
+
+def bottleneck_infer_spmd(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+                          mm1, mv1, mm2, mv2, mm3, mv3, *, stride, eps,
+                          mesh, axes, interpret=None):
+    """``bottleneck_infer`` with the batch sharded over mesh ``axes``.
+
+    Inference uses the moving statistics, so the computation is purely
+    per-sample: a plain forward shard_map with no collectives."""
+    _ax, _asize, dspec = _spmd_parts(mesh, axes)
+    rep = P()
+
+    def local(*args):
+        return bottleneck_infer(*args, stride=stride, eps=eps,
+                                interpret=interpret)
+
+    f = jax.shard_map(local, mesh=mesh,
+                      in_specs=(dspec,) + (rep,) * 16,
+                      out_specs=dspec, check_vma=False)
+    return f(data, w1, w2, w3, wsc, g1, b1, g2, b2, g3, b3,
+             mm1, mv1, mm2, mv2, mm3, mv3)

@@ -74,19 +74,38 @@ def fused_bottleneck_unit(
     wsc = None if sc_weight is None else sc_weight.transpose(2, 3, 1, 0)
     moving = (bn1_moving_mean, bn1_moving_var, bn2_moving_mean,
               bn2_moving_var, bn3_moving_mean, bn3_moving_var)
+    # Under a TrainStep mesh the Pallas kernels must be partitioned
+    # explicitly (shard_map over the data axes) — Mosaic kernels are
+    # opaque to pjit's partitioner on real TPU (fused_block.py spmd
+    # wrappers; set by parallel/spmd.py at trace time).
+    scope = fb.current_spmd_scope()
     if __is_train__:
-        out, stats = fb.bottleneck_train(
-            data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma, bn2_beta,
-            bn3_gamma, bn3_beta, s, float(eps), None)
+        if scope is not None:
+            mesh, axes = scope
+            out, stats = fb.bottleneck_train_spmd(
+                data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma,
+                bn2_beta, bn3_gamma, bn3_beta, s, float(eps), None,
+                mesh, axes)
+        else:
+            out, stats = fb.bottleneck_train(
+                data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma,
+                bn2_beta, bn3_gamma, bn3_beta, s, float(eps), None)
         m = float(momentum)
         new = tuple(
             (m * old.astype(jnp.float32)
              + (1.0 - m) * jax.lax.stop_gradient(st)).astype(old.dtype)
             for old, st in zip(moving, stats))
         return (out,) + new
-    out = fb.bottleneck_infer(
-        data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma, bn2_beta,
-        bn3_gamma, bn3_beta, *moving, stride=s, eps=float(eps))
+    if scope is not None:
+        mesh, axes = scope
+        out = fb.bottleneck_infer_spmd(
+            data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma, bn2_beta,
+            bn3_gamma, bn3_beta, *moving, stride=s, eps=float(eps),
+            mesh=mesh, axes=axes)
+    else:
+        out = fb.bottleneck_infer(
+            data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma, bn2_beta,
+            bn3_gamma, bn3_beta, *moving, stride=s, eps=float(eps))
     return (out,) + moving
 
 

@@ -29,6 +29,8 @@ it is the engine under ``kvstore='tpu'`` Module training, ``bench.py`` and
 """
 from __future__ import annotations
 
+import contextlib
+import functools
 import re
 
 import jax
@@ -303,8 +305,6 @@ class TrainStep:
         non-default devices — e.g. the 8-CPU-device dryrun mesh while the
         default platform is a TPU — never touches the default device.
         """
-        import contextlib
-
         from ..initializer import Uniform, InitDesc
 
         shape_kwargs = dict(data_shapes)
@@ -321,6 +321,13 @@ class TrainStep:
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         np_state = _np.random.get_state()
         _np.random.seed(seed)
+        # the initializer zoo draws from the module-owned RNG
+        # (random.initializer_rng), not the global numpy one — seed it
+        # too, else same-seed init_params differs across processes
+        from .. import random as _rnd_mod
+
+        prev_init_rng = _rnd_mod._INIT_RNG
+        _rnd_mod._INIT_RNG = _np.random.RandomState(int(seed) & 0x7FFFFFFF)
         try:
             with ctx:
                 for name, shape in zip(arg_names, arg_shapes):
@@ -337,6 +344,7 @@ class TrainStep:
                 opt_state = self.optimizer.init(params)
         finally:
             _np.random.set_state(np_state)
+            _rnd_mod._INIT_RNG = prev_init_rng
         return params, opt_state, aux
 
     # -- sharding ------------------------------------------------------------
@@ -437,7 +445,7 @@ class TrainStep:
 
         mesh = self.mesh
         if mesh is None:
-            return jax.jit(step, donate_argnums=(0,))
+            return self._bind_fused_scope(jax.jit(step, donate_argnums=(0,)))
 
         ps, opt_s, aux_s = self.shardings(params, opt_state, aux, param_rules)
         rep = replicated(mesh)
@@ -452,12 +460,12 @@ class TrainStep:
             out_s = (carry_s, (rep, out_sh))
         else:
             out_s = (carry_s, rep)
-        return jax.jit(
+        return self._bind_fused_scope(jax.jit(
             step,
             in_shardings=(carry_s, batch_s, rep),
             out_shardings=out_s,
             donate_argnums=(0,),
-        )
+        ))
 
     def compile(self, params, opt_state, aux, param_rules=None):
         if param_rules is not None:
@@ -492,3 +500,27 @@ class TrainStep:
             key = _rnd.next_key()
         fn = self.compile(*carry[:3])
         return fn(carry, batch, key)
+
+    def _bind_fused_scope(self, fn):
+        """Bind the trace-time SPMD scope for Pallas-fused ops to the
+        compiled step: on a mesh, the FusedBottleneckUnit op shard_maps
+        its kernels over the data axes (Mosaic kernels are opaque to
+        pjit's partitioner on real TPU). The scope wraps every call of
+        the returned fn — tracing is lazy, so it must be active at the
+        first invocation no matter whether the caller went through
+        __call__ or a raw compile()."""
+        if self.mesh is None:
+            return fn
+        axes = tuple(a for a in self.data_axes if a in self.mesh.axis_names)
+        if not axes:
+            return fn
+        from ..kernels import fused_block as _fb
+
+        mesh = self.mesh
+
+        @functools.wraps(fn)
+        def scoped(*args, **kwargs):
+            with _fb.spmd_scope(mesh, axes):
+                return fn(*args, **kwargs)
+
+        return scoped

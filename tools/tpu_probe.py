@@ -1,0 +1,91 @@
+"""TPU tunnel health prober (VERDICT r4 item 1).
+
+Polls TPU backend availability in a fresh subprocess (so a wedged
+libtpu/tunnel cannot wedge the prober itself) and appends one JSON line
+per probe to ``TPU_HEALTH.jsonl``:
+
+    {"t": "<iso8601>", "ok": true, "init_s": 12.3}
+    {"t": "<iso8601>", "ok": false, "err": "timeout>120s"}
+
+Usage:
+    python tools/tpu_probe.py            # single probe, exit 0 iff healthy
+    python tools/tpu_probe.py --loop 600 # probe every 600s forever
+    python tools/tpu_probe.py --wait 7200  # block until healthy (or give up)
+
+The point: three rounds of BENCH_r0N.json errored on a wedged tunnel
+because nothing in-tree even *polled* for a healthy window. Anything
+that needs the chip (bench, kernel smoke) can consult the log or use
+--wait to fire at the first healthy moment.
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "TPU_HEALTH.jsonl")
+
+_CHILD = r"""
+import time, json, sys
+t0 = time.time()
+import jax
+devs = jax.devices()
+ok = any(d.platform == "tpu" for d in devs)
+print(json.dumps({"ok": ok, "init_s": round(time.time() - t0, 1),
+                  "devices": [str(d) for d in devs]}))
+"""
+
+
+def probe_once(timeout=150):
+    """One fresh-subprocess probe. Returns the record dict (also logged)."""
+    t = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    try:
+        r = subprocess.run([sys.executable, "-c", _CHILD], timeout=timeout,
+                           capture_output=True, text=True)
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        rec = {"t": t, **json.loads(line)}
+    except subprocess.TimeoutExpired:
+        rec = {"t": t, "ok": False, "err": "timeout>%ds" % timeout}
+    except Exception as e:  # json decode, crash, ...
+        rec = {"t": t, "ok": False, "err": repr(e)[:200]}
+    rec.pop("devices", None)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--loop", type=int, metavar="SECS",
+                    help="probe every SECS seconds forever")
+    ap.add_argument("--wait", type=int, metavar="SECS",
+                    help="probe until healthy or SECS elapsed")
+    ap.add_argument("--timeout", type=int, default=150,
+                    help="per-probe init timeout (s)")
+    args = ap.parse_args()
+
+    if args.loop:
+        while True:
+            rec = probe_once(args.timeout)
+            print(json.dumps(rec), flush=True)
+            time.sleep(args.loop)
+    if args.wait:
+        deadline = time.time() + args.wait
+        while time.time() < deadline:
+            rec = probe_once(args.timeout)
+            print(json.dumps(rec), flush=True)
+            if rec.get("ok"):
+                return 0
+            time.sleep(60)
+        return 1
+    rec = probe_once(args.timeout)
+    print(json.dumps(rec))
+    return 0 if rec.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

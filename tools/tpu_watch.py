@@ -1,0 +1,156 @@
+"""Fire-at-first-healthy-window TPU evidence pipeline (VERDICT r4 #1).
+
+The axon TPU tunnel wedges for long stretches; rounds 2-4 lost their
+bench numbers to it. This watcher runs in the background all round:
+
+  loop:
+    probe tunnel health (fresh subprocess, tools/tpu_probe.py)
+    if healthy: run the next incomplete evidence stage, checkpointing
+    sleep
+
+Stages (in order; each checkpointed in TPU_EVIDENCE/state.json so a
+brief window still lands something):
+
+  smoke_quick   Mosaic-compile every fused kernel, small shapes
+  bench_unfused one bench.py worker measurement, unfused graph
+  smoke_full    kernel smoke at ResNet-50 stage shapes
+  bench_fused   one bench.py worker measurement, fused graph
+
+All stdout/stderr lands in TPU_EVIDENCE/<stage>.log (timestamped).
+A stage that fails for a non-tunnel reason (e.g. Mosaic rejects a
+kernel) is recorded as "failed" with the error tail and NOT retried —
+the log is the diagnostic; fix the kernel, delete the state entry,
+and the watcher picks it up again.
+
+Usage:  python tools/tpu_watch.py [--interval 300] [--once]
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVID = os.path.join(ROOT, "TPU_EVIDENCE")
+STATE = os.path.join(EVID, "state.json")
+
+STAGES = [
+    ("smoke_quick",
+     [sys.executable, "tools/tpu_kernel_smoke.py", "--quick"], 1500),
+    ("bench_unfused",
+     [sys.executable, "bench.py", "--worker", "unfused"], 1500),
+    ("smoke_full",
+     [sys.executable, "tools/tpu_kernel_smoke.py"], 2400),
+    ("bench_fused",
+     [sys.executable, "bench.py", "--worker", "fused"], 2400),
+]
+
+
+def _now():
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _load_state():
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _save_state(st):
+    os.makedirs(EVID, exist_ok=True)
+    with open(STATE, "w") as f:
+        json.dump(st, f, indent=1)
+
+
+def _probe():
+    try:
+        r = subprocess.run(
+            [sys.executable, "tools/tpu_probe.py", "--timeout", "120"],
+            cwd=ROOT, timeout=150, capture_output=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def _tunnel_error(tail):
+    return ("tunnel unreachable" in tail or "DEADLINE_EXCEEDED" in tail
+            or "failed to connect" in tail.lower()
+            or "UNAVAILABLE" in tail)
+
+
+def run_stage(name, cmd, timeout):
+    os.makedirs(EVID, exist_ok=True)
+    log = os.path.join(EVID, name + ".log")
+    t0 = time.time()
+    with open(log, "a") as f:
+        f.write("\n===== attempt %s =====\n" % _now())
+        f.flush()
+        try:
+            r = subprocess.run(cmd, cwd=ROOT, stdout=f, stderr=f,
+                               timeout=timeout)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            f.write("WATCHDOG: stage timeout after %ds\n" % timeout)
+            rc = -9
+    with open(log) as f:
+        f.seek(max(0, os.path.getsize(log) - 2000))
+        tail = f.read()
+    return rc, round(time.time() - t0, 1), tail
+
+
+def step(st):
+    """Run the next incomplete stage. Returns True if all stages done."""
+    for name, cmd, timeout in STAGES:
+        cur = st.get(name, {})
+        if cur.get("status") in ("done", "failed"):
+            continue
+        print("[%s] running stage %s" % (_now(), name), flush=True)
+        rc, dt, tail = run_stage(name, cmd, timeout)
+        if rc == 0:
+            st[name] = {"status": "done", "t": _now(), "secs": dt}
+        elif rc == -9 or _tunnel_error(tail):
+            st[name] = {"status": "retry", "t": _now(),
+                        "attempts": cur.get("attempts", 0) + 1}
+        else:
+            st[name] = {"status": "failed", "t": _now(), "rc": rc,
+                        "tail": tail[-800:]}
+        _save_state(st)
+        return False  # one stage per healthy probe; re-probe between
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=300)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe(+stage) cycle, then exit: 0 if the "
+                         "stage ran or everything is terminal, 1 if the "
+                         "tunnel was unhealthy")
+    args = ap.parse_args()
+    while True:
+        st = _load_state()
+        if all(st.get(n, {}).get("status") in ("done", "failed")
+               for n, _, _ in STAGES):
+            print("[%s] all stages terminal: %s" % (_now(), json.dumps(
+                {n: st[n]["status"] for n, _, _ in STAGES})), flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval * 4)
+            continue
+        healthy = _probe()
+        if healthy:
+            step(_load_state())
+        else:
+            print("[%s] tunnel unhealthy" % _now(), flush=True)
+        if args.once:
+            return 0 if healthy else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
