@@ -1,0 +1,98 @@
+#' Internal plumbing: load libmxtpu_c_api.so and call its .C-convention
+#' R shim tier (src/c_api_r.cc).
+#'
+#' Reference counterpart: R-package/src Rcpp glue — redesigned here as a
+#' pure-R binding so no compilation happens at install time: handles are
+#' 8-byte raw vectors, numeric data crosses as double (the shim casts to
+#' float32), and string results arrive in preallocated buffers.
+
+.MXNetEnv <- new.env()
+
+mx.internal.lib.path <- function() {
+  p <- Sys.getenv("MXTPU_CAPI_LIB", "")
+  if (nzchar(p)) return(p)
+  # common layouts: repo checkout (env MXTPU_ROOT) or alongside package
+  root <- Sys.getenv("MXTPU_ROOT", "")
+  if (nzchar(root)) {
+    cand <- file.path(root, "mxnet_tpu", "lib", "libmxtpu_c_api.so")
+    if (file.exists(cand)) return(cand)
+  }
+  stop(paste("cannot locate libmxtpu_c_api.so;",
+             "set MXTPU_CAPI_LIB or MXTPU_ROOT"))
+}
+
+mx.internal.load <- function() {
+  if (!is.null(.MXNetEnv$dll)) return(invisible(NULL))
+  .MXNetEnv$dll <- dyn.load(mx.internal.lib.path(), local = FALSE)
+  invisible(NULL)
+}
+
+mx.internal.last.error <- function() {
+  buf <- paste(rep(" ", 4096), collapse = "")
+  r <- .C("MXRGetLastError", out = buf, len = as.integer(4096),
+          rc = as.integer(0))
+  trimws(r$out)
+}
+
+#' Call a shim function; stop() with the backend message on failure.
+#' Every shim function's last argument is rc (int, 0 = ok). NAOK: NaN/Inf
+#' are legitimate tensor values and must round-trip (reference parity).
+mx.internal.C <- function(fname, ...) {
+  mx.internal.load()
+  res <- .C(fname, ..., rc = as.integer(0), NAOK = TRUE)
+  if (res$rc != 0) {
+    stop(sprintf("%s: %s", fname, mx.internal.last.error()))
+  }
+  res
+}
+
+mx.internal.new.handle <- function() raw(8)
+
+mx.internal.null.handle <- function(h) all(h == as.raw(0))
+
+#' Pack a list of handles (raw(8) each) into one raw vector.
+mx.internal.pack.handles <- function(handles) {
+  if (length(handles) == 0) return(raw(0))
+  do.call(c, handles)
+}
+
+mx.internal.unpack.handles <- function(buf, n) {
+  lapply(seq_len(n), function(i) buf[(8 * (i - 1) + 1):(8 * i)])
+}
+
+#' A blank string buffer for shim string returns.
+mx.internal.strbuf <- function(n = 65536) paste(rep(" ", n), collapse = "")
+
+mx.internal.split.lines <- function(s) {
+  s <- trimws(s, which = "right")
+  if (!nzchar(s)) return(character(0))
+  strsplit(s, "\n", fixed = TRUE)[[1]]
+}
+
+#' Framework version (MXGetVersion through the shim).
+#' @export
+mx.version <- function() {
+  r <- mx.internal.C("MXRGetVersion", out = as.integer(0))
+  r$out
+}
+
+#' Seed the framework RNG (reference parity: mx.set.seed).
+#' @export
+mx.set.seed <- function(seed) {
+  invisible(mx.internal.C("MXRRandomSeed", seed = as.integer(seed)))
+}
+
+#' Block until all pending device work completes.
+#' @export
+mx.nd.waitall <- function() {
+  invisible(mx.internal.C("MXRNDArrayWaitAll"))
+}
+
+#' All registered operator names.
+#' @export
+mx.internal.op.names <- function() {
+  buf <- mx.internal.strbuf()
+  r <- mx.internal.C("MXRListAllOpNames", buf = buf,
+                     len = as.integer(nchar(buf)))
+  mx.internal.split.lines(r$buf)
+}

@@ -1,0 +1,31 @@
+#' Device contexts (reference parity: R-package/R/context.R).
+#'
+#' On the TPU-native stack both mx.cpu() and mx.gpu() resolve to the
+#' framework's device table — mx.gpu maps to the TPU tier the same way
+#' the python frontend's mx.gpu does (mxnet_tpu/context.py).
+
+mx.internal.ctx <- function(dev_type, dev_id) {
+  structure(list(device = dev_type, device_id = dev_id,
+                 device_typeid = if (dev_type == "cpu") 1L else 2L),
+            class = "MXContext")
+}
+
+#' @export
+mx.cpu <- function(dev.id = 0) mx.internal.ctx("cpu", as.integer(dev.id))
+
+#' @export
+mx.gpu <- function(dev.id = 0) mx.internal.ctx("gpu", as.integer(dev.id))
+
+#' @export
+mx.tpu <- function(dev.id = 0) mx.internal.ctx("gpu", as.integer(dev.id))
+
+#' @export
+is.mx.context <- function(x) inherits(x, "MXContext")
+
+#' Default context (settable, reference parity: mx.ctx.default).
+#' @export
+mx.ctx.default <- function(new = NULL) {
+  if (!is.null(new)) .MXNetEnv$ctx <- new
+  if (is.null(.MXNetEnv$ctx)) .MXNetEnv$ctx <- mx.cpu()
+  .MXNetEnv$ctx
+}

@@ -1,0 +1,103 @@
+#' Executor: bind a Symbol and run it (reference parity:
+#' R-package/R/executor.R over MXExecutorSimpleBind).
+
+#' Bind a symbol with named input shapes; the framework allocates
+#' argument, gradient and auxiliary arrays.
+#'
+#' @param symbol the network
+#' @param ctx device context
+#' @param grad.req "write", "add" or "null"
+#' @param ... named R-convention shapes (data = c(784, 64), ...)
+#' @return an MXExecutor: arg.arrays / grad.arrays / aux.arrays are
+#'   named lists of NDArrays (names follow mx.symbol.arguments order)
+#' @export
+mx.simple.bind <- function(symbol, ctx = NULL, grad.req = "write", ...) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  provided <- list(...)
+  keys <- names(provided)
+  cshapes <- lapply(provided, function(s) rev(as.integer(s)))
+  ind <- c(0L, cumsum(vapply(cshapes, length, 1L)))
+  flat <- as.integer(unlist(cshapes))
+  if (length(flat) == 0) flat <- integer(0)
+  arg_cap <- 4096L
+  aux_cap <- 4096L
+  r <- mx.internal.C("MXRExecutorSimpleBind", sym = symbol$handle,
+                     dev_type = ctx$device_typeid, dev_id = ctx$device_id,
+                     n_provided = length(provided), keys = keys,
+                     ind_ptr = ind, shape_data = flat,
+                     grad_req = grad.req,
+                     arg_cap = arg_cap, in_args = raw(8 * arg_cap),
+                     arg_grads = raw(8 * arg_cap), n_args = as.integer(0),
+                     aux_cap = aux_cap, aux_states = raw(8 * aux_cap),
+                     n_aux = as.integer(0),
+                     out = mx.internal.new.handle())
+  exec <- new.env(parent = emptyenv())
+  exec$handle <- r$out
+  arg_names <- mx.symbol.arguments(symbol)
+  aux_names <- mx.symbol.auxiliary.states(symbol)
+  wrap_all <- function(buf, n, nms) {
+    hs <- mx.internal.unpack.handles(buf, n)
+    out <- vector("list", n)   # out[i] <- list(NULL) keeps the slot;
+    for (i in seq_len(n)) {    # out[[i]] <- NULL would delete it
+      if (!mx.internal.null.handle(hs[[i]])) {
+        out[[i]] <- mx.internal.nd.wrap(hs[[i]])
+      }
+    }
+    names(out) <- nms[seq_len(n)]
+    out
+  }
+  exec$arg.arrays <- wrap_all(r$in_args, r$n_args, arg_names)
+  exec$grad.arrays <- wrap_all(r$arg_grads, r$n_args, arg_names)
+  exec$aux.arrays <- wrap_all(r$aux_states, r$n_aux, aux_names)
+  exec$symbol <- symbol
+  class(exec) <- "MXExecutor"
+  reg.finalizer(exec, function(e) {
+    if (!is.null(e$handle) && !mx.internal.null.handle(e$handle)) {
+      tryCatch(.C("MXRExecutorFree", exec = e$handle, rc = as.integer(0)),
+               error = function(err) NULL)
+      e$handle <- NULL
+    }
+  })
+  exec
+}
+
+#' Run the forward pass.
+#' @export
+mx.exec.forward <- function(exec, is.train = TRUE) {
+  mx.internal.C("MXRExecutorForward", exec = exec$handle,
+                is_train = as.integer(is.train))
+  invisible(exec)
+}
+
+#' Run the backward pass (loss heads supply their own head grads,
+#' reference parity: Executor::Backward with ones).
+#' @export
+mx.exec.backward <- function(exec) {
+  mx.internal.C("MXRExecutorBackward", exec = exec$handle)
+  invisible(exec)
+}
+
+#' Fetch output NDArrays.
+#' @export
+mx.exec.outputs <- function(exec) {
+  cap <- 64L
+  r <- mx.internal.C("MXRExecutorOutputs", exec = exec$handle, cap = cap,
+                     out_handles = raw(8 * cap), n = as.integer(0))
+  out <- lapply(mx.internal.unpack.handles(r$out_handles, r$n),
+                mx.internal.nd.wrap)
+  names(out) <- mx.symbol.outputs(exec$symbol)[seq_len(r$n)]
+  out
+}
+
+#' Copy host values into bound argument arrays by name.
+#' @export
+mx.exec.update.arg.arrays <- function(exec, arg.arrays) {
+  for (nm in names(arg.arrays)) {
+    dst <- exec$arg.arrays[[nm]]
+    if (is.null(dst)) next
+    v <- arg.arrays[[nm]]
+    if (is.mx.ndarray(v)) v <- as.array(v)
+    mx.nd.internal.copyfrom(dst, v)
+  }
+  invisible(exec)
+}

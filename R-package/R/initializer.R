@@ -1,0 +1,70 @@
+#' Initializers (reference parity: R-package/R/initializer.R).
+
+#' Uniform initializer factory.
+#' @export
+mx.init.uniform <- function(scale = 0.01) {
+  function(name, shape) {
+    array(runif(prod(shape), -scale, scale), dim = shape)
+  }
+}
+
+#' Normal initializer factory.
+#' @export
+mx.init.normal <- function(sd = 0.01) {
+  function(name, shape) {
+    array(rnorm(prod(shape), 0, sd), dim = shape)
+  }
+}
+
+#' Xavier initializer factory (reference parity: mx.init.Xavier;
+#' fan computation mirrors initializer.py Xavier with R-reversed dims —
+#' the backend row-major shape is rev(shape)).
+#' @export
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape) {
+    cshape <- rev(shape)   # backend convention: (out, in, ...)
+    hw <- if (length(cshape) > 2) prod(cshape[3:length(cshape)]) else 1
+    fan_out <- cshape[1] * hw
+    fan_in <- if (length(cshape) > 1) cshape[2] * hw else fan_out
+    factor <- switch(factor_type, avg = (fan_in + fan_out) / 2,
+                     `in` = fan_in, out = fan_out)
+    scale <- sqrt(magnitude / factor)
+    if (rnd_type == "uniform") {
+      array(runif(prod(shape), -scale, scale), dim = shape)
+    } else {
+      array(rnorm(prod(shape), 0, scale), dim = shape)
+    }
+  }
+}
+
+#' Apply an initializer over inferred argument shapes. Bias/beta start
+#' at zero, gamma/moving variance at one (reference parity:
+#' mx.model.init.params).
+#' @export
+mx.internal.init.params <- function(symbol, input.shapes, initializer,
+                                    ctx = NULL) {
+  inferred <- do.call(mx.symbol.infer.shape, c(list(symbol), input.shapes))
+  if (is.null(inferred)) stop("shape inference incomplete")
+  arg_params <- list()
+  for (nm in names(inferred$arg.shapes)) {
+    if (nm %in% names(input.shapes)) next
+    shape <- inferred$arg.shapes[[nm]]
+    host <- if (grepl("(bias|beta)$", nm)) {
+      array(0, dim = shape)
+    } else if (grepl("gamma$", nm)) {
+      array(1, dim = shape)
+    } else {
+      initializer(nm, shape)
+    }
+    arg_params[[nm]] <- mx.nd.array(host, ctx)
+  }
+  aux_params <- list()
+  for (nm in names(inferred$aux.shapes)) {
+    shape <- inferred$aux.shapes[[nm]]
+    host <- if (grepl("var$", nm)) array(1, dim = shape)
+            else array(0, dim = shape)
+    aux_params[[nm]] <- mx.nd.array(host, ctx)
+  }
+  list(arg.params = arg_params, aux.params = aux_params)
+}

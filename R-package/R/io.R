@@ -1,0 +1,97 @@
+#' Data iterators over the C ABI DataIter surface (reference parity:
+#' R-package/R/io.R; the creators are the same registry python's
+#' mx.io uses, so MNISTIter/ImageRecordIter/CSVIter behave identically).
+
+mx.internal.iter.wrap <- function(handle) {
+  it <- new.env(parent = emptyenv())
+  it$handle <- handle
+  class(it) <- "MXDataIter"
+  reg.finalizer(it, function(e) {
+    if (!is.null(e$handle) && !mx.internal.null.handle(e$handle)) {
+      tryCatch(.C("MXRDataIterFree", iter = e$handle, rc = as.integer(0)),
+               error = function(err) NULL)
+      e$handle <- NULL
+    }
+  })
+  it
+}
+
+#' Names of the registered data iterators.
+#' @export
+mx.io.list.iters <- function() {
+  buf <- mx.internal.strbuf()
+  r <- mx.internal.C("MXRListDataIters", buf = buf,
+                     len = as.integer(nchar(buf)))
+  mx.internal.split.lines(r$buf)
+}
+
+#' Create a named iterator with string-typed kwargs.
+#' @export
+mx.io.internal.create <- function(name, ...) {
+  params <- list(...)
+  keys <- as.character(names(params))
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) (if (v) "True" else "False")
+    else if (is.numeric(v) && length(v) > 1)
+      paste0("(", paste(v, collapse = ","), ")")
+    else as.character(v)
+  }, "")
+  if (length(keys) == 0) { keys <- ""; vals <- "" }
+  r <- mx.internal.C("MXRDataIterCreate", name = name,
+                     n_kv = length(params), keys = keys, vals = vals,
+                     out = mx.internal.new.handle())
+  mx.internal.iter.wrap(r$out)
+}
+
+#' MNIST iterator (reference parity: mx.io.MNISTIter).
+#' @export
+mx.io.MNISTIter <- function(...) mx.io.internal.create("MNISTIter", ...)
+
+#' CSV iterator.
+#' @export
+mx.io.CSVIter <- function(...) mx.io.internal.create("CSVIter", ...)
+
+#' ImageRecord iterator.
+#' @export
+mx.io.ImageRecordIter <- function(...) {
+  mx.io.internal.create("ImageRecordIter", ...)
+}
+
+#' Advance; FALSE at end of epoch.
+#' @export
+mx.io.iter.next <- function(iter) {
+  r <- mx.internal.C("MXRDataIterNext", iter = iter$handle,
+                     out = as.integer(0))
+  r$out != 0
+}
+
+#' Rewind to the epoch start.
+#' @export
+mx.io.iter.reset <- function(iter) {
+  mx.internal.C("MXRDataIterBeforeFirst", iter = iter$handle)
+  invisible(iter)
+}
+
+#' Current batch data (NDArray).
+#' @export
+mx.io.iter.data <- function(iter) {
+  r <- mx.internal.C("MXRDataIterGetData", iter = iter$handle,
+                     out = mx.internal.new.handle())
+  mx.internal.nd.wrap(r$out)
+}
+
+#' Current batch label (NDArray).
+#' @export
+mx.io.iter.label <- function(iter) {
+  r <- mx.internal.C("MXRDataIterGetLabel", iter = iter$handle,
+                     out = mx.internal.new.handle())
+  mx.internal.nd.wrap(r$out)
+}
+
+#' Pad rows in the current (tail) batch.
+#' @export
+mx.io.iter.padnum <- function(iter) {
+  r <- mx.internal.C("MXRDataIterGetPadNum", iter = iter$handle,
+                     pad = as.integer(0))
+  r$pad
+}

@@ -1,0 +1,34 @@
+#' Evaluation metrics (reference parity: R-package/R/metric.R).
+
+mx.internal.metric <- function(name, init, update, get) {
+  structure(list(name = name, init = init, update = update, get = get),
+            class = "mx.metric")
+}
+
+#' Classification accuracy. Predictions follow the R layout:
+#' (classes, batch); labels are 0-based class ids.
+#' @export
+mx.metric.accuracy <- mx.internal.metric(
+  "accuracy",
+  init = function() c(0, 0),
+  update = function(label, pred, state) {
+    pa <- as.array(pred)
+    la <- as.array(label)
+    hit <- sum((max.col(t(pa)) - 1) == as.integer(la))
+    state + c(hit, length(la))
+  },
+  get = function(state) state[1] / max(state[2], 1)
+)
+
+#' Mean squared error.
+#' @export
+mx.metric.mse <- mx.internal.metric(
+  "mse",
+  init = function() c(0, 0),
+  update = function(label, pred, state) {
+    pa <- as.array(pred)
+    la <- as.array(label)
+    state + c(sum((pa - la)^2), length(la))
+  },
+  get = function(state) state[1] / max(state[2], 1)
+)

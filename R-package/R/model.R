@@ -1,0 +1,201 @@
+#' FeedForward model tier (reference parity: R-package/R/model.R
+#' mx.model.FeedForward.create / predict / save / load).
+#'
+#' The training loop drives the executor tier directly: simple-bind,
+#' copy params in, forward/backward per batch, sgd(-momentum) update via
+#' the imperative optimizer ops (src/operator/optimizer_op parity ops
+#' sgd_update / sgd_mom_update) — the same loop the reference model.R
+#' runs, minus the multi-device split (the TPU stack scales through the
+#' fused SPMD step on the python tier instead).
+
+mx.internal.train.batch <- function(exec, optim_state, trainable,
+                                    learning.rate, momentum, wd,
+                                    clip_gradient) {
+  mx.exec.forward(exec, is.train = TRUE)
+  mx.exec.backward(exec)
+  for (nm in trainable) {
+    w <- exec$arg.arrays[[nm]]
+    g <- exec$grad.arrays[[nm]]
+    if (is.null(w) || is.null(g)) next
+    params <- list(lr = learning.rate, wd = wd)
+    if (!is.null(clip_gradient)) params$clip_gradient <- clip_gradient
+    if (momentum > 0) {
+      params$momentum <- momentum
+      mx.nd.internal.invoke("sgd_mom_update",
+                            list(w, g, optim_state[[nm]]),
+                            params, out = list(w))
+    } else {
+      mx.nd.internal.invoke("sgd_update", list(w, g), params,
+                            out = list(w))
+    }
+  }
+  invisible(NULL)
+}
+
+#' Train a FeedForward model from a data iterator.
+#'
+#' @param symbol network with a loss head (e.g. SoftmaxOutput)
+#' @param X an MXDataIter
+#' @param ctx device context
+#' @param num.round epochs
+#' @param learning.rate,momentum,wd,clip_gradient SGD hyper-parameters
+#' @param initializer weight initializer factory (mx.init.*)
+#' @param eval.metric an mx.metric (train metric, printed per epoch)
+#' @param batch.end.callback function(epoch, nbatch, metric_value)
+#' @param verbose print per-epoch metric
+#' @return mx.model list(symbol, arg.params, aux.params)
+#' @export
+mx.model.FeedForward.create <- function(symbol, X, ctx = NULL,
+                                        num.round = 10,
+                                        learning.rate = 0.01,
+                                        momentum = 0, wd = 0,
+                                        clip_gradient = NULL,
+                                        initializer = mx.init.uniform(0.01),
+                                        eval.metric = mx.metric.accuracy,
+                                        batch.end.callback = NULL,
+                                        data.name = "data",
+                                        label.name = NULL,
+                                        verbose = TRUE) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  arg_names <- mx.symbol.arguments(symbol)
+  if (is.null(label.name)) {
+    label.name <- grep("label", arg_names, value = TRUE)[1]
+  }
+  mx.io.iter.reset(X)
+  stopifnot(mx.io.iter.next(X))
+  dshape <- dim(mx.io.iter.data(X))
+  lshape <- dim(mx.io.iter.label(X))
+  input.shapes <- list(dshape, lshape)
+  names(input.shapes) <- c(data.name, label.name)
+
+  init <- mx.internal.init.params(symbol, input.shapes, initializer, ctx)
+  bind_args <- c(list(symbol, ctx = ctx, grad.req = "write"), input.shapes)
+  exec <- do.call(mx.simple.bind, bind_args)
+  mx.exec.update.arg.arrays(exec, init$arg.params)
+  for (nm in names(init$aux.params)) {
+    if (!is.null(exec$aux.arrays[[nm]])) {
+      mx.nd.internal.copyfrom(exec$aux.arrays[[nm]],
+                              as.array(init$aux.params[[nm]]))
+    }
+  }
+  trainable <- setdiff(arg_names, c(data.name, label.name))
+  optim_state <- list()
+  for (nm in trainable) {
+    if (!is.null(exec$arg.arrays[[nm]])) {
+      optim_state[[nm]] <- mx.nd.zeros(dim(exec$arg.arrays[[nm]]), ctx)
+    }
+  }
+
+  for (epoch in seq_len(num.round)) {
+    mx.io.iter.reset(X)
+    state <- eval.metric$init()
+    nbatch <- 0
+    while (mx.io.iter.next(X)) {
+      mx.nd.internal.copyfrom(exec$arg.arrays[[data.name]],
+                              as.array(mx.io.iter.data(X)))
+      label <- mx.io.iter.label(X)
+      mx.nd.internal.copyfrom(exec$arg.arrays[[label.name]],
+                              as.array(label))
+      mx.internal.train.batch(exec, optim_state, trainable,
+                              learning.rate, momentum, wd, clip_gradient)
+      out <- mx.exec.outputs(exec)[[1]]
+      state <- eval.metric$update(label, out, state)
+      nbatch <- nbatch + 1
+      if (!is.null(batch.end.callback)) {
+        batch.end.callback(epoch, nbatch, eval.metric$get(state))
+      }
+    }
+    if (verbose) {
+      cat(sprintf("Epoch [%d] Train-%s=%f\n", epoch, eval.metric$name,
+                  eval.metric$get(state)))
+    }
+  }
+
+  arg.params <- list()
+  for (nm in trainable) {
+    if (!is.null(exec$arg.arrays[[nm]])) {
+      arg.params[[nm]] <- mx.nd.array(as.array(exec$arg.arrays[[nm]]), ctx)
+    }
+  }
+  aux.params <- list()
+  for (nm in names(exec$aux.arrays)) {
+    aux.params[[nm]] <- mx.nd.array(as.array(exec$aux.arrays[[nm]]), ctx)
+  }
+  structure(list(symbol = symbol, arg.params = arg.params,
+                 aux.params = aux.params, data.name = data.name,
+                 label.name = label.name),
+            class = "MXFeedForwardModel")
+}
+
+#' Predict over an iterator; returns the concatenated output matrix in
+#' R layout (classes, n).
+#' @export
+predict.MXFeedForwardModel <- function(object, X, ctx = NULL, ...) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  mx.io.iter.reset(X)
+  stopifnot(mx.io.iter.next(X))
+  dshape <- dim(mx.io.iter.data(X))
+  lshape <- dim(mx.io.iter.label(X))
+  input.shapes <- list(dshape, lshape)
+  names(input.shapes) <- c(object$data.name, object$label.name)
+  bind_args <- c(list(object$symbol, ctx = ctx, grad.req = "null"),
+                 input.shapes)
+  exec <- do.call(mx.simple.bind, bind_args)
+  mx.exec.update.arg.arrays(exec, object$arg.params)
+  for (nm in names(object$aux.params)) {
+    if (!is.null(exec$aux.arrays[[nm]])) {
+      mx.nd.internal.copyfrom(exec$aux.arrays[[nm]],
+                              as.array(object$aux.params[[nm]]))
+    }
+  }
+  mx.io.iter.reset(X)
+  chunks <- list()
+  while (mx.io.iter.next(X)) {
+    mx.nd.internal.copyfrom(exec$arg.arrays[[object$data.name]],
+                            as.array(mx.io.iter.data(X)))
+    mx.exec.forward(exec, is.train = FALSE)
+    pad <- mx.io.iter.padnum(X)
+    out <- as.array(mx.exec.outputs(exec)[[1]])
+    keep <- ncol(out) - pad
+    chunks[[length(chunks) + 1]] <- out[, seq_len(keep), drop = FALSE]
+  }
+  do.call(cbind, chunks)
+}
+
+#' Save a model's params + symbol in the framework's checkpoint format
+#' (interoperates with python mx.model.load_checkpoint).
+#' @export
+mx.model.save <- function(model, prefix, iteration) {
+  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
+  packed <- list()
+  for (nm in names(model$arg.params)) {
+    packed[[paste0("arg:", nm)]] <- model$arg.params[[nm]]
+  }
+  for (nm in names(model$aux.params)) {
+    packed[[paste0("aux:", nm)]] <- model$aux.params[[nm]]
+  }
+  mx.nd.save(packed, sprintf("%s-%04d.params", prefix, iteration))
+  invisible(NULL)
+}
+
+#' Load a checkpoint saved by any frontend.
+#' @export
+mx.model.load <- function(prefix, iteration) {
+  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
+  packed <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  arg.params <- list()
+  aux.params <- list()
+  for (nm in names(packed)) {
+    if (startsWith(nm, "arg:")) {
+      arg.params[[substring(nm, 5)]] <- packed[[nm]]
+    } else if (startsWith(nm, "aux:")) {
+      aux.params[[substring(nm, 5)]] <- packed[[nm]]
+    }
+  }
+  structure(list(symbol = symbol, arg.params = arg.params,
+                 aux.params = aux.params, data.name = "data",
+                 label.name = grep("label",
+                                   mx.symbol.arguments(symbol),
+                                   value = TRUE)[1]),
+            class = "MXFeedForwardModel")
+}

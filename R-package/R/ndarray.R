@@ -1,0 +1,222 @@
+#' NDArray: device tensors (reference parity: R-package/R/ndarray.R).
+#'
+#' Layout convention matches the reference R package: R arrays are
+#' column-major, the backend is row-major, so shapes are REVERSED at the
+#' boundary and the flat data is passed through unchanged — an R array
+#' of dim c(784, 64) becomes a backend (64, 784) tensor. as.array()
+#' round-trips exactly. The .C tier is float32 (the reference R surface
+#' is single-precision too).
+
+mx.internal.nd.wrap <- function(handle) {
+  nd <- new.env(parent = emptyenv())
+  nd$handle <- handle
+  class(nd) <- "MXNDArray"
+  reg.finalizer(nd, function(e) {
+    if (!is.null(e$handle) && !mx.internal.null.handle(e$handle)) {
+      tryCatch(.C("MXRNDArrayFree", handle = e$handle, rc = as.integer(0)),
+               error = function(err) NULL)
+      e$handle <- NULL
+    }
+  })
+  nd
+}
+
+#' @export
+is.mx.ndarray <- function(x) inherits(x, "MXNDArray")
+
+#' Create an empty NDArray of the given R-convention shape.
+#' @export
+mx.nd.internal.empty <- function(shape, ctx = NULL) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  if (!is.mx.context(ctx)) stop("ctx must come from mx.cpu()/mx.gpu()")
+  cshape <- rev(as.integer(shape))   # R column-major -> backend row-major
+  r <- mx.internal.C("MXRNDArrayCreate", shape = cshape,
+                     ndim = length(cshape),
+                     dev_type = ctx$device_typeid,
+                     dev_id = ctx$device_id,
+                     out = mx.internal.new.handle())
+  mx.internal.nd.wrap(r$out)
+}
+
+#' Create an NDArray from an R vector/matrix/array.
+#' @export
+mx.nd.array <- function(src.array, ctx = NULL) {
+  if (is.mx.ndarray(src.array)) return(src.array)
+  shape <- dim(src.array)
+  if (is.null(shape)) shape <- length(src.array)
+  nd <- mx.nd.internal.empty(shape, ctx)
+  data <- as.double(src.array)
+  mx.internal.C("MXRNDArraySyncCopyFromDouble", handle = nd$handle,
+                data = data, n = length(data))
+  nd
+}
+
+#' @export
+dim.MXNDArray <- function(x) {
+  r <- mx.internal.C("MXRNDArrayGetShape", handle = x$handle,
+                     ndim = as.integer(16), shape = integer(16))
+  rev(r$shape[seq_len(r$ndim)])
+}
+
+#' @export
+length.MXNDArray <- function(x) prod(dim(x))
+
+#' @export
+as.array.MXNDArray <- function(x, ...) {
+  shape <- dim(x)
+  n <- prod(shape)
+  r <- mx.internal.C("MXRNDArraySyncCopyToDouble", handle = x$handle,
+                     out = double(n), n = as.integer(n))
+  array(r$out, dim = shape)
+}
+
+#' @export
+print.MXNDArray <- function(x, ...) {
+  cat(sprintf("<MXNDArray %s>\n", paste(dim(x), collapse = "x")))
+  print(as.array(x))
+}
+
+#' Invoke a registered operator imperatively.
+#'
+#' @param op operator name ("FullyConnected", "sgd_update", ...)
+#' @param ndargs list of MXNDArray inputs (order matters)
+#' @param params named list of scalar attributes
+#' @param out NULL (allocate outputs) or a list of MXNDArrays to write
+#' @return a single MXNDArray, or a list when the op has several outputs
+#' @export
+mx.nd.internal.invoke <- function(op, ndargs, params = list(), out = NULL) {
+  in_buf <- mx.internal.pack.handles(lapply(ndargs, function(a) a$handle))
+  keys <- as.character(names(params))
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) (if (v) "1" else "0")
+    else if (is.numeric(v) && length(v) > 1)
+      paste0("(", paste(v, collapse = ","), ")")
+    else as.character(v)
+  }, "")
+  if (length(keys) == 0) { keys <- ""; vals <- "" }
+  cap <- 16L
+  if (is.null(out)) {
+    r <- mx.internal.C("MXRImperativeInvoke", op = op,
+                       n_in = length(ndargs), in_handles = in_buf,
+                       n_out = as.integer(0), out_cap = cap,
+                       out_handles = raw(8 * cap),
+                       n_kv = length(params), keys = keys, vals = vals)
+    hs <- mx.internal.unpack.handles(r$out_handles, r$n_out)
+    res <- lapply(hs, mx.internal.nd.wrap)
+    if (length(res) == 1) res[[1]] else res
+  } else {
+    out_buf <- mx.internal.pack.handles(lapply(out, function(a) a$handle))
+    mx.internal.C("MXRImperativeInvoke", op = op,
+                  n_in = length(ndargs), in_handles = in_buf,
+                  n_out = length(out), out_cap = cap,
+                  out_handles = out_buf,
+                  n_kv = length(params), keys = keys, vals = vals)
+    if (length(out) == 1) out[[1]] else out
+  }
+}
+
+#' @export
+mx.nd.zeros <- function(shape, ctx = NULL) {
+  nd <- mx.nd.internal.empty(shape, ctx)
+  data <- double(prod(shape))
+  mx.internal.C("MXRNDArraySyncCopyFromDouble", handle = nd$handle,
+                data = data, n = length(data))
+  nd
+}
+
+#' @export
+mx.nd.ones <- function(shape, ctx = NULL) {
+  nd <- mx.nd.internal.empty(shape, ctx)
+  data <- rep(1.0, prod(shape))
+  mx.internal.C("MXRNDArraySyncCopyFromDouble", handle = nd$handle,
+                data = data, n = length(data))
+  nd
+}
+
+#' Copy host data into an existing NDArray (shapes must agree).
+#' @export
+mx.nd.internal.copyfrom <- function(nd, src.array) {
+  data <- as.double(src.array)
+  mx.internal.C("MXRNDArraySyncCopyFromDouble", handle = nd$handle,
+                data = data, n = length(data))
+  nd
+}
+
+#' Arithmetic: scalars ride the *_scalar ops (no host round-trip);
+#' tensor-tensor uses the elemwise ops; other R vectors are lifted,
+#' erroring on length mismatch rather than silently recycling.
+mx.internal.nd.binop <- function(op, scalar_op, rscalar_op, e1, e2) {
+  lift <- function(v, like) {
+    if (is.mx.ndarray(v)) return(v)
+    if (length(v) != length(like)) {
+      stop(sprintf("length mismatch: %d vs %d", length(v), length(like)))
+    }
+    mx.nd.array(array(as.double(v), dim = dim(like)))
+  }
+  if (is.mx.ndarray(e1) && is.mx.ndarray(e2)) {
+    mx.nd.internal.invoke(op, list(e1, e2))
+  } else if (is.mx.ndarray(e1) && is.numeric(e2) && length(e2) == 1) {
+    mx.nd.internal.invoke(scalar_op, list(e1), list(scalar = e2))
+  } else if (is.mx.ndarray(e2) && is.numeric(e1) && length(e1) == 1) {
+    mx.nd.internal.invoke(rscalar_op, list(e2), list(scalar = e1))
+  } else if (is.mx.ndarray(e1)) {
+    mx.nd.internal.invoke(op, list(e1, lift(e2, e1)))
+  } else {
+    mx.nd.internal.invoke(op, list(lift(e1, e2), e2))
+  }
+}
+
+#' @export
+"+.MXNDArray" <- function(e1, e2) {
+  mx.internal.nd.binop("elemwise_add", "_plus_scalar", "_plus_scalar",
+                       e1, e2)
+}
+
+#' @export
+"-.MXNDArray" <- function(e1, e2) {
+  mx.internal.nd.binop("elemwise_sub", "_minus_scalar", "_rminus_scalar",
+                       e1, e2)
+}
+
+#' @export
+"*.MXNDArray" <- function(e1, e2) {
+  mx.internal.nd.binop("elemwise_mul", "_mul_scalar", "_mul_scalar",
+                       e1, e2)
+}
+
+#' @export
+"/.MXNDArray" <- function(e1, e2) {
+  mx.internal.nd.binop("elemwise_div", "_div_scalar", "_rdiv_scalar",
+                       e1, e2)
+}
+
+#' Save a (named) list of NDArrays (reference parity: mx.nd.save).
+#' @export
+mx.nd.save <- function(ndarray, filename) {
+  if (!is.list(ndarray)) ndarray <- list(ndarray)
+  keys <- names(ndarray)
+  has_keys <- as.integer(!is.null(keys) && all(nzchar(keys)))
+  if (has_keys == 0L) keys <- rep("", length(ndarray))
+  mx.internal.C("MXRNDArraySave", fname = path.expand(filename),
+                n = length(ndarray),
+                handles = mx.internal.pack.handles(
+                  lapply(ndarray, function(a) a$handle)),
+                has_keys = has_keys, keys = keys)
+  invisible(NULL)
+}
+
+#' Load NDArrays saved by any frontend of the framework.
+#' @export
+mx.nd.load <- function(filename) {
+  cap <- 4096L
+  names_buf <- mx.internal.strbuf()
+  r <- mx.internal.C("MXRNDArrayLoad", fname = path.expand(filename),
+                     cap = cap, handles = raw(8 * cap),
+                     n_out = as.integer(0), names_buf = names_buf,
+                     names_len = as.integer(nchar(names_buf)))
+  hs <- mx.internal.unpack.handles(r$handles, r$n_out)
+  out <- lapply(hs, mx.internal.nd.wrap)
+  nms <- mx.internal.split.lines(r$names_buf)
+  if (length(nms) == length(out)) names(out) <- nms
+  out
+}

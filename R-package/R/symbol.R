@@ -1,0 +1,179 @@
+#' Symbol: the declarative graph tier (reference parity:
+#' R-package/R/symbol.R). Symbols compose through the C ABI
+#' (MXSymbolCreateAtomicSymbol + MXSymbolCompose), so a graph built in R
+#' is byte-identical JSON to one built from python or perl.
+
+mx.internal.sym.wrap <- function(handle) {
+  s <- new.env(parent = emptyenv())
+  s$handle <- handle
+  class(s) <- "MXSymbol"
+  reg.finalizer(s, function(e) {
+    if (!is.null(e$handle) && !mx.internal.null.handle(e$handle)) {
+      tryCatch(.C("MXRSymbolFree", sym = e$handle, rc = as.integer(0)),
+               error = function(err) NULL)
+      e$handle <- NULL
+    }
+  })
+  s
+}
+
+#' @export
+is.mx.symbol <- function(x) inherits(x, "MXSymbol")
+
+#' Create a variable (placeholder) symbol.
+#' @export
+mx.symbol.Variable <- function(name) {
+  r <- mx.internal.C("MXRSymbolCreateVariable", name = name,
+                     out = mx.internal.new.handle())
+  mx.internal.sym.wrap(r$out)
+}
+
+#' Create + compose an operator symbol.
+#'
+#' @param op registered operator name
+#' @param args mixed list: MXSymbol entries become graph inputs
+#'   (keyword-composed when named), scalars become op attributes;
+#'   a `name` entry names the node.
+#' @export
+mx.internal.symbol.create <- function(op, args) {
+  nm <- ""
+  sym_args <- list()
+  params <- list()
+  arg_names <- names(args)
+  if (is.null(arg_names)) arg_names <- rep("", length(args))
+  for (i in seq_along(args)) {
+    v <- args[[i]]
+    k <- arg_names[i]
+    if (identical(k, "name")) {
+      nm <- as.character(v)
+    } else if (is.mx.symbol(v)) {
+      sym_args[[length(sym_args) + 1]] <- v
+      names(sym_args)[length(sym_args)] <- k
+    } else if (is.list(v) && length(v) > 0 && is.mx.symbol(v[[1]])) {
+      for (s in v) {
+        sym_args[[length(sym_args) + 1]] <- s
+        names(sym_args)[length(sym_args)] <- ""
+      }
+    } else if (!is.null(v)) {
+      params[[k]] <- v
+    }
+  }
+  keys <- as.character(names(params))
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) (if (v) "1" else "0")
+    else if (is.numeric(v) && length(v) > 1)
+      paste0("(", paste(v, collapse = ","), ")")
+    else as.character(v)
+  }, "")
+  if (length(keys) == 0) { keys <- ""; vals <- "" }
+  r <- mx.internal.C("MXRSymbolCreateAtomic", op = op,
+                     n_kv = length(params), keys = keys, vals = vals,
+                     out = mx.internal.new.handle())
+  sym <- mx.internal.sym.wrap(r$out)
+  if (length(sym_args) > 0) {
+    snames <- names(sym_args)
+    has_keys <- as.integer(!is.null(snames) && all(nzchar(snames)))
+    if (has_keys == 0L) snames <- rep("", length(sym_args))
+    mx.internal.C("MXRSymbolCompose", sym = sym$handle, name = nm,
+                  n_args = length(sym_args), has_keys = has_keys,
+                  keys = snames,
+                  args = mx.internal.pack.handles(
+                    lapply(sym_args, function(s) s$handle)))
+  }
+  sym
+}
+
+mx.internal.symbol.list <- function(sym, which) {
+  buf <- mx.internal.strbuf()
+  r <- mx.internal.C("MXRSymbolList", sym = sym$handle,
+                     which = as.integer(which), buf = buf,
+                     len = as.integer(nchar(buf)))
+  mx.internal.split.lines(r$buf)
+}
+
+#' @export
+mx.symbol.arguments <- function(sym) mx.internal.symbol.list(sym, 0)
+
+#' @export
+mx.symbol.outputs <- function(sym) mx.internal.symbol.list(sym, 1)
+
+#' @export
+mx.symbol.auxiliary.states <- function(sym) mx.internal.symbol.list(sym, 2)
+
+#' Graph JSON (interoperates with python/perl save/load).
+#' @export
+mx.symbol.tojson <- function(sym) {
+  buf <- mx.internal.strbuf(1048576)
+  r <- mx.internal.C("MXRSymbolSaveToJSON", sym = sym$handle, buf = buf,
+                     len = as.integer(nchar(buf)))
+  trimws(r$buf)
+}
+
+#' @export
+mx.symbol.load.json <- function(json) {
+  r <- mx.internal.C("MXRSymbolCreateFromJSON", json = json,
+                     out = mx.internal.new.handle())
+  mx.internal.sym.wrap(r$out)
+}
+
+#' @export
+mx.symbol.save <- function(sym, filename) {
+  writeLines(mx.symbol.tojson(sym), path.expand(filename))
+  invisible(NULL)
+}
+
+#' @export
+mx.symbol.load <- function(filename) {
+  mx.symbol.load.json(paste(readLines(path.expand(filename)),
+                            collapse = "\n"))
+}
+
+#' Infer shapes from named input shapes (R-convention shapes in,
+#' R-convention shapes out).
+#'
+#' @param sym the symbol
+#' @param ... named shapes, e.g. data = c(784, 64)
+#' @return list(arg.shapes=, out.shapes=, aux.shapes=) named lists, or
+#'   NULL when inference is incomplete
+#' @export
+mx.symbol.infer.shape <- function(sym, ...) {
+  provided <- list(...)
+  keys <- names(provided)
+  cshapes <- lapply(provided, function(s) rev(as.integer(s)))
+  ind <- c(0L, cumsum(vapply(cshapes, length, 1L)))
+  flat <- as.integer(unlist(cshapes))
+  if (length(flat) == 0) flat <- integer(0)
+  grab <- function(which, nms) {
+    cap <- 65536L
+    ndims_cap <- 8192L
+    r <- mx.internal.C("MXRSymbolInferShape", sym = sym$handle,
+                       n_provided = length(provided), keys = keys,
+                       ind_ptr = ind, shape_data = flat,
+                       which = as.integer(which), out_n = as.integer(0),
+                       out_ndims = integer(ndims_cap),
+                       ndims_cap = ndims_cap, out_shapes = integer(cap),
+                       shape_cap = cap, complete = as.integer(0))
+    if (r$complete == 0) return(NULL)
+    shapes <- list()
+    off <- 0
+    for (i in seq_len(r$out_n)) {
+      d <- r$out_ndims[i]
+      shapes[[i]] <- rev(r$out_shapes[(off + 1):(off + d)])
+      off <- off + d
+    }
+    names(shapes) <- nms
+    shapes
+  }
+  args <- grab(0, mx.symbol.arguments(sym))
+  if (is.null(args)) return(NULL)
+  list(arg.shapes = args,
+       out.shapes = grab(1, mx.symbol.outputs(sym)),
+       aux.shapes = grab(2, mx.symbol.auxiliary.states(sym)))
+}
+
+#' @export
+print.MXSymbol <- function(x, ...) {
+  cat(sprintf("<MXSymbol outputs=%s>\n",
+              paste(mx.symbol.outputs(x), collapse = ", ")))
+  invisible(x)
+}

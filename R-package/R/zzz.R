@@ -1,0 +1,23 @@
+#' mxnet.tpu: R frontend for the TPU-native MXNet framework.
+#'
+#' Pure-R binding over libmxtpu_c_api.so (src/c_api_r.cc shim tier).
+#' Reference parity surface: R-package/R (ndarray, symbol, executor,
+#' model, io) re-designed without install-time compilation.
+#'
+#' @docType package
+#' @name mxnet.tpu
+NULL
+
+.onLoad <- function(libname, pkgname) {
+  # lazy: the shared library loads on first use so the package can be
+  # attached (e.g. for docs) on machines without the framework built
+  invisible(NULL)
+}
+
+.onUnload <- function(libpath) {
+  if (!is.null(.MXNetEnv$dll)) {
+    tryCatch(dyn.unload(.MXNetEnv$dll[["path"]]),
+             error = function(e) NULL)
+    .MXNetEnv$dll <- NULL
+  }
+}

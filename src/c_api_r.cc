@@ -1,0 +1,486 @@
+/* R-binding shim tier over the C ABI (.C calling convention).
+ *
+ * Reference counterpart: R-package/src sources — the reference binds R
+ * through Rcpp/.Call glue compiled against R headers at install time.
+ * TPU-native redesign: this shim compiles into libmxtpu_c_api.so with
+ * the rest of the C ABI (no R toolchain needed to build or CI-test it),
+ * and the R package is *pure R* — it dyn.load()s the library and talks
+ * through `.C`, whose convention is "every argument is a pointer to an
+ * R-owned buffer". Concretely:
+ *
+ *   - handles travel as 8-byte raw vectors (unsigned char*), memcpy'd
+ *     to/from the underlying pointers;
+ *   - numeric data crosses as double* (R has no float32) and is cast
+ *     at the boundary — the .C tier is float32-only, matching the
+ *     reference R package's single-precision surface;
+ *   - string results are snprintf'd into R-preallocated character
+ *     buffers whose capacity rides in an explicit *len argument;
+ *   - every function's last argument is `int *rc` (0 ok, -1 error;
+ *     fetch the message with MXRGetLastError).
+ */
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+#define MXR_DLL extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+void *get_handle(const unsigned char *buf) {
+  void *h;
+  std::memcpy(&h, buf, sizeof(void *));
+  return h;
+}
+
+void put_handle(unsigned char *buf, const void *h) {
+  std::memcpy(buf, &h, sizeof(void *));
+}
+
+std::string g_r_error;  /* shim-level errors (lookup/overflow); read-and-
+                         * cleared by MXRGetLastError so it can't go stale
+                         * and misattribute a later failure */
+
+/* join `n` C strings with '\n' into an R-preallocated buffer */
+int join_into(const char **arr, unsigned n, char *buf, int cap) {
+  int off = 0;
+  for (unsigned i = 0; i < n; ++i) {
+    int wrote = snprintf(buf + off, cap > off ? cap - off : 0, "%s%s",
+                         i ? "\n" : "", arr[i]);
+    if (wrote < 0 || off + wrote >= cap) {
+      g_r_error = "string result exceeds caller buffer; grow it and retry";
+      return -1;
+    }
+    off += wrote;
+  }
+  return 0;
+}
+
+const char *last_error() {
+  /* a set g_r_error is always the most recent failure (cleared on every
+   * read); the backend message can be stale from an earlier call */
+  if (!g_r_error.empty()) return g_r_error.c_str();
+  const char *e = MXGetLastError();
+  return e != nullptr ? e : "";
+}
+
+AtomicSymbolCreator creator_by_name(const char *name) {
+  static std::map<std::string, AtomicSymbolCreator> index;
+  if (index.empty()) {
+    mx_uint n = 0;
+    AtomicSymbolCreator *arr = nullptr;
+    if (MXSymbolListAtomicSymbolCreators(&n, &arr) != 0) return nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *nm = nullptr;
+      if (MXSymbolGetAtomicSymbolName(arr[i], &nm) == 0 && nm)
+        index[nm] = arr[i];
+    }
+  }
+  auto it = index.find(name);
+  if (it == index.end()) {
+    g_r_error = std::string("operator ") + name + " is not registered";
+    return nullptr;
+  }
+  return it->second;
+}
+
+}  // namespace
+
+MXR_DLL void MXRGetLastError(char **out, int *len, int *rc) {
+  snprintf(out[0], *len, "%s", last_error());
+  g_r_error.clear();
+  *rc = 0;
+}
+
+MXR_DLL void MXRGetVersion(int *out, int *rc) { *rc = MXGetVersion(out); }
+
+MXR_DLL void MXRRandomSeed(int *seed, int *rc) { *rc = MXRandomSeed(*seed); }
+
+MXR_DLL void MXRNDArrayWaitAll(int *rc) { *rc = MXNDArrayWaitAll(); }
+
+MXR_DLL void MXRListAllOpNames(char **buf, int *len, int *rc) {
+  mx_uint n = 0;
+  const char **names = nullptr;
+  *rc = MXListAllOpNames(&n, &names);
+  if (*rc == 0) *rc = join_into(names, n, buf[0], *len);
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+MXR_DLL void MXRNDArrayCreate(int *shape, int *ndim, int *dev_type,
+                              int *dev_id, unsigned char *out, int *rc) {
+  std::vector<mx_uint> s(shape, shape + *ndim);
+  NDArrayHandle h = nullptr;
+  *rc = MXNDArrayCreate(s.data(), *ndim, *dev_type, *dev_id, 0, 0, &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRNDArraySyncCopyFromDouble(unsigned char *handle, double *data,
+                                          int *n, int *rc) {
+  std::vector<float> tmp(*n);
+  for (int i = 0; i < *n; ++i) tmp[i] = static_cast<float>(data[i]);
+  *rc = MXNDArraySyncCopyFromCPU(get_handle(handle), tmp.data(), *n);
+}
+
+MXR_DLL void MXRNDArraySyncCopyToDouble(unsigned char *handle, double *out,
+                                        int *n, int *rc) {
+  std::vector<float> tmp(*n);
+  *rc = MXNDArraySyncCopyToCPU(get_handle(handle), tmp.data(), *n);
+  if (*rc == 0)
+    for (int i = 0; i < *n; ++i) out[i] = static_cast<double>(tmp[i]);
+}
+
+MXR_DLL void MXRNDArrayGetShape(unsigned char *handle, int *ndim,
+                                int *shape, int *rc) {
+  mx_uint d = 0;
+  const mx_uint *pdata = nullptr;
+  *rc = MXNDArrayGetShape(get_handle(handle), &d, &pdata);
+  if (*rc != 0) return;
+  if (static_cast<int>(d) > *ndim) {  /* *ndim carries the caller's cap */
+    g_r_error = "MXRNDArrayGetShape: ndim exceeds caller buffer";
+    *rc = -1;
+    return;
+  }
+  for (mx_uint i = 0; i < d; ++i) shape[i] = static_cast<int>(pdata[i]);
+  *ndim = static_cast<int>(d);
+}
+
+MXR_DLL void MXRNDArrayFree(unsigned char *handle, int *rc) {
+  *rc = MXNDArrayFree(get_handle(handle));
+}
+
+MXR_DLL void MXRNDArraySave(char **fname, int *n, unsigned char *handles,
+                            int *has_keys, char **keys, int *rc) {
+  std::vector<NDArrayHandle> hs(*n);
+  for (int i = 0; i < *n; ++i) hs[i] = get_handle(handles + 8 * i);
+  std::vector<const char *> ks;
+  if (*has_keys)
+    for (int i = 0; i < *n; ++i) ks.push_back(keys[i]);
+  *rc = MXNDArraySave(fname[0], *n, hs.data(),
+                      *has_keys ? ks.data() : nullptr);
+}
+
+MXR_DLL void MXRNDArrayLoad(char **fname, int *cap, unsigned char *handles,
+                            int *n_out, char **names_buf, int *names_len,
+                            int *rc) {
+  mx_uint n = 0, nk = 0;
+  NDArrayHandle *arr = nullptr;
+  const char **names = nullptr;
+  *rc = MXNDArrayLoad(fname[0], &n, &arr, &nk, &names);
+  if (*rc != 0) return;
+  if (static_cast<int>(n) > *cap) {
+    g_r_error = "MXRNDArrayLoad: more arrays than caller buffer";
+    *rc = -1;
+    return;
+  }
+  for (mx_uint i = 0; i < n; ++i) put_handle(handles + 8 * i, arr[i]);
+  *n_out = static_cast<int>(n);
+  *rc = join_into(names, nk, names_buf[0], *names_len);
+}
+
+/* ---- imperative invoke ------------------------------------------------ */
+
+/* n_out as in/out: >0 on entry means "write into these n handles"
+ * (the `out=` form, e.g. sgd_update(out=w)); 0 means "allocate",
+ * returning the count (capped by the 8*cap raw buffer R passed). */
+MXR_DLL void MXRImperativeInvoke(char **op, int *n_in,
+                                 unsigned char *in_handles, int *n_out,
+                                 int *out_cap, unsigned char *out_handles,
+                                 int *n_kv, char **keys, char **vals,
+                                 int *rc) {
+  AtomicSymbolCreator creator = creator_by_name(op[0]);
+  if (creator == nullptr) { *rc = -1; return; }
+  std::vector<NDArrayHandle> ins(*n_in);
+  for (int i = 0; i < *n_in; ++i) ins[i] = get_handle(in_handles + 8 * i);
+  std::vector<const char *> ks, vs;
+  for (int i = 0; i < *n_kv; ++i) { ks.push_back(keys[i]); vs.push_back(vals[i]); }
+  if (*n_out > 0) {
+    std::vector<NDArrayHandle> outs(*n_out);
+    for (int i = 0; i < *n_out; ++i) outs[i] = get_handle(out_handles + 8 * i);
+    NDArrayHandle *outp = outs.data();
+    *rc = MXImperativeInvoke(creator, *n_in, ins.data(), n_out, &outp,
+                             *n_kv, ks.data(), vs.data());
+    return;
+  }
+  int num_outputs = 0;
+  NDArrayHandle *outputs = nullptr;
+  *rc = MXImperativeInvoke(creator, *n_in, ins.data(), &num_outputs,
+                           &outputs, *n_kv, ks.data(), vs.data());
+  if (*rc != 0) return;
+  if (num_outputs > *out_cap) {
+    g_r_error = "MXRImperativeInvoke: more outputs than caller buffer";
+    *rc = -1;
+    return;
+  }
+  for (int i = 0; i < num_outputs; ++i)
+    put_handle(out_handles + 8 * i, outputs[i]);
+  *n_out = num_outputs;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+MXR_DLL void MXRSymbolCreateAtomic(char **op, int *n_kv, char **keys,
+                                   char **vals, unsigned char *out, int *rc) {
+  AtomicSymbolCreator creator = creator_by_name(op[0]);
+  if (creator == nullptr) { *rc = -1; return; }
+  std::vector<const char *> ks, vs;
+  for (int i = 0; i < *n_kv; ++i) { ks.push_back(keys[i]); vs.push_back(vals[i]); }
+  SymbolHandle h = nullptr;
+  *rc = MXSymbolCreateAtomicSymbol(creator, *n_kv, ks.data(), vs.data(), &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRSymbolCreateVariable(char **name, unsigned char *out,
+                                     int *rc) {
+  SymbolHandle h = nullptr;
+  *rc = MXSymbolCreateVariable(name[0], &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRSymbolCompose(unsigned char *sym, char **name, int *n_args,
+                              int *has_keys, char **keys,
+                              unsigned char *args, int *rc) {
+  std::vector<SymbolHandle> hs(*n_args);
+  for (int i = 0; i < *n_args; ++i) hs[i] = get_handle(args + 8 * i);
+  std::vector<const char *> ks;
+  if (*has_keys)
+    for (int i = 0; i < *n_args; ++i) ks.push_back(keys[i]);
+  *rc = MXSymbolCompose(get_handle(sym), name[0], *n_args,
+                        *has_keys ? ks.data() : nullptr, hs.data());
+}
+
+/* which: 0 = arguments, 1 = outputs, 2 = auxiliary states */
+MXR_DLL void MXRSymbolList(unsigned char *sym, int *which, char **buf,
+                           int *len, int *rc) {
+  mx_uint n = 0;
+  const char **names = nullptr;
+  switch (*which) {
+    case 0: *rc = MXSymbolListArguments(get_handle(sym), &n, &names); break;
+    case 1: *rc = MXSymbolListOutputs(get_handle(sym), &n, &names); break;
+    default: *rc = MXSymbolListAuxiliaryStates(get_handle(sym), &n, &names);
+  }
+  if (*rc == 0) *rc = join_into(names, n, buf[0], *len);
+}
+
+MXR_DLL void MXRSymbolSaveToJSON(unsigned char *sym, char **buf, int *len,
+                                 int *rc) {
+  const char *json = nullptr;
+  *rc = MXSymbolSaveToJSON(get_handle(sym), &json);
+  if (*rc != 0) return;
+  int wrote = snprintf(buf[0], *len, "%s", json);
+  if (wrote >= *len) {
+    g_r_error = "MXRSymbolSaveToJSON: json exceeds caller buffer";
+    *rc = -1;
+  }
+}
+
+MXR_DLL void MXRSymbolCreateFromJSON(char **json, unsigned char *out,
+                                     int *rc) {
+  SymbolHandle h = nullptr;
+  *rc = MXSymbolCreateFromJSON(json[0], &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRSymbolFree(unsigned char *sym, int *rc) {
+  *rc = MXSymbolFree(get_handle(sym));
+}
+
+/* Infer shapes from named input shapes. which: 0 args, 1 outputs, 2 aux.
+ * shapes flatten row-major with ind_ptr offsets (CSR layout, the same
+ * convention MXSymbolInferShape itself uses). */
+MXR_DLL void MXRSymbolInferShape(unsigned char *sym, int *n_provided,
+                                 char **keys, int *ind_ptr, int *shape_data,
+                                 int *which, int *out_n, int *out_ndims,
+                                 int *ndims_cap, int *out_shapes,
+                                 int *shape_cap, int *complete, int *rc) {
+  std::vector<const char *> ks;
+  std::vector<mx_uint> ind(ind_ptr, ind_ptr + *n_provided + 1);
+  std::vector<mx_uint> sd(shape_data, shape_data + ind[*n_provided]);
+  for (int i = 0; i < *n_provided; ++i) ks.push_back(keys[i]);
+  mx_uint in_n = 0, out_nn = 0, aux_n = 0;
+  const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+  const mx_uint **in_sd = nullptr, **out_sd = nullptr, **aux_sd = nullptr;
+  *rc = MXSymbolInferShape(get_handle(sym), *n_provided, ks.data(),
+                           ind.data(), sd.data(), &in_n, &in_nd, &in_sd,
+                           &out_nn, &out_nd, &out_sd, &aux_n, &aux_nd,
+                           &aux_sd, complete);
+  if (*rc != 0) return;
+  mx_uint n = *which == 0 ? in_n : (*which == 1 ? out_nn : aux_n);
+  const mx_uint *nd = *which == 0 ? in_nd : (*which == 1 ? out_nd : aux_nd);
+  const mx_uint **sdp = *which == 0 ? in_sd : (*which == 1 ? out_sd : aux_sd);
+  if (static_cast<int>(n) > *ndims_cap) {
+    g_r_error = "MXRSymbolInferShape: arrays exceed caller ndims buffer";
+    *rc = -1;
+    return;
+  }
+  int off = 0;
+  for (mx_uint i = 0; i < n; ++i) {
+    out_ndims[i] = static_cast<int>(nd[i]);
+    for (mx_uint j = 0; j < nd[i]; ++j) {
+      if (off >= *shape_cap) {
+        g_r_error = "MXRSymbolInferShape: shapes exceed caller buffer";
+        *rc = -1;
+        return;
+      }
+      out_shapes[off++] = static_cast<int>(sdp[i][j]);
+    }
+  }
+  *out_n = static_cast<int>(n);
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+MXR_DLL void MXRExecutorSimpleBind(unsigned char *sym, int *dev_type,
+                                   int *dev_id, int *n_provided, char **keys,
+                                   int *ind_ptr, int *shape_data,
+                                   char **grad_req, int *arg_cap,
+                                   unsigned char *in_args,
+                                   unsigned char *arg_grads, int *n_args,
+                                   int *aux_cap, unsigned char *aux_states,
+                                   int *n_aux, unsigned char *out, int *rc) {
+  std::vector<const char *> ks;
+  std::vector<mx_uint> ind(ind_ptr, ind_ptr + *n_provided + 1);
+  std::vector<mx_uint> sd(shape_data, shape_data + ind[*n_provided]);
+  for (int i = 0; i < *n_provided; ++i) ks.push_back(keys[i]);
+  mx_uint num_in = 0, num_aux = 0;
+  NDArrayHandle *ins = nullptr, *grads = nullptr, *auxs = nullptr;
+  ExecutorHandle exec = nullptr;
+  int shared_buffer_len = -1;
+  const char **updated_names = nullptr;
+  NDArrayHandle *updated_handles = nullptr;
+  *rc = MXExecutorSimpleBind(
+      get_handle(sym), *dev_type, *dev_id,
+      0, nullptr, nullptr, nullptr,              /* group2ctx */
+      /* global-string grad_req: len 0, names null, types[0] = req
+       * (the four-way convention, c_api.cc:1835-1855) */
+      0, nullptr, const_cast<const char **>(grad_req),
+      *n_provided, ks.data(), sd.data(), ind.data(),
+      0, nullptr, nullptr,                        /* dtypes */
+      0, nullptr, nullptr,                        /* stypes */
+      0, nullptr,                                 /* shared arg names */
+      &shared_buffer_len, nullptr, nullptr, &updated_names, &updated_handles,
+      &num_in, &ins, &grads, &num_aux, &auxs, nullptr, &exec);
+  if (*rc != 0) return;
+  if (static_cast<int>(num_in) > *arg_cap ||
+      static_cast<int>(num_aux) > *aux_cap) {
+    g_r_error = "MXRExecutorSimpleBind: arrays exceed caller buffer";
+    *rc = -1;
+    return;
+  }
+  for (mx_uint i = 0; i < num_in; ++i) {
+    put_handle(in_args + 8 * i, ins[i]);
+    put_handle(arg_grads + 8 * i, grads ? grads[i] : nullptr);
+  }
+  for (mx_uint i = 0; i < num_aux; ++i) put_handle(aux_states + 8 * i, auxs[i]);
+  *n_args = static_cast<int>(num_in);
+  *n_aux = static_cast<int>(num_aux);
+  put_handle(out, exec);
+}
+
+MXR_DLL void MXRExecutorForward(unsigned char *exec, int *is_train, int *rc) {
+  *rc = MXExecutorForward(get_handle(exec), *is_train);
+}
+
+MXR_DLL void MXRExecutorBackward(unsigned char *exec, int *rc) {
+  *rc = MXExecutorBackward(get_handle(exec), 0, nullptr);
+}
+
+MXR_DLL void MXRExecutorOutputs(unsigned char *exec, int *cap,
+                                unsigned char *out_handles, int *n, int *rc) {
+  mx_uint num = 0;
+  NDArrayHandle *outs = nullptr;
+  *rc = MXExecutorOutputs(get_handle(exec), &num, &outs);
+  if (*rc != 0) return;
+  if (static_cast<int>(num) > *cap) {
+    g_r_error = "MXRExecutorOutputs: more outputs than caller buffer";
+    *rc = -1;
+    return;
+  }
+  for (mx_uint i = 0; i < num; ++i) put_handle(out_handles + 8 * i, outs[i]);
+  *n = static_cast<int>(num);
+}
+
+MXR_DLL void MXRExecutorFree(unsigned char *exec, int *rc) {
+  *rc = MXExecutorFree(get_handle(exec));
+}
+
+/* ---- DataIter --------------------------------------------------------- */
+
+MXR_DLL void MXRListDataIters(char **buf, int *len, int *rc) {
+  mx_uint n = 0;
+  DataIterCreator *arr = nullptr;
+  *rc = MXListDataIters(&n, &arr);
+  if (*rc != 0) return;
+  std::vector<const char *> names;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *nm = nullptr, *desc = nullptr;
+    mx_uint na = 0;
+    const char **an = nullptr, **at = nullptr, **ad = nullptr;
+    if (MXDataIterGetIterInfo(arr[i], &nm, &desc, &na, &an, &at, &ad) == 0)
+      names.push_back(nm);
+  }
+  *rc = join_into(names.data(), names.size(), buf[0], *len);
+}
+
+MXR_DLL void MXRDataIterCreate(char **name, int *n_kv, char **keys,
+                               char **vals, unsigned char *out, int *rc) {
+  mx_uint n = 0;
+  DataIterCreator *arr = nullptr;
+  *rc = MXListDataIters(&n, &arr);
+  if (*rc != 0) return;
+  DataIterCreator creator = nullptr;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *nm = nullptr, *desc = nullptr;
+    mx_uint na = 0;
+    const char **an = nullptr, **at = nullptr, **ad = nullptr;
+    if (MXDataIterGetIterInfo(arr[i], &nm, &desc, &na, &an, &at, &ad) == 0 &&
+        nm != nullptr && std::strcmp(nm, name[0]) == 0) {
+      creator = arr[i];
+      break;
+    }
+  }
+  if (creator == nullptr) {
+    g_r_error = std::string("data iterator ") + name[0] + " not found";
+    *rc = -1;
+    return;
+  }
+  std::vector<const char *> ks, vs;
+  for (int i = 0; i < *n_kv; ++i) { ks.push_back(keys[i]); vs.push_back(vals[i]); }
+  DataIterHandle h = nullptr;
+  *rc = MXDataIterCreateIter(creator, *n_kv, ks.data(), vs.data(), &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRDataIterNext(unsigned char *iter, int *out, int *rc) {
+  *rc = MXDataIterNext(get_handle(iter), out);
+}
+
+MXR_DLL void MXRDataIterBeforeFirst(unsigned char *iter, int *rc) {
+  *rc = MXDataIterBeforeFirst(get_handle(iter));
+}
+
+MXR_DLL void MXRDataIterGetData(unsigned char *iter, unsigned char *out,
+                                int *rc) {
+  NDArrayHandle h = nullptr;
+  *rc = MXDataIterGetData(get_handle(iter), &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRDataIterGetLabel(unsigned char *iter, unsigned char *out,
+                                 int *rc) {
+  NDArrayHandle h = nullptr;
+  *rc = MXDataIterGetLabel(get_handle(iter), &h);
+  if (*rc == 0) put_handle(out, h);
+}
+
+MXR_DLL void MXRDataIterGetPadNum(unsigned char *iter, int *pad, int *rc) {
+  *rc = MXDataIterGetPadNum(get_handle(iter), pad);
+}
+
+MXR_DLL void MXRDataIterFree(unsigned char *iter, int *rc) {
+  *rc = MXDataIterFree(get_handle(iter));
+}
