@@ -227,6 +227,18 @@ mxtpu_sym_list_arguments(h)
       for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVpv(names[i], 0)));
     }
 
+void
+mxtpu_sym_list_aux(h)
+    IV h
+  PPCODE:
+    {
+      mx_uint n = 0, i;
+      const char **names = NULL;
+      MXCHECK(MXSymbolListAuxiliaryStates(INT2PTR(void *, h), &n, &names));
+      EXTEND(SP, n);
+      for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+    }
+
 SV *
 mxtpu_sym_to_json(h)
     IV h

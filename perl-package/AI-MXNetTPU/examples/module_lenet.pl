@@ -1,0 +1,89 @@
+#!/usr/bin/perl
+# LeNet on MNIST through AI::MXNetTPU::Module — the Module-tier flow
+# (fit/score/predict) in pure Perl.
+#
+# Reference counterpart: perl-package/AI-MXNet/examples/mnist.pl with
+# AI::MXNet::Module (itself module/module.py's loop). Usage:
+#   module_lenet.pl <train-images-file> <train-labels-file>
+# Prints PERL_MODULE_OK when final accuracy >= 0.95.
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use lib "$FindBin::Bin/../blib/lib";
+use lib "$FindBin::Bin/../blib/arch";
+use AI::MXNetTPU;
+use AI::MXNetTPU::Module;
+
+my ( $images, $labels ) = @ARGV;
+die "usage: $0 <images> <labels>\n" unless $labels;
+
+srand(7);
+
+my $it = AI::MXNetTPU::IO->new(
+    'MNISTIter',
+    image      => $images,
+    label      => $labels,
+    batch_size => 32,
+    flat       => 'False',
+    shuffle    => 'False',
+);
+
+# LeNet (example/image-classification/symbols/lenet.py parity, sizes
+# trimmed for CI): conv-tanh-pool x2 -> fc-tanh -> fc -> softmax
+my $S    = 'AI::MXNetTPU::Symbol';
+my $data = $S->variable('data');
+my $c1 = $S->create( 'Convolution', { kernel => '(5,5)', num_filter => 8 },
+    { data => $data }, 'conv1' );
+my $a1 = $S->create( 'Activation', { act_type => 'tanh' }, { data => $c1 },
+    'tanh1' );
+my $p1 = $S->create( 'Pooling',
+    { pool_type => 'max', kernel => '(2,2)', stride => '(2,2)' },
+    { data => $a1 }, 'pool1' );
+my $c2 = $S->create( 'Convolution', { kernel => '(5,5)', num_filter => 16 },
+    { data => $p1 }, 'conv2' );
+my $a2 = $S->create( 'Activation', { act_type => 'tanh' }, { data => $c2 },
+    'tanh2' );
+my $p2 = $S->create( 'Pooling',
+    { pool_type => 'max', kernel => '(2,2)', stride => '(2,2)' },
+    { data => $a2 }, 'pool2' );
+my $fl = $S->create( 'Flatten', {}, { data => $p2 }, 'flatten' );
+my $f1 = $S->create( 'FullyConnected', { num_hidden => 64 },
+    { data => $fl }, 'fc1' );
+my $a3 = $S->create( 'Activation', { act_type => 'tanh' }, { data => $f1 },
+    'tanh3' );
+my $f2 = $S->create( 'FullyConnected', { num_hidden => 10 },
+    { data => $a3 }, 'fc2' );
+my $net = $S->create( 'SoftmaxOutput', {}, { data => $f2 }, 'softmax' );
+
+my $mod = AI::MXNetTPU::Module->new( symbol => $net );
+$mod->fit(
+    $it,
+    num_epoch        => 6,
+    optimizer_params => { learning_rate => 0.1, momentum => 0.9 },
+);
+
+my $acc = $mod->score($it);
+printf( "final accuracy: %.4f\n", $acc );
+die "accuracy $acc below bar\n" unless $acc >= 0.95;
+
+# predict must agree with score: same probs, so same argmax accuracy
+my $probs = $mod->predict($it);
+my @labels;
+$it->reset;
+while ( $it->next ) { push @labels, @{ $it->label->aslist }; }
+die "predict size mismatch\n" unless @$probs == @labels * 10;
+my $hit = 0;
+for my $i ( 0 .. $#labels ) {
+    my ( $best, $bp ) = ( 0, -1 );
+    for my $c ( 0 .. 9 ) {
+        my $v = $probs->[ $i * 10 + $c ];
+        ( $best, $bp ) = ( $c, $v ) if $v > $bp;
+    }
+    $hit++ if $best == int( $labels[$i] );
+}
+my $pacc = $hit / @labels;
+die sprintf( "predict acc %.4f != score acc %.4f\n", $pacc, $acc )
+  if abs( $pacc - $acc ) > 1e-9;
+
+print "PERL_MODULE_OK\n";

@@ -1,0 +1,266 @@
+package AI::MXNetTPU::Module;
+
+# Module tier: bind / init_params / fit / score / predict over the
+# executor + imperative-optimizer ABI.
+#
+# Reference counterpart: perl-package/AI-MXNet/lib/AI/MXNet/Module.pm
+# (itself mirroring python/mxnet/module/module.py) — the same high-level
+# training loop, minus the multi-device executor group (the TPU stack
+# scales through the fused SPMD step on the python tier).
+#
+#   my $mod = AI::MXNetTPU::Module->new( symbol => $net );
+#   $mod->fit( $train_iter,
+#       num_epoch        => 10,
+#       optimizer_params => { learning_rate => 0.1, momentum => 0.9 } );
+#   my $acc = $mod->score($val_iter);
+
+use strict;
+use warnings;
+use AI::MXNetTPU;
+use AI::MXNetTPU::NDArray;
+use AI::MXNetTPU::Symbol;
+use AI::MXNetTPU::Executor;
+
+sub new {
+    my ( $class, %args ) = @_;
+    my $symbol = $args{symbol} or die "Module->new: symbol required\n";
+    my $self   = bless {
+        symbol      => $symbol,
+        data_name   => $args{data_name}  // 'data',
+        label_name  => $args{label_name} // 'softmax_label',
+        binded      => 0,
+        params_init => 0,
+    }, $class;
+    return $self;
+}
+
+sub symbol { $_[0]{symbol} }
+
+# ---- bind -----------------------------------------------------------------
+
+sub bind {
+    my ( $self, %shapes ) = @_;
+    die "Module->bind: data shape required\n"
+      unless $shapes{ $self->{data_name} };
+    $self->{exec} =
+      AI::MXNetTPU::Executor->simple_bind( $self->{symbol}, \%shapes );
+    $self->{data_shape}  = [ @{ $shapes{ $self->{data_name} } } ];
+    $self->{label_shape} = [ @{ $shapes{ $self->{label_name} } || [] } ];
+    $self->{binded}      = 1;
+    return $self;
+}
+
+# ---- init -----------------------------------------------------------------
+
+# Xavier-uniform over backend-layout fans (initializer.py Xavier parity);
+# bias/beta zero, gamma/moving-var one. Deterministic via srand outside.
+sub _xavier_fill {
+    my ($shape) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    my $hw = 1;
+    $hw *= $shape->[$_] for 2 .. $#$shape;
+    my $fan_out = $shape->[0] * $hw;
+    my $fan_in  = ( @$shape > 1 ? $shape->[1] : $shape->[0] ) * $hw;
+    my $scale   = sqrt( 3.0 / ( ( $fan_in + $fan_out ) / 2.0 ) );
+    return [ map { ( rand(2) - 1 ) * $scale } 1 .. $n ];
+}
+
+sub init_params {
+    my ($self) = @_;
+    die "Module->init_params: call bind first\n" unless $self->{binded};
+    my $args = $self->{exec}->arg_dict;
+    # sort: perl randomizes hash order per process, and the shared rand()
+    # stream must be consumed in a stable order for srand() determinism
+    for my $name ( sort keys %$args ) {
+        next
+          if $name eq $self->{data_name}
+          or $name eq $self->{label_name};
+        my $arr   = $args->{$name};
+        my $shape = $arr->shape;
+        my $n     = $arr->size;
+        if ( $name =~ /(?:bias|beta)$/ ) {
+            $arr->set( [ (0) x $n ] );
+        }
+        elsif ( $name =~ /gamma$/ ) {
+            $arr->set( [ (1) x $n ] );
+        }
+        else {
+            $arr->set( _xavier_fill($shape) );
+        }
+    }
+    for my $i ( 0 .. $#{ $self->{exec}{aux} } ) {
+        my $name = $self->{symbol}->list_auxiliary_states->[$i] // '';
+        my $arr  = $self->{exec}{aux}[$i];
+        my $v    = ( $name =~ /var$/ ) ? 1 : 0;
+        $arr->set( [ ($v) x $arr->size ] );
+    }
+    $self->{params_init} = 1;
+    return $self;
+}
+
+# ---- the train loop -------------------------------------------------------
+
+sub _update {
+    my ( $self, %opt ) = @_;
+    my $lr       = $opt{learning_rate} // 0.01;
+    my $momentum = $opt{momentum}      // 0;
+    my $wd       = $opt{wd}            // 0;
+    my $rescale  = $opt{rescale_grad}  // 1.0;
+    for my $pair ( @{ $self->{update_pairs} } ) {
+        my ( $name, $w, $g ) = @$pair;
+        if ( $momentum > 0 ) {
+            my $m = $self->{momentum_state}{$name};
+            AI::MXNetTPU::imperative_invoke(
+                'sgd_mom_update',
+                [ $w->handle, $g->handle, $m->handle ],
+                [ $w->handle ],
+                [ 'lr', 'momentum', 'rescale_grad', 'wd' ],
+                [ $lr,  $momentum,  $rescale,       $wd ]
+            );
+        }
+        else {
+            AI::MXNetTPU::imperative_invoke(
+                'sgd_update',
+                [ $w->handle,  $g->handle ],
+                [ $w->handle ],
+                [ 'lr', 'rescale_grad', 'wd' ],
+                [ $lr,  $rescale,       $wd ]
+            );
+        }
+    }
+}
+
+sub _batch_accuracy {
+    my ( $probs, $labels, $n_batch, $n_cls ) = @_;
+    my $hit = 0;
+    for my $i ( 0 .. $n_batch - 1 ) {
+        my ( $best, $bp ) = ( 0, -1 );
+        for my $c ( 0 .. $n_cls - 1 ) {
+            my $v = $probs->[ $i * $n_cls + $c ];
+            ( $best, $bp ) = ( $c, $v ) if $v > $bp;
+        }
+        $hit++ if $best == int( $labels->[$i] );
+    }
+    return $hit;
+}
+
+sub fit {
+    my ( $self, $iter, %args ) = @_;
+    my $num_epoch = $args{num_epoch} // 10;
+    my %opt       = %{ $args{optimizer_params} || {} };
+
+    # auto-bind from the first batch
+    unless ( $self->{binded} ) {
+        $iter->reset;
+        $iter->next or die "Module->fit: empty iterator\n";
+        my ( $ds, $ls ) = ( $iter->data->shape, $iter->label->shape );
+        $self->bind(
+            $self->{data_name}  => $ds,
+            $self->{label_name} => $ls
+        );
+    }
+    $self->init_params unless $self->{params_init};
+
+    my $args_d = $self->{exec}->arg_dict;
+    $self->{trainable} = [
+        grep { $_ ne $self->{data_name} && $_ ne $self->{label_name} }
+          @{ $self->{symbol}->list_arguments }
+    ];
+    $opt{rescale_grad} //= 1.0 / $self->{data_shape}[0];
+    if ( ( $opt{momentum} // 0 ) > 0 ) {
+        for my $name ( @{ $self->{trainable} } ) {
+            $self->{momentum_state}{$name} =
+              AI::MXNetTPU::NDArray->zeros( $args_d->{$name}->shape );
+        }
+    }
+    # resolve (name, weight, grad) once — the dicts are immutable after
+    # bind, so rebuilding them per batch in _update is pure waste
+    my $grads_d = $self->{exec}->grad_dict;
+    $self->{update_pairs} = [
+        grep { defined $_->[2] }
+        map  { [ $_, $args_d->{$_}, $grads_d->{$_} ] }
+          @{ $self->{trainable} }
+    ];
+
+    my $last_acc = 0;
+    for my $epoch ( 1 .. $num_epoch ) {
+        $iter->reset;
+        my ( $hit, $seen ) = ( 0, 0 );
+        while ( $iter->next ) {
+            $args_d->{ $self->{data_name} }->copy_from( $iter->data );
+            my $label = $iter->label;
+            $args_d->{ $self->{label_name} }->copy_from($label);
+            my $outs = $self->{exec}->forward(1);
+            $self->{exec}->backward;
+            $self->_update(%opt);
+            my $labels  = $label->aslist;
+            my $n_batch = scalar @$labels;
+            my $probs   = $outs->[0]->aslist;
+            my $n_cls   = @$probs / $n_batch;
+            $hit  += _batch_accuracy( $probs, $labels, $n_batch, $n_cls );
+            $seen += $n_batch;
+        }
+        $last_acc = $seen ? $hit / $seen : 0;
+        printf( "Epoch[%d] Train-accuracy=%.4f\n", $epoch, $last_acc )
+          unless $args{quiet};
+    }
+    return $last_acc;
+}
+
+# ---- evaluation -----------------------------------------------------------
+
+sub predict {
+    my ( $self, $iter ) = @_;
+    die "Module->predict: call fit or bind+init first\n"
+      unless $self->{binded};
+    my $args_d = $self->{exec}->arg_dict;
+    my @all;
+    $iter->reset;
+    while ( $iter->next ) {
+        $args_d->{ $self->{data_name} }->copy_from( $iter->data );
+        my $outs = $self->{exec}->forward(0);
+        push @all, @{ $outs->[0]->aslist };
+    }
+    return \@all;
+}
+
+sub score {
+    my ( $self, $iter ) = @_;
+    die "Module->score: call fit or bind+init first\n"
+      unless $self->{binded};
+    my $args_d = $self->{exec}->arg_dict;
+    my ( $hit, $seen ) = ( 0, 0 );
+    $iter->reset;
+    while ( $iter->next ) {
+        $args_d->{ $self->{data_name} }->copy_from( $iter->data );
+        my $outs   = $self->{exec}->forward(0);
+        my $labels = $iter->label->aslist;
+        my $probs  = $outs->[0]->aslist;
+        my $n      = scalar @$labels;
+        $hit  += _batch_accuracy( $probs, $labels, $n, @$probs / $n );
+        $seen += $n;
+    }
+    return $seen ? $hit / $seen : 0;
+}
+
+sub get_params {
+    my ($self) = @_;
+    my $args = $self->{exec}->arg_dict;
+    my %out;
+    for my $name ( @{ $self->{trainable} || [] } ) {
+        $out{$name} = $args->{$name}->aslist;
+    }
+    return \%out;
+}
+
+sub set_params {
+    my ( $self, $params ) = @_;
+    my $args = $self->{exec}->arg_dict;
+    for my $name ( keys %$params ) {
+        $args->{$name}->set( $params->{$name} ) if $args->{$name};
+    }
+    return $self;
+}
+
+1;

@@ -38,6 +38,10 @@ sub list_arguments {
     return [ AI::MXNetTPU::sym_list_arguments( $_[0]{handle} ) ];
 }
 
+sub list_auxiliary_states {
+    return [ AI::MXNetTPU::sym_list_aux( $_[0]{handle} ) ];
+}
+
 sub tojson { AI::MXNetTPU::sym_to_json( $_[0]{handle} ) }
 
 sub DESTROY {
