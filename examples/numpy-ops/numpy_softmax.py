@@ -1,0 +1,105 @@
+"""Softmax written as a numpy CustomOp, used as the loss head of an MLP.
+
+Counterpart of the reference's example/numpy-ops/numpy_softmax.py /
+custom_softmax.py: the op's forward and backward run as host numpy
+inside an otherwise-compiled graph — the custom-op bridge
+(mxnet_tpu/operator.py, ref src/operator/custom/custom.cc) moves
+tensors across the host boundary exactly at this node.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        # loss head: backward needs no upstream gradient
+        super(NumpySoftmaxProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class NumpySoftmax(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                y = np.exp(x - x.max(axis=1, keepdims=True))
+                y /= y.sum(axis=1, keepdims=True)
+                self.assign(out_data[0], req[0], mx.nd.array(y))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                l = in_data[1].asnumpy().astype(np.int32)
+                y = out_data[0].asnumpy()
+                dx = y.copy()
+                dx[np.arange(l.shape[0]), l] -= 1.0
+                self.assign(in_grad[0], req[0], mx.nd.array(dx))
+                self.assign(in_grad[1], req[1],
+                            mx.nd.zeros(in_data[1].shape))
+
+        return NumpySoftmax()
+
+
+def mlp_with_numpy_softmax():
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=64)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=10)
+    return mx.sym.Custom(data=fc2, label=label, op_type="numpy_softmax",
+                         name="softmax")
+
+
+def synth_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 784).astype(np.float32) * 0.1
+    for i, lab in enumerate(y):
+        x[i, 78 * int(lab):78 * int(lab) + 78] += 0.8
+    return x, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-epochs", type=int, default=6)
+    p.add_argument("--num-examples", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=50)
+    args = p.parse_args()
+
+    mx.random.seed(0)   # deterministic init for the CI threshold
+    x, y = synth_mnist(args.num_examples)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(mlp_with_numpy_softmax(), context=mx.tpu(0))
+    mod.fit(train, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric=mx.metric.Accuracy())
+    train.reset()
+    acc = dict(mod.score(train, mx.metric.Accuracy()))["accuracy"]
+    print("final train accuracy: %.4f" % acc)
+
+    # parity: the custom head's probabilities match the built-in softmax
+    probs_custom = mx.nd.Custom(mx.nd.array(x[:8, :10]),
+                                mx.nd.array(y[:8]),
+                                op_type="numpy_softmax").asnumpy()
+    probs_builtin = mx.nd.softmax(mx.nd.array(x[:8, :10])).asnumpy()
+    err = float(np.abs(probs_custom - probs_builtin).max())
+    print("softmax parity max err: %.2e" % err)
+    assert err < 1e-5
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,71 @@ def test_example_domain(script, marker):
     assert marker in out, out[-1500:]
 
 
+def test_svm_mnist():
+    """SVMOutput's only end-to-end exercise (ref example/svm_mnist)."""
+    out = _run("svm_mnist/svm_mnist.py",
+               "--num-epochs", "6", "--num-examples", "600")
+    acc = float(re.search(r"final validation accuracy: ([0-9.]+)",
+                          out).group(1))
+    assert acc > 0.9, out[-1500:]
+
+
+def test_vae():
+    """VAE (ref example/vae): ELBO must improve; prior samples emitted."""
+    out = _run("vae/vae.py", "--epochs", "5", "--num-examples", "384")
+    assert "elbo improved: True" in out, out[-1500:]
+    assert "sample mean activation" in out, out[-1500:]
+
+
+def test_numpy_ops_softmax():
+    """Custom-op example surface (ref example/numpy-ops): numpy softmax
+    head trains an MLP and matches the built-in op."""
+    out = _run("numpy-ops/numpy_softmax.py", "--num-epochs", "5")
+    acc = float(re.search(r"final train accuracy: ([0-9.]+)", out).group(1))
+    assert acc > 0.9, out[-1500:]
+    err = float(re.search(r"softmax parity max err: ([0-9.e-]+)",
+                          out).group(1))
+    assert err < 1e-5, out[-1500:]
+
+
+def test_numpy_ops_weighted_logistic():
+    out = _run("numpy-ops/weighted_logistic_regression.py",
+               "--num-steps", "80")
+    m = re.search(r"positive recall: first=([0-9.]+) last=([0-9.]+)", out)
+    assert float(m.group(2)) > 0.9, out[-1500:]
+
+
+def test_captcha():
+    """Multi-digit captcha (ref example/captcha): 4 softmax heads over
+    one trunk, whole-string accuracy."""
+    out = _run("captcha/cnn_captcha.py",
+               "--num-epochs", "16", "--num-examples", "500", timeout=570)
+    acc = float(re.search(r"final captcha accuracy: ([0-9.]+)",
+                          out).group(1))
+    assert acc > 0.6, out[-1500:]
+
+
+def test_rnn_time_major():
+    """Time-major layout demo (ref example/rnn-time-major): both
+    layouts converge alike."""
+    out = _run("rnn-time-major/rnn_cell_demo.py", "--num-epochs", "5",
+               timeout=570)
+    accs = [float(m) for m in re.findall(r"accuracy=([0-9.]+)", out)]
+    assert len(accs) == 2 and min(accs) > 0.8, out[-1500:]
+
+
+def test_speech_recognition_bucketing():
+    """Acoustic model over utterance-length buckets (ref
+    example/speech_recognition): BucketingModule at its realistic
+    shape — conv front-end + stacked LSTM + per-frame softmax."""
+    out = _run("speech_recognition/train_speech.py",
+               "--num-epochs", "6", timeout=570)
+    accs = [float(m) for m in
+            re.findall(r"frame accuracy ([0-9.]+)", out)]
+    assert accs[-1] > accs[0] and accs[-1] > 0.5, out[-1500:]
+    assert "buckets trained: [20, 30, 40]" in out, out[-1500:]
+
+
 @pytest.mark.nightly
 @pytest.mark.parametrize("script,marker", [
     ("nce-loss/toy_nce.py", "NCE_OK"),
