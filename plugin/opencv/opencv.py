@@ -1,0 +1,176 @@
+"""OpenCV plugin: cv2-backed image ops + an augmenting ImageIter.
+
+Reference counterpart: plugin/opencv/ (opencv.py + cv_api.cc) — there
+the decode/resize/border kernels are C++ OpenCV behind the C API; here
+cv2's own native kernels fill that role and results land directly in
+framework NDArrays. The ImageIter mirrors the reference class: file
+list in, decode -> augment (resize / rand_crop / rand_mirror) ->
+NCHW float batches out, drop-in as a Module.fit data source.
+
+Import requires cv2 (pip opencv); everything else is framework-only.
+"""
+import random
+
+import cv2
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio
+from mxnet_tpu.ndarray import ndarray as nd
+
+
+def imdecode(str_img, flag=1):
+    """Decode a compressed image buffer into an HWC BGR NDArray
+    (ref plugin/opencv/opencv.py imdecode)."""
+    buf = np.frombuffer(
+        str_img if isinstance(str_img, (bytes, bytearray))
+        else str_img.encode("latin1"), np.uint8)
+    img = cv2.imdecode(buf, flag)
+    if img is None:
+        raise ValueError("imdecode: buffer is not a valid image")
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img.astype(np.float32))
+
+def resize(src, size, interpolation=cv2.INTER_LINEAR):
+    """Resize an HWC NDArray/array to ``size`` = (w, h)."""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = cv2.resize(img, tuple(size), interpolation=interpolation)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out.astype(np.float32))
+
+
+def copyMakeBorder(src, top, bot, left, right,
+                   border_type=cv2.BORDER_CONSTANT, value=0):
+    """Pad an HWC NDArray/array (ref cv_api.cc MXCVcopyMakeBorder)."""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = cv2.copyMakeBorder(img, top, bot, left, right, border_type,
+                             value=value)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out.astype(np.float32))
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit src_size, preserving aspect (ref helper)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None):
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        out = cv2.resize(out, tuple(size))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out.astype(np.float32))
+
+
+def random_crop(src, size):
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size)
+
+
+class ImageIter(mxio.DataIter):
+    """Augmenting image iterator over (path, label) lists.
+
+    Ref: plugin/opencv/opencv.py ImageListIter. Each epoch: optional
+    shuffle; per image decode -> resize shorter side -> random or
+    center crop to ``data_shape`` -> optional mirror -> NCHW float.
+    """
+
+    def __init__(self, img_list, data_shape, batch_size, resize_size=None,
+                 rand_crop=False, rand_mirror=False, shuffle=False,
+                 mean=None, data_name="data", label_name="softmax_label"):
+        super(ImageIter, self).__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise ValueError("data_shape must be (C, H, W)")
+        self.img_list = list(img_list)
+        self.data_shape = tuple(data_shape)
+        self.resize_size = resize_size
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.shuffle = shuffle
+        self.mean = mean
+        self.data_name = data_name
+        self.label_name = label_name
+        self._order = list(range(len(self.img_list)))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = 0
+        if self.shuffle:
+            random.shuffle(self._order)
+
+    def _load_one(self, path):
+        flag = 1 if self.data_shape[0] == 3 else 0
+        img = cv2.imread(path, flag)
+        if img is None:
+            raise IOError("ImageIter: cannot read %r" % path)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.resize_size is not None:
+            short = min(img.shape[:2])
+            scale = float(self.resize_size) / short
+            nw = max(int(img.shape[1] * scale + 0.5), self.data_shape[2])
+            nh = max(int(img.shape[0] * scale + 0.5), self.data_shape[1])
+            img = cv2.resize(img, (nw, nh))
+            if img.ndim == 2:
+                img = img[:, :, None]
+        c, th, tw = self.data_shape
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            raise ValueError(
+                "ImageIter: image %dx%d smaller than data_shape %dx%d "
+                "(set resize_size to upscale)" % (h, w, th, tw))
+        if self.rand_crop:
+            x0 = random.randint(0, w - tw)
+            y0 = random.randint(0, h - th)
+        else:
+            x0, y0 = (w - tw) // 2, (h - th) // 2
+        img = img[y0:y0 + th, x0:x0 + tw]
+        if self.rand_mirror and random.random() < 0.5:
+            img = img[:, ::-1]
+        out = img.astype(np.float32).transpose(2, 0, 1)   # HWC -> CHW
+        if self.mean is not None:
+            out -= self.mean
+        return out
+
+    def next(self):
+        if self.cursor >= len(self.img_list):
+            raise StopIteration
+        n = self.batch_size
+        data = np.zeros((n,) + self.data_shape, np.float32)
+        label = np.zeros((n,), np.float32)
+        pad = 0
+        for i in range(n):
+            if self.cursor < len(self.img_list):
+                path, lab = self.img_list[self._order[self.cursor]]
+                data[i] = self._load_one(path)
+                label[i] = lab
+                self.cursor += 1
+            else:
+                pad += 1
+        return mxio.DataBatch(data=[nd.array(data)],
+                              label=[nd.array(label)], pad=pad,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)

@@ -101,3 +101,126 @@ def test_residual_block_converts_and_runs():
     out = ex.forward(is_train=False,
                      data=rng.randn(2, 8, 16, 16).astype(np.float32))[0]
     assert np.all(np.isfinite(out.asnumpy()))
+
+
+# ---------------------------------------------------------------------------
+# .caffemodel weights bridge (VERDICT r4 #6): dependency-free wire
+# parser -> convert_model -> checkpoint loadable by the framework
+# ---------------------------------------------------------------------------
+def test_caffemodel_weights_roundtrip(tmp_path):
+    """Write a synthetic .caffemodel for the RESBLOCK net (the protobuf
+    writer in caffe_proto.py), convert, and check every blob landed in
+    the right arg/aux slot — including the BatchNorm scale_factor
+    normalization and the Scale->gamma/beta fold."""
+    from caffe_proto import parse_caffemodel, write_caffemodel
+    from convert_model import convert_model, save_checkpoint
+
+    rng = np.random.RandomState(0)
+    conv_w = rng.randn(8, 8, 3, 3).astype(np.float32)
+    bn_mean = rng.randn(8).astype(np.float32)
+    bn_var = rng.rand(8).astype(np.float32) + 0.5
+    sf = 4.0                      # caffe stores mean*sf, var*sf
+    gamma = rng.rand(8).astype(np.float32) + 0.5
+    beta = rng.randn(8).astype(np.float32)
+    fc_w = rng.randn(4, 8).astype(np.float32)
+    fc_b = rng.randn(4).astype(np.float32)
+
+    blob = write_caffemodel("resblock", [
+        ("conv1", "Convolution", [((8, 8, 3, 3), conv_w.ravel().tolist())]),
+        ("bn1", "BatchNorm", [((8,), (bn_mean * sf).tolist()),
+                              ((8,), (bn_var * sf).tolist()),
+                              ((1,), [sf])]),
+        ("scale1", "Scale", [((8,), gamma.tolist()),
+                             ((8,), beta.tolist())]),
+        ("fc", "InnerProduct", [((4, 8), fc_w.ravel().tolist()),
+                                ((4,), fc_b.tolist())]),
+    ])
+
+    # the wire parser reads back exactly what the writer emitted
+    layers = parse_caffemodel(blob)
+    assert [l["name"] for l in layers] == ["conv1", "bn1", "scale1", "fc"]
+    assert layers[0]["blobs"][0][0] == (8, 8, 3, 3)
+
+    sym, arg_params, aux_params = convert_model(RESBLOCK, blob)
+    np.testing.assert_array_equal(arg_params["conv1_weight"], conv_w)
+    np.testing.assert_allclose(aux_params["bn1_moving_mean"], bn_mean,
+                               rtol=1e-6)
+    np.testing.assert_allclose(aux_params["bn1_moving_var"], bn_var,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(arg_params["bn1_gamma"], gamma)
+    np.testing.assert_array_equal(arg_params["bn1_beta"], beta)
+    np.testing.assert_array_equal(arg_params["fc_weight"], fc_w)
+    np.testing.assert_array_equal(arg_params["fc_bias"], fc_b)
+
+    # checkpoint round-trip + forward through the converted net
+    prefix = str(tmp_path / "resblock")
+    save_checkpoint(sym, arg_params, aux_params, prefix)
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    exe = sym2.simple_bind(ctx=mx.cpu(), data=(2, 8, 16, 16),
+                           grad_req="null")
+    for k, v in args2.items():
+        if k in exe.arg_dict:
+            v.copyto(exe.arg_dict[k])
+    for k, v in aux2.items():
+        exe.aux_dict[k][:] = v
+    x = rng.randn(2, 8, 16, 16).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    # unpacked float encoding is also legal on the wire
+    blob_unpacked = write_caffemodel("n", [
+        ("conv1", "Convolution",
+         [((2, 1, 1, 1), [1.5, -2.5])])], packed=False)
+    lay = parse_caffemodel(blob_unpacked)
+    assert lay[0]["blobs"][0] == ((2, 1, 1, 1), [1.5, -2.5])
+
+
+def test_caffemodel_legacy_blob_shapes():
+    """Legacy BlobProto (num/channels/h/w fields, no BlobShape): the
+    4-D wire shape survives — a (1, C, kh, kw) conv weight keeps its
+    leading 1, and legacy (1, 1, out, in) FC weights squeeze to 2-D in
+    convert_model, not in the parser."""
+    import struct
+    from caffe_proto import (_key, parse_caffemodel, write_bytes,
+                             write_string, write_varint)
+
+    def legacy_blob(num, ch, h, w, data):
+        msg = b"".join(_key(f, 0) + write_varint(d)
+                       for f, d in zip((1, 2, 3, 4), (num, ch, h, w)))
+        msg += write_bytes(5, struct.pack("<%df" % len(data), *data))
+        return msg
+
+    def legacy_layer(name, type_str, blobs):
+        msg = write_string(1, name) + write_string(2, type_str)
+        for b in blobs:
+            msg += write_bytes(7, b)
+        return msg
+
+    conv_w = list(np.arange(9, dtype=np.float32))        # (1, 1, 3, 3)
+    fc_w = list(np.arange(8, dtype=np.float32))          # (1, 1, 2, 4)
+    net = write_string(1, "legacy")
+    net += write_bytes(100, legacy_layer(
+        "conv1", "Convolution", [legacy_blob(1, 1, 3, 3, conv_w)]))
+    net += write_bytes(100, legacy_layer(
+        "fc", "InnerProduct", [legacy_blob(1, 1, 2, 4, fc_w)]))
+
+    layers = parse_caffemodel(net)
+    assert layers[0]["blobs"][0][0] == (1, 1, 3, 3)       # not stripped
+    assert layers[1]["blobs"][0][0] == (1, 1, 2, 4)
+
+    from convert_model import convert_model
+    proto = """
+    name: "legacy"
+    input: "data"
+    input_dim: 1 input_dim: 1 input_dim: 8 input_dim: 8
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 1 kernel_size: 3 bias_term: false } }
+    layer { name: "flat" type: "Flatten" bottom: "conv1" top: "flat" }
+    layer { name: "fc" type: "InnerProduct" bottom: "flat" top: "fc"
+      inner_product_param { num_output: 2 } }
+    """
+    _sym, args, _aux = convert_model(proto, net)
+    assert args["conv1_weight"].shape == (1, 1, 3, 3)
+    assert args["fc_weight"].shape == (2, 4)

@@ -1,0 +1,214 @@
+"""Dependency-free protobuf wire-format codec for .caffemodel files.
+
+Reference counterpart: tools/caffe_converter/caffe_parser.py, which
+needs caffe's generated protobuf classes (and therefore a caffe
+install). This module reads the NetParameter wire format directly —
+varint / fixed32 / fixed64 / length-delimited framing per the protobuf
+encoding spec — covering the subset .caffemodel files use:
+
+    NetParameter   { name=1, layers(V1)=2, layer=100 }
+    LayerParameter { name=1, type=2, bottom=3, top=4, blobs=7 }
+    V1LayerParameter { bottom=2, top=3, name=4, type=5, blobs=6 }
+    BlobProto      { num=1, channels=2, height=3, width=4,
+                     data=5 (float, packed or not), shape=7,
+                     double_data=8 }
+    BlobShape      { dim=1 (int64, packed) }
+
+A writer for the same subset backs the converter's tests (synthesizing
+valid .caffemodel blobs without caffe).
+"""
+import struct
+
+
+# ---------------------------------------------------------------------------
+# wire-level reader
+# ---------------------------------------------------------------------------
+def read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def read_fields(buf, start=0, end=None):
+    """Scan a message; yield (field_number, wire_type, value) where value
+    is an int (varint/fixed) or bytes (length-delimited)."""
+    pos = start
+    if end is None:
+        end = len(buf)
+    while pos < end:
+        key, pos = read_varint(buf, pos)
+        field, wtype = key >> 3, key & 7
+        if wtype == 0:                      # varint
+            val, pos = read_varint(buf, pos)
+        elif wtype == 1:                    # fixed64
+            val = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wtype == 2:                    # length-delimited
+            n, pos = read_varint(buf, pos)
+            val = bytes(buf[pos:pos + n])
+            pos += n
+        elif wtype == 5:                    # fixed32
+            val = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wtype)
+        yield field, wtype, val
+
+
+def group(buf):
+    """{field_number: [(wire_type, value), ...]} for one message."""
+    out = {}
+    for field, wtype, val in read_fields(buf):
+        out.setdefault(field, []).append((wtype, val))
+    return out
+
+
+def _floats(entries):
+    """repeated float: packed (one length-delimited blob) or unpacked
+    (one fixed32 per entry) — both legal on the wire."""
+    vals = []
+    for wtype, v in entries:
+        if wtype == 2:
+            vals.extend(struct.unpack("<%df" % (len(v) // 4), v))
+        elif wtype == 5:
+            vals.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        else:
+            raise ValueError("bad float wire type %d" % wtype)
+    return vals
+
+
+def _varints_packed(entries):
+    vals = []
+    for wtype, v in entries:
+        if wtype == 2:
+            pos = 0
+            while pos < len(v):
+                x, pos = read_varint(v, pos)
+                vals.append(x)
+        else:
+            vals.append(v)
+    return vals
+
+
+def parse_blob(buf):
+    """BlobProto -> (shape tuple, flat float list)."""
+    g = group(buf)
+    data = _floats(g.get(5, []))
+    if not data and 8 in g:                  # double_data
+        data = []
+        for wtype, v in g[8]:
+            if wtype == 2:
+                data.extend(struct.unpack("<%dd" % (len(v) // 8), v))
+            else:
+                data.append(struct.unpack("<d", struct.pack("<Q", v))[0])
+    if 7 in g:                               # BlobShape
+        dims = _varints_packed(group(g[7][0][1]).get(1, []))
+        shape = tuple(int(d) for d in dims)
+    else:
+        # legacy num/channels/h/w: always 4-D on the wire (caffe
+        # Blob::FromProto); consumers squeeze per layer kind — stripping
+        # 1-dims here would corrupt e.g. a (1, C, kh, kw) conv weight
+        shape = tuple(int(g[f][0][1]) if f in g else 1
+                      for f in (1, 2, 3, 4))
+    return shape, data
+
+
+def _string(g, field, default=""):
+    if field in g:
+        return g[field][0][1].decode("utf-8")
+    return default
+
+
+def parse_caffemodel(buf):
+    """NetParameter -> list of {name, type, blobs:[(shape, data)]}.
+
+    Handles both the modern ``layer`` (field 100) and the legacy V1
+    ``layers`` (field 2) encodings; V1 enum types come through as ints.
+    """
+    g = group(buf)
+    layers = []
+    for _w, msg in g.get(100, []):           # LayerParameter
+        lg = group(msg)
+        layers.append({
+            "name": _string(lg, 1),
+            "type": _string(lg, 2),
+            "blobs": [parse_blob(b) for _w2, b in lg.get(7, [])],
+        })
+    for _w, msg in g.get(2, []):             # V1LayerParameter
+        lg = group(msg)
+        type_id = int(lg[5][0][1]) if 5 in lg else -1
+        layers.append({
+            "name": _string(lg, 4),
+            "type": _V1_TYPES.get(type_id, str(type_id)),
+            "blobs": [parse_blob(b) for _w2, b in lg.get(6, [])],
+        })
+    return layers
+
+
+# V1LayerParameter.LayerType values used by weight-carrying layers
+_V1_TYPES = {
+    4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
+    0: "None", 3: "Concat", 5: "Data", 6: "Dropout", 8: "Eltwise",
+    15: "LRN", 17: "Pooling", 18: "ReLU", 19: "Sigmoid",
+    20: "Softmax", 21: "SoftmaxWithLoss", 23: "TanH",
+}
+
+
+# ---------------------------------------------------------------------------
+# wire-level writer (test support: synthesize valid caffemodel bytes)
+# ---------------------------------------------------------------------------
+def write_varint(x):
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field, wtype):
+    return write_varint((field << 3) | wtype)
+
+
+def write_bytes(field, payload):
+    return _key(field, 2) + write_varint(len(payload)) + payload
+
+
+def write_string(field, s):
+    return write_bytes(field, s.encode("utf-8"))
+
+
+def write_blob(shape, data, packed=True):
+    shape_msg = b"".join(_key(1, 0) + write_varint(d) for d in shape)
+    msg = write_bytes(7, shape_msg)
+    if packed:
+        msg += write_bytes(5, struct.pack("<%df" % len(data), *data))
+    else:
+        msg += b"".join(_key(5, 5) + struct.pack("<f", v) for v in data)
+    return msg
+
+
+def write_layer(name, type_str, blobs, packed=True):
+    msg = write_string(1, name) + write_string(2, type_str)
+    for shape, data in blobs:
+        msg += write_bytes(7, write_blob(shape, data, packed))
+    return msg
+
+
+def write_caffemodel(name, layers, packed=True):
+    """layers: [(name, type, [(shape, flat floats), ...]), ...]"""
+    msg = write_string(1, name)
+    for lname, ltype, blobs in layers:
+        msg += write_bytes(100, write_layer(lname, ltype, blobs, packed))
+    return msg

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Caffe .caffemodel weights -> mxnet_tpu checkpoint converter.
+
+Reference counterpart: ``tools/caffe_converter/convert_model.py`` —
+there built on caffe's generated protobuf classes; here on the
+dependency-free wire parser (caffe_proto.py), so the bridge runs in
+this offline image. Completes the prototxt bridge
+(convert_symbol.py): symbol from the prototxt, weights from the
+binary blobs, saved in the framework's checkpoint format (loadable by
+``mx.model.load_checkpoint`` from every frontend).
+
+Blob mapping (reference convert_model.py table):
+    Convolution / InnerProduct / Deconvolution:
+        blobs[0] -> <name>_weight        (OIHW / (out,in) — same layout)
+        blobs[1] -> <name>_bias
+    BatchNorm: blobs [mean, var, scale_factor]
+        -> aux <name>_moving_mean / _moving_var, each / scale_factor
+    Scale (paired with the preceding BatchNorm):
+        blobs [gamma, beta] -> <bn_name>_gamma / <bn_name>_beta
+        (convert_symbol folds caffe's Scale into BatchNorm's affine)
+
+Usage:
+    python convert_model.py net.prototxt net.caffemodel out_prefix
+writes out_prefix-symbol.json and out_prefix-0000.params.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__))
+                .rsplit("/", 2)[0])
+
+from caffe_proto import parse_caffemodel  # noqa: E402
+from convert_symbol import convert_symbol, parse_prototxt  # noqa: E402
+
+WEIGHT_LAYERS = ("Convolution", "InnerProduct", "Deconvolution")
+
+
+def _layer_types(prototxt_text):
+    """{layer_name: (type, bottoms, tops)} from the prototxt."""
+    net = parse_prototxt(prototxt_text)
+    out = {}
+    for layer in net.get("layer", []) + net.get("layers", []):
+        name = layer["name"][0]
+        out[name] = (
+            layer["type"][0],
+            [str(b) for b in layer.get("bottom", [])],
+            [str(t) for t in layer.get("top", [])],
+        )
+    return out
+
+
+def convert_model(prototxt_text, caffemodel_bytes):
+    """Returns (symbol, arg_params, aux_params) as numpy dicts."""
+    types = _layer_types(prototxt_text)
+    model_layers = parse_caffemodel(caffemodel_bytes)
+
+    # Scale layers attach to the BatchNorm producing their bottom blob
+    bn_of_top = {}
+    for name, (ltype, _bots, tops) in types.items():
+        if ltype == "BatchNorm":
+            for t in tops:
+                bn_of_top[t] = name
+
+    arg_params, aux_params = {}, {}
+    for layer in model_layers:
+        name = layer["name"]
+        blobs = layer["blobs"]
+        if not blobs:
+            continue
+        ltype = types.get(name, (layer["type"], [], []))[0]
+        if ltype in WEIGHT_LAYERS:
+            shape, data = blobs[0]
+            w = np.asarray(data, np.float32).reshape(shape)
+            if ltype == "InnerProduct" and w.ndim > 2:
+                # legacy blobs store FC weights as (1, 1, out, in)
+                w = w.reshape(shape[-2], shape[-1])
+            arg_params[name + "_weight"] = w
+            if len(blobs) > 1:
+                bshape, bdata = blobs[1]
+                arg_params[name + "_bias"] = np.asarray(
+                    bdata, np.float32).reshape(-1)
+        elif ltype == "BatchNorm":
+            (m_shape, mean), (_v, var) = blobs[0], blobs[1]
+            sf = blobs[2][1][0] if len(blobs) > 2 and blobs[2][1] else 1.0
+            sf = 1.0 / sf if sf != 0 else 1.0
+            aux_params[name + "_moving_mean"] = (
+                np.asarray(mean, np.float32) * sf)
+            aux_params[name + "_moving_var"] = (
+                np.asarray(var, np.float32) * sf)
+        elif ltype == "Scale":
+            bots = types.get(name, (None, [], []))[1]
+            bn = bn_of_top.get(bots[0]) if bots else None
+            if bn is None:
+                raise ValueError(
+                    "Scale layer %r has no preceding BatchNorm" % name)
+            arg_params[bn + "_gamma"] = np.asarray(blobs[0][1], np.float32)
+            if len(blobs) > 1:
+                arg_params[bn + "_beta"] = np.asarray(
+                    blobs[1][1], np.float32)
+        # other layer kinds carry no learnable blobs we map
+
+    sym, _input_dim = convert_symbol(prototxt_text)
+    # BatchNorm args not present in the blobs (e.g. Scale absent ->
+    # gamma/beta default) are filled at bind time by the initializer
+    return sym, arg_params, aux_params
+
+
+def save_checkpoint(sym, arg_params, aux_params, prefix, epoch=0):
+    import mxnet as mx
+
+    sym.save("%s-symbol.json" % prefix)
+    save_dict = {"arg:%s" % k: mx.nd.array(v)
+                 for k, v in arg_params.items()}
+    save_dict.update({"aux:%s" % k: mx.nd.array(v)
+                      for k, v in aux_params.items()})
+    mx.nd.save("%s-%04d.params" % (prefix, epoch), save_dict)
+
+
+def main():
+    if len(sys.argv) < 4:
+        print(__doc__)
+        raise SystemExit(1)
+    with open(sys.argv[1]) as f:
+        text = f.read()
+    with open(sys.argv[2], "rb") as f:
+        blob = f.read()
+    sym, arg_params, aux_params = convert_model(text, blob)
+    save_checkpoint(sym, arg_params, aux_params, sys.argv[3])
+    print("converted %d arg + %d aux params -> %s-*"
+          % (len(arg_params), len(aux_params), sys.argv[3]))
+
+
+if __name__ == "__main__":
+    main()
