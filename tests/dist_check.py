@@ -189,6 +189,39 @@ def main():
         assert np.allclose(w_np[row], want), (r, row, w_np[row], want)
     assert sorted(touched[-1].tolist()) == list(range(n))
 
+    # server-side optimizer mode (ref kvstore_dist_server.h:173-500
+    # set_optimizer): the reference runs the optimizer ON the server —
+    # workers push grads and pull back UPDATED WEIGHTS. Serverless
+    # equivalence contract: after push+pull every worker holds exactly
+    # the weights a central server would have produced, bit-identical
+    # across workers.
+    kvo = mx.kv.create("dist_sync")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=1.0 / n)
+    kvo.set_optimizer(opt)
+    shape_o = (4, 3)
+    w0 = np.linspace(-1, 1, 12).reshape(shape_o).astype(np.float32)
+    kvo.init("srv_w", nd.array(w0))
+    for step in range(3):
+        grad_r = np.full(shape_o, float(r + 1 + step), np.float32)
+        kvo.push("srv_w", nd.array(grad_r))
+        wout_o = nd.zeros(shape_o)
+        kvo.pull("srv_w", out=wout_o)
+    got_w = wout_o.asnumpy()
+    # serial "central server": same optimizer applied to the aggregated
+    # gradient sequence
+    ref_opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                               rescale_grad=1.0 / n)
+    ref_upd = mx.optimizer.get_updater(ref_opt)
+    w_ref = nd.array(w0)
+    for step in range(3):
+        g_sum = np.zeros(shape_o, np.float32)
+        for g in range(n):
+            g_sum += np.full(shape_o, float(g + 1 + step), np.float32)
+        ref_upd("srv_w", nd.array(g_sum), w_ref)
+    assert np.array_equal(got_w, w_ref.asnumpy()), (
+        r, got_w, w_ref.asnumpy())
+
     print("DIST_CHECK_OK rank=%d loss=%.4f" % (r, lv), flush=True)
 
 
