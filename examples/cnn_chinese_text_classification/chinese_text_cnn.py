@@ -1,0 +1,99 @@
+# -*- coding: utf-8 -*-
+"""Character-level CNN for Chinese text classification.
+
+Counterpart of the reference's example/cnn_chinese_text_classification/
+— Kim-style text CNN where the token unit is the CJK character (no word
+segmentation, the point of the chinese variant): char embedding,
+parallel conv widths over the sequence, max-over-time pooling, softmax.
+A synthetic two-class corpus built from real CJK characters (positive /
+negative sentiment wordlets embedded in random text) stands in for the
+Sogou corpus.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+
+POS_WORDS = ["喜欢", "很好", "高兴", "优秀", "精彩"]
+NEG_WORDS = ["讨厌", "糟糕", "失望", "无聊", "差劲"]
+FILLER = "的一是在有人这中大为上个国我以要他时来用们"
+
+
+def build_vocab():
+    chars = sorted(set("".join(POS_WORDS + NEG_WORDS) + FILLER))
+    return {c: i + 1 for i, c in enumerate(chars)}   # 0 = pad
+
+
+def synth_corpus(n, seq_len, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = np.zeros((n, seq_len), np.float32)
+    ys = np.zeros((n,), np.float32)
+    filler_ids = [vocab[c] for c in FILLER]
+    for i in range(n):
+        lab = i % 2
+        words = POS_WORDS if lab else NEG_WORDS
+        seq = [int(rng.choice(filler_ids)) for _ in range(seq_len)]
+        # plant 1-2 sentiment wordlets at random positions
+        for _ in range(rng.randint(1, 3)):
+            w = words[rng.randint(len(words))]
+            pos = rng.randint(0, seq_len - len(w))
+            for j, ch in enumerate(w):
+                seq[pos + j] = vocab[ch]
+        xs[i] = seq
+        ys[i] = lab
+    return xs, ys
+
+
+def text_cnn(seq_len, vocab_size, num_embed, filter_widths, num_filter):
+    data = mx.sym.var("data")
+    embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    conv_in = mx.sym.Reshape(embed, shape=(-1, 1, seq_len, num_embed))
+    pooled = []
+    for w in filter_widths:
+        conv = mx.sym.Convolution(conv_in, kernel=(w, num_embed),
+                                  num_filter=num_filter,
+                                  name="conv%d" % w)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(act, pool_type="max",
+                                     kernel=(seq_len - w + 1, 1)))
+    concat = mx.sym.Concat(*pooled, dim=1)
+    flat = mx.sym.Flatten(concat)
+    fc = mx.sym.FullyConnected(flat, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-epochs", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=24)
+    p.add_argument("--num-examples", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--num-embed", type=int, default=16)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    vocab = build_vocab()
+    x, y = synth_corpus(args.num_examples, args.seq_len, vocab)
+    n_train = int(0.8 * len(x))
+    train = mx.io.NDArrayIter(x[:n_train], y[:n_train], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[n_train:], y[n_train:], args.batch_size)
+
+    net = text_cnn(args.seq_len, len(vocab) + 1, args.num_embed,
+                   (2, 3, 4), 32)
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 0.005},
+            eval_metric=mx.metric.Accuracy())
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("chars in vocab: %d" % len(vocab))
+    print("final validation accuracy: %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

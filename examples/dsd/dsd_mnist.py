@@ -1,0 +1,113 @@
+"""Dense-Sparse-Dense (DSD) training flow.
+
+Counterpart of the reference's example/dsd/ (Han et al.'s DSD: train
+dense, prune the smallest weights and retrain under the sparsity mask,
+then release the mask and retrain dense — a regularizer that often
+beats straight dense training). The sparse phase re-applies the mask
+to the two pruned weight matrices after every update step.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+
+
+def mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=96)
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def synth_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 784).astype(np.float32) * 0.3
+    for i, lab in enumerate(y):
+        x[i, 78 * int(lab):78 * int(lab) + 78] += 0.7
+    return x, y.astype(np.float32)
+
+
+def _phase(mod, train, epochs, lr, masks=None):
+    """One training phase; masks (name -> 0/1 array) keep pruned
+    weights at zero through every update."""
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": lr,
+                                         "momentum": 0.9},
+                       force_init=True)
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            if masks:
+                args, _ = mod.get_params()
+                # only the two pruned matrices round-trip; everything
+                # else stays device-resident untouched
+                pruned = {k: args[k] * mx.nd.array(m)
+                          for k, m in masks.items()}
+                mod.set_params(pruned, {}, allow_missing=True)
+
+
+def _accuracy(mod, it):
+    it.reset()
+    return dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-examples", type=int, default=800)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--sparsity", type=float, default=0.5)
+    p.add_argument("--epochs-per-phase", type=int, default=4)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    x, y = synth_mnist(args.num_examples)
+    n_train = int(0.8 * len(x))
+    train = mx.io.NDArrayIter(x[:n_train], y[:n_train], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[n_train:], y[n_train:], args.batch_size)
+
+    mod = mx.mod.Module(mlp(), context=mx.tpu(0))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+
+    # phase 1: dense
+    _phase(mod, train, args.epochs_per_phase, 0.1)
+    acc_dense = _accuracy(mod, val)
+    print("phase1 dense:  val accuracy %.4f" % acc_dense)
+
+    # prune: zero the smallest |w| per weight matrix
+    args_p, _ = mod.get_params()
+    masks = {}
+    for name in ("fc1_weight", "fc2_weight"):
+        w = args_p[name].asnumpy()
+        thresh = np.quantile(np.abs(w), args.sparsity)
+        masks[name] = (np.abs(w) > thresh).astype(np.float32)
+    kept = {k: float(m.mean()) for k, m in masks.items()}
+    print("sparse masks keep: %s" % kept)
+
+    # phase 2: sparse retrain under the mask
+    _phase(mod, train, args.epochs_per_phase, 0.05, masks=masks)
+    args_s, _ = mod.get_params()
+    for name, m in masks.items():
+        w = args_s[name].asnumpy()
+        assert float(np.abs(w[m == 0]).max()) == 0.0, "mask violated"
+    acc_sparse = _accuracy(mod, val)
+    print("phase2 sparse: val accuracy %.4f" % acc_sparse)
+
+    # phase 3: re-dense (mask released, lower lr)
+    _phase(mod, train, args.epochs_per_phase, 0.01)
+    acc_final = _accuracy(mod, val)
+    print("phase3 dense:  val accuracy %.4f" % acc_final)
+    print("dsd ok: %s" % (acc_final >= max(acc_dense - 0.02, 0.9)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Adversarial variational autoencoder (VAE-GAN).
+
+Counterpart of the reference's example/mxnet_adversarial_vae/ — a VAE
+whose decoder doubles as a GAN generator: the discriminator learns to
+tell real samples from reconstructions/prior samples, and its signal
+is added to the ELBO so reconstructions sharpen beyond the L2-ish blur
+of a plain VAE. Alternating updates on the gluon tier (two Trainers,
+one autograd graph each), all compiled by XLA per step.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import autograd, gluon, nd
+
+
+def make_encoder(n_hidden, n_latent):
+    net = gluon.nn.HybridSequential(prefix="enc_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(n_hidden, activation="tanh"))
+        net.add(gluon.nn.Dense(n_latent * 2))
+    return net
+
+
+def make_decoder(n_hidden, n_out):
+    net = gluon.nn.HybridSequential(prefix="dec_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(n_hidden, activation="tanh"))
+        net.add(gluon.nn.Dense(n_out))
+    return net
+
+
+def make_discriminator(n_hidden):
+    net = gluon.nn.HybridSequential(prefix="dis_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(n_hidden, activation="tanh"))
+        net.add(gluon.nn.Dense(1))
+    return net
+
+
+def bce_logits(logit, target):
+    return nd.mean(nd.relu(logit) - logit * target
+                   + nd.log(1.0 + nd.exp(-nd.abs(logit))))
+
+
+def synth_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = (rng.rand(n, 784) < 0.05).astype(np.float32)
+    for i, lab in enumerate(y):
+        x[i, 78 * int(lab):78 * int(lab) + 78] = 1.0
+    return x
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-latent", type=int, default=8)
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--gan-weight", type=float, default=0.1)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    ctx = mx.tpu(0)
+    x = synth_mnist(args.num_examples)
+    enc = make_encoder(128, args.n_latent)
+    dec = make_decoder(128, 784)
+    dis = make_discriminator(64)
+    for net in (enc, dec, dis):
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        net.hybridize()
+    t_vae = gluon.Trainer(
+        dict(list(enc.collect_params().items())
+             + list(dec.collect_params().items())),
+        "adam", {"learning_rate": 1e-2})
+    t_dis = gluon.Trainer(dis.collect_params(), "adam",
+                          {"learning_rate": 1e-3})
+
+    first = last = None
+    d_accs = []
+    for epoch in range(args.epochs):
+        tot = nb = 0.0
+        for i in range(0, len(x), args.batch_size):
+            xb = nd.array(x[i:i + args.batch_size], ctx=ctx)
+            n = xb.shape[0]
+
+            # --- discriminator step: real vs reconstruction ---
+            # (generator pass outside record: only the discriminator
+            # needs gradients here)
+            h = enc(xb)
+            mu = nd.slice_axis(h, axis=1, begin=0, end=args.n_latent)
+            lv = nd.slice_axis(h, axis=1, begin=args.n_latent,
+                               end=2 * args.n_latent)
+            z = mu + nd.exp(0.5 * lv) * nd.random_normal(
+                0, 1, shape=mu.shape)
+            recon = dec(z).sigmoid()
+            with autograd.record():
+                d_loss = (bce_logits(dis(xb), nd.ones((n, 1), ctx=ctx))
+                          + bce_logits(dis(recon),
+                                       nd.zeros((n, 1), ctx=ctx)))
+            d_loss.backward()
+            t_dis.step(n)
+
+            # --- VAE step: ELBO + adversarial term ---
+            with autograd.record():
+                h = enc(xb)
+                mu = nd.slice_axis(h, axis=1, begin=0, end=args.n_latent)
+                lv = nd.slice_axis(h, axis=1, begin=args.n_latent,
+                                   end=2 * args.n_latent)
+                z = mu + nd.exp(0.5 * lv) * nd.random_normal(
+                    0, 1, shape=mu.shape)
+                logits = dec(z)
+                recon_l = nd.sum(nd.relu(logits) - logits * xb
+                                 + nd.log(1.0 + nd.exp(-nd.abs(logits))),
+                                 axis=1)
+                kl = -0.5 * nd.sum(1 + lv - mu * mu - nd.exp(lv), axis=1)
+                fool = bce_logits(dis(logits.sigmoid()),
+                                  nd.ones((n, 1), ctx=ctx))
+                loss = nd.mean(recon_l + kl) + args.gan_weight * fool
+            loss.backward()
+            t_vae.step(n)
+            tot += float(nd.mean(recon_l + kl).asscalar())
+            nb += 1
+
+        avg = tot / nb
+        if first is None:
+            first = avg
+        last = avg
+        # discriminator calibration on a held-out-ish pass
+        xb = nd.array(x[:128], ctx=ctx)
+        h = enc(xb)
+        mu = nd.slice_axis(h, axis=1, begin=0, end=args.n_latent)
+        recon = dec(mu).sigmoid()
+        d_real = (dis(xb).asnumpy() > 0).mean()
+        d_fake = (dis(recon).asnumpy() < 0).mean()
+        d_accs.append(0.5 * (d_real + d_fake))
+        print("epoch %d: -ELBO=%.2f  disc_acc=%.3f"
+              % (epoch, avg, d_accs[-1]))
+
+    print("elbo improved: %s" % (last < first))
+    print("adversary engaged: %s" % (max(d_accs) > 0.6))
+
+
+if __name__ == "__main__":
+    main()

@@ -183,6 +183,45 @@ def test_speech_recognition_bucketing():
     assert "buckets trained: [20, 30, 40]" in out, out[-1500:]
 
 
+def test_dsd():
+    """Dense-sparse-dense flow (ref example/dsd): prune, masked
+    retrain (mask invariant asserted in-script), re-dense."""
+    out = _run("dsd/dsd_mnist.py", "--epochs-per-phase", "3",
+               "--num-examples", "600")
+    assert "dsd ok: True" in out, out[-1500:]
+    assert "phase2 sparse" in out, out[-1500:]
+
+
+def test_kaggle_ndsb1(tmp_path):
+    """Class-folder image pipeline (ref example/kaggle-ndsb1) through
+    the opencv plugin ImageIter."""
+    pytest.importorskip("cv2", reason="needs the opencv plugin")
+    out = _run("kaggle-ndsb1/train_plankton.py", "--num-epochs", "8",
+               "--data-root", str(tmp_path / "ndsb"))
+    acc = float(re.search(r"final plankton accuracy: ([0-9.]+)",
+                          out).group(1))
+    assert acc > 0.9, out[-1500:]
+
+
+def test_adversarial_vae():
+    """VAE-GAN (ref example/mxnet_adversarial_vae): ELBO improves and
+    the discriminator actually engages."""
+    out = _run("mxnet_adversarial_vae/avae.py", "--epochs", "5",
+               "--num-examples", "384", timeout=570)
+    assert "elbo improved: True" in out, out[-1500:]
+    assert "adversary engaged: True" in out, out[-1500:]
+
+
+def test_chinese_text_cnn():
+    """Char-level CJK text CNN (ref
+    example/cnn_chinese_text_classification)."""
+    out = _run("cnn_chinese_text_classification/chinese_text_cnn.py",
+               "--num-epochs", "6", "--num-examples", "500")
+    acc = float(re.search(r"final validation accuracy: ([0-9.]+)",
+                          out).group(1))
+    assert acc > 0.9, out[-1500:]
+
+
 @pytest.mark.nightly
 @pytest.mark.parametrize("script,marker", [
     ("nce-loss/toy_nce.py", "NCE_OK"),

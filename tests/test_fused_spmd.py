@@ -164,6 +164,45 @@ def test_fused_trainstep_mesh_matches_single(axes, mesh_kw):
                                    atol=2e-6, err_msg=k)
 
 
+def test_fused_trainstep_mixed_dp_tp_mesh():
+    """Fused Pallas units over dp while fc1 is tensor-sharded over tp —
+    the dryrun's mixed-mesh layout with the fused graph: shard_map
+    regions (batch axes only) compose with pjit's tp partitioning of
+    the dense tail."""
+    from jax.sharding import PartitionSpec as P
+
+    sym = _fused_sym()
+    mesh = _mesh(8, names=("dp", "tp"), shape=(4, 2))
+    rules = [(r".*fc1_weight$", P("tp", None)), (r".*fc1_bias$", P("tp"))]
+    ts = TrainStep(sym, functional_optimizer("sgd", learning_rate=0.05),
+                   mesh=mesh, data_axes=("dp",), param_rules=rules,
+                   return_outputs=True)
+    batch = 8
+    p, _o, a = ts.init_params({"data": (batch, 3, 32, 32),
+                               "softmax_label": (batch,)},
+                              initializer=mx.initializer.Xavier())
+    pn = {k: np.asarray(v) for k, v in p.items()}
+    an = {k: np.asarray(v) for k, v in a.items()}
+    rng = np.random.RandomState(1)
+    batch_np = {
+        "data": rng.randn(batch, 3, 32, 32).astype(np.float32),
+        "softmax_label": rng.randint(0, 16, (batch,)).astype(np.float32),
+    }
+    l_mesh, o_mesh, p_mesh, _a_mesh = _run_steps(
+        ts, pn, an, batch_np,
+        place_sharding=data_sharding(mesh, ("dp",)))
+
+    ts1 = TrainStep(sym, functional_optimizer("sgd", learning_rate=0.05),
+                    mesh=None, return_outputs=True)
+    l_one, o_one, p_one, _a_one = _run_steps(ts1, pn, an, batch_np)
+    np.testing.assert_allclose(l_mesh, l_one, rtol=2e-5)
+    np.testing.assert_allclose(o_mesh, o_one, rtol=2e-4, atol=2e-5)
+    for k in ("fc1_weight", "stage1_unit1_conv2_weight",
+              "stage2_unit1_bn2_gamma"):
+        np.testing.assert_allclose(p_mesh[k], p_one[k], rtol=2e-4,
+                                   atol=2e-6, err_msg=k)
+
+
 def test_init_params_deterministic():
     """Same seed => identical params: init_params must seed the
     module-owned initializer RNG, not just global numpy (regression —
