@@ -212,6 +212,17 @@ def test_adversarial_vae():
     assert "adversary engaged: True" in out, out[-1500:]
 
 
+def test_kaggle_ndsb2(tmp_path):
+    """CDF regression with CRPS (ref example/kaggle-ndsb2): CSVIter
+    disk pipeline, symbolic difference channels, 120-way sigmoid head."""
+    out = _run("kaggle-ndsb2/train_heart.py", "--num-epochs", "8",
+               "--num-examples", "300",
+               "--data-root", str(tmp_path / "ndsb2"))
+    assert "crps improved: True" in out, out[-1500:]
+    crps = [float(m) for m in re.findall(r"train CRPS ([0-9.]+)", out)]
+    assert crps[-1] < 0.08, out[-1500:]
+
+
 def test_chinese_text_cnn():
     """Char-level CJK text CNN (ref
     example/cnn_chinese_text_classification)."""
