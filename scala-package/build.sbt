@@ -1,0 +1,17 @@
+// Scala binding for mxnet_tpu over the C ABI's .C-convention shim tier
+// (src/c_api_r.cc — every argument a primitive array, which JNA maps
+// without any JNI glue; the same tier the pure-R binding uses).
+//
+// Reference counterpart: scala-package/ (the reference's JNI-based
+// scala frontend). Build: `sbt compile` / `sbt "runMain
+// ai.mxnettpu.examples.TrainMnist <images> <labels>"` with
+// MXTPU_CAPI_LIB pointing at libmxtpu_c_api.so.
+name := "mxnet-tpu-scala"
+
+version := "0.12.1"
+
+scalaVersion := "2.12.18"
+
+libraryDependencies += "net.java.dev.jna" % "jna" % "5.13.0"
+
+Compile / scalaSource := baseDirectory.value / "core" / "src" / "main" / "scala"

@@ -1,0 +1,150 @@
+package ai.mxnettpu
+
+import com.sun.jna.{Library, Native}
+
+/** JNA surface of the .C-convention shim tier (src/c_api_r.cc).
+  *
+  * Reference counterpart: scala-package core LibInfo.scala (JNI).
+  * Every shim argument is a pointer into a caller-owned buffer, so the
+  * whole ABI maps to JNA primitive arrays — no JNI glue to compile:
+  * handles are 8-byte Array[Byte], ints/doubles are 1-or-n element
+  * arrays, strings are Array[String] (char**), and every call's last
+  * argument is rc (Array[Int](1), 0 = ok).
+  */
+trait CApiShim extends Library {
+  def MXRGetLastError(out: Array[String], len: Array[Int], rc: Array[Int]): Unit
+  def MXRGetVersion(out: Array[Int], rc: Array[Int]): Unit
+  def MXRRandomSeed(seed: Array[Int], rc: Array[Int]): Unit
+  def MXRNDArrayWaitAll(rc: Array[Int]): Unit
+  def MXRListAllOpNames(buf: Array[String], len: Array[Int], rc: Array[Int]): Unit
+
+  def MXRNDArrayCreate(shape: Array[Int], ndim: Array[Int], devType: Array[Int],
+                       devId: Array[Int], out: Array[Byte], rc: Array[Int]): Unit
+  def MXRNDArraySyncCopyFromDouble(handle: Array[Byte], data: Array[Double],
+                                   n: Array[Int], rc: Array[Int]): Unit
+  def MXRNDArraySyncCopyToDouble(handle: Array[Byte], out: Array[Double],
+                                 n: Array[Int], rc: Array[Int]): Unit
+  def MXRNDArrayGetShape(handle: Array[Byte], ndim: Array[Int],
+                         shape: Array[Int], rc: Array[Int]): Unit
+  def MXRNDArrayFree(handle: Array[Byte], rc: Array[Int]): Unit
+  def MXRImperativeInvoke(op: Array[String], nIn: Array[Int],
+                          inHandles: Array[Byte], nOut: Array[Int],
+                          outCap: Array[Int], outHandles: Array[Byte],
+                          nKv: Array[Int], keys: Array[String],
+                          vals: Array[String], rc: Array[Int]): Unit
+
+  def MXRSymbolCreateAtomic(op: Array[String], nKv: Array[Int],
+                            keys: Array[String], vals: Array[String],
+                            out: Array[Byte], rc: Array[Int]): Unit
+  def MXRSymbolCreateVariable(name: Array[String], out: Array[Byte],
+                              rc: Array[Int]): Unit
+  def MXRSymbolCompose(sym: Array[Byte], name: Array[String],
+                       nArgs: Array[Int], hasKeys: Array[Int],
+                       keys: Array[String], args: Array[Byte],
+                       rc: Array[Int]): Unit
+  def MXRSymbolList(sym: Array[Byte], which: Array[Int], buf: Array[String],
+                    len: Array[Int], rc: Array[Int]): Unit
+  def MXRSymbolSaveToJSON(sym: Array[Byte], buf: Array[String],
+                          len: Array[Int], rc: Array[Int]): Unit
+  def MXRSymbolCreateFromJSON(json: Array[String], out: Array[Byte],
+                              rc: Array[Int]): Unit
+  def MXRSymbolFree(sym: Array[Byte], rc: Array[Int]): Unit
+
+  def MXRExecutorSimpleBind(sym: Array[Byte], devType: Array[Int],
+                            devId: Array[Int], nProvided: Array[Int],
+                            keys: Array[String], indPtr: Array[Int],
+                            shapeData: Array[Int], gradReq: Array[String],
+                            argCap: Array[Int], inArgs: Array[Byte],
+                            argGrads: Array[Byte], nArgs: Array[Int],
+                            auxCap: Array[Int], auxStates: Array[Byte],
+                            nAux: Array[Int], out: Array[Byte],
+                            rc: Array[Int]): Unit
+  def MXRExecutorForward(exec: Array[Byte], isTrain: Array[Int],
+                         rc: Array[Int]): Unit
+  def MXRExecutorBackward(exec: Array[Byte], rc: Array[Int]): Unit
+  def MXRExecutorOutputs(exec: Array[Byte], cap: Array[Int],
+                         outHandles: Array[Byte], n: Array[Int],
+                         rc: Array[Int]): Unit
+  def MXRExecutorFree(exec: Array[Byte], rc: Array[Int]): Unit
+
+  def MXRDataIterCreate(name: Array[String], nKv: Array[Int],
+                        keys: Array[String], vals: Array[String],
+                        out: Array[Byte], rc: Array[Int]): Unit
+  def MXRDataIterNext(iter: Array[Byte], out: Array[Int], rc: Array[Int]): Unit
+  def MXRDataIterBeforeFirst(iter: Array[Byte], rc: Array[Int]): Unit
+  def MXRDataIterGetData(iter: Array[Byte], out: Array[Byte], rc: Array[Int]): Unit
+  def MXRDataIterGetLabel(iter: Array[Byte], out: Array[Byte], rc: Array[Int]): Unit
+  def MXRDataIterGetPadNum(iter: Array[Byte], pad: Array[Int], rc: Array[Int]): Unit
+  def MXRDataIterFree(iter: Array[Byte], rc: Array[Int]): Unit
+}
+
+object Base {
+  lazy val lib: CApiShim = {
+    val path = sys.env.getOrElse(
+      "MXTPU_CAPI_LIB",
+      sys.env.get("MXTPU_ROOT")
+        .map(_ + "/mxnet_tpu/lib/libmxtpu_c_api.so")
+        .getOrElse(throw new RuntimeException(
+          "set MXTPU_CAPI_LIB or MXTPU_ROOT to locate libmxtpu_c_api.so")))
+    Native.load(path, classOf[CApiShim])
+  }
+
+  def lastError(): String = {
+    val (buf, len) = strBuf(4096)
+    val rc = Array(0)
+    lib.MXRGetLastError(buf, len, rc)
+    buf(0).trim
+  }
+
+  /** Run a shim call; the rc array's single element reports failure. */
+  def check(fn: Array[Int] => Unit): Unit = {
+    val rc = Array(0)
+    fn(rc)
+    if (rc(0) != 0) throw new MXNetError(lastError())
+  }
+
+  def newHandle(): Array[Byte] = new Array[Byte](8)
+
+  def packHandles(hs: Seq[Array[Byte]]): Array[Byte] = {
+    val out = new Array[Byte](8 * math.max(1, hs.length))
+    hs.zipWithIndex.foreach { case (h, i) =>
+      System.arraycopy(h, 0, out, 8 * i, 8)
+    }
+    out
+  }
+
+  def unpackHandles(buf: Array[Byte], n: Int): IndexedSeq[Array[Byte]] =
+    (0 until n).map(i => buf.slice(8 * i, 8 * i + 8))
+
+  /** A string out-buffer and its matching length argument, built
+    * together so a call site can never pass a len larger than the
+    * allocation (the shim's snprintf trusts len; a mismatch would be
+    * native heap corruption, not an error).
+    */
+  def strBuf(n: Int = 65536): (Array[String], Array[Int]) =
+    (Array(" " * n), Array(n))
+
+  def splitLines(s: String): Array[String] = {
+    val t = s.replaceAll("\\s+$", "")
+    if (t.isEmpty) Array.empty else t.split("\n")
+  }
+
+  def version(): Int = {
+    val out = Array(0)
+    check(rc => lib.MXRGetVersion(out, rc))
+    out(0)
+  }
+
+  def randomSeed(seed: Int): Unit =
+    check(rc => lib.MXRRandomSeed(Array(seed), rc))
+
+  def waitAll(): Unit = check(rc => lib.MXRNDArrayWaitAll(rc))
+
+  def listAllOpNames(): Array[String] = {
+    val (buf, len) = strBuf()
+    check(rc => lib.MXRListAllOpNames(buf, len, rc))
+    splitLines(buf(0))
+  }
+}
+
+class MXNetError(msg: String) extends RuntimeException(msg)

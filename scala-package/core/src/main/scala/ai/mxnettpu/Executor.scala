@@ -1,0 +1,64 @@
+package ai.mxnettpu
+
+import Base._
+
+/** Bound computation graph (reference counterpart: scala-package core
+  * Executor.scala over MXExecutorSimpleBind).
+  */
+class Executor private[mxnettpu] (private[mxnettpu] val handle: Array[Byte],
+                                  val symbol: Symbol,
+                                  val argArrays: IndexedSeq[NDArray],
+                                  val gradArrays: IndexedSeq[Option[NDArray]],
+                                  val auxArrays: IndexedSeq[NDArray]) {
+
+  lazy val argDict: Map[String, NDArray] =
+    symbol.listArguments().zip(argArrays).toMap
+
+  lazy val gradDict: Map[String, Option[NDArray]] =
+    symbol.listArguments().zip(gradArrays).toMap
+
+  def forward(isTrain: Boolean): IndexedSeq[NDArray] = {
+    check(rc => lib.MXRExecutorForward(handle,
+                                       Array(if (isTrain) 1 else 0), rc))
+    val cap = 64
+    val buf = new Array[Byte](8 * cap)
+    val n = Array(0)
+    check(rc => lib.MXRExecutorOutputs(handle, Array(cap), buf, n, rc))
+    unpackHandles(buf, n(0)).map(new NDArray(_))
+  }
+
+  def backward(): Unit = check(rc => lib.MXRExecutorBackward(handle, rc))
+
+  def dispose(): Unit = check(rc => lib.MXRExecutorFree(handle, rc))
+}
+
+object Executor {
+  /** simpleBind with named row-major input shapes (python-frontend
+    * shape convention).
+    */
+  def simpleBind(symbol: Symbol, shapes: Seq[(String, Seq[Int])],
+                 gradReq: String = "write", devType: Int = 1,
+                 devId: Int = 0): Executor = {
+    val keys = shapes.map(_._1).toArray
+    val flat = shapes.flatMap(_._2).map(_.toInt).toArray
+    val indPtr = shapes.scanLeft(0)(_ + _._2.length).toArray
+    val argCap = 4096
+    val auxCap = 4096
+    val inArgs = new Array[Byte](8 * argCap)
+    val argGrads = new Array[Byte](8 * argCap)
+    val auxStates = new Array[Byte](8 * auxCap)
+    val nArgs = Array(0)
+    val nAux = Array(0)
+    val h = newHandle()
+    check(rc => lib.MXRExecutorSimpleBind(
+      symbol.handle, Array(devType), Array(devId), Array(shapes.length),
+      keys, indPtr, flat, Array(gradReq), Array(argCap), inArgs,
+      argGrads, nArgs, Array(auxCap), auxStates, nAux, h, rc))
+    val args = unpackHandles(inArgs, nArgs(0)).map(new NDArray(_))
+    val grads = unpackHandles(argGrads, nArgs(0)).map { hb =>
+      if (hb.forall(_ == 0)) None else Some(new NDArray(hb))
+    }
+    val aux = unpackHandles(auxStates, nAux(0)).map(new NDArray(_))
+    new Executor(h, symbol, args, grads, aux)
+  }
+}

@@ -1,0 +1,56 @@
+package ai.mxnettpu
+
+import Base._
+
+/** Data iterator over the DataIter C surface (reference counterpart:
+  * scala-package core IO.scala; same creators as python's mx.io).
+  */
+class DataIter private[mxnettpu] (private[mxnettpu] val handle: Array[Byte]) {
+
+  def reset(): Unit = check(rc => lib.MXRDataIterBeforeFirst(handle, rc))
+
+  def hasNext: Boolean = {
+    val out = Array(0)
+    check(rc => lib.MXRDataIterNext(handle, out, rc))
+    out(0) != 0
+  }
+
+  def data: NDArray = {
+    val h = newHandle()
+    check(rc => lib.MXRDataIterGetData(handle, h, rc))
+    new NDArray(h)
+  }
+
+  def label: NDArray = {
+    val h = newHandle()
+    check(rc => lib.MXRDataIterGetLabel(handle, h, rc))
+    new NDArray(h)
+  }
+
+  def padNum: Int = {
+    val out = Array(0)
+    check(rc => lib.MXRDataIterGetPadNum(handle, out, rc))
+    out(0)
+  }
+
+  def dispose(): Unit = check(rc => lib.MXRDataIterFree(handle, rc))
+}
+
+object DataIter {
+  def create(iterName: String, params: Map[String, String]): DataIter = {
+    val keys = if (params.isEmpty) Array("") else params.keys.toArray
+    val vals = if (params.isEmpty) Array("") else keys.map(params)
+    val h = newHandle()
+    check(rc => lib.MXRDataIterCreate(Array(iterName), Array(params.size),
+                                      keys, vals, h, rc))
+    new DataIter(h)
+  }
+
+  def mnistIter(image: String, label: String, batchSize: Int,
+                flat: Boolean = true, shuffle: Boolean = false): DataIter =
+    create("MNISTIter", Map(
+      "image" -> image, "label" -> label,
+      "batch_size" -> batchSize.toString,
+      "flat" -> (if (flat) "True" else "False"),
+      "shuffle" -> (if (shuffle) "True" else "False")))
+}

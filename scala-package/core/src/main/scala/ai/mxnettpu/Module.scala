@@ -1,0 +1,144 @@
+package ai.mxnettpu
+
+import scala.collection.mutable
+import scala.util.Random
+
+/** Module tier: bind / initParams / fit / score over the executor and
+  * imperative-optimizer ops (reference counterpart: scala-package core
+  * Module + FeedForward.scala; same loop as the python and perl Module
+  * tiers of this framework).
+  */
+class Module(val symbol: Symbol, dataName: String = "data",
+             labelName: String = "softmax_label") {
+
+  private var exec: Executor = _
+  private var trainable: Array[String] = Array.empty
+  private val momentum = mutable.Map.empty[String, NDArray]
+
+  def bind(shapes: Seq[(String, Seq[Int])]): this.type = {
+    exec = Executor.simpleBind(symbol, shapes)
+    this
+  }
+
+  /** Xavier-uniform over backend fans; bias/beta zero, gamma one.
+    * Stable name order so a seeded Random reproduces.
+    */
+  def initParams(seed: Long = 0L): this.type = {
+    require(exec != null, "call bind first")
+    val rng = new Random(seed)
+    for (name <- exec.argDict.keys.toSeq.sorted
+         if name != dataName && name != labelName) {
+      val arr = exec.argDict(name)
+      val shape = arr.shape
+      val n = shape.product
+      val values =
+        if (name.endsWith("bias") || name.endsWith("beta")) {
+          new Array[Double](n)
+        } else if (name.endsWith("gamma")) {
+          Array.fill(n)(1.0)
+        } else {
+          val hw = if (shape.length > 2) shape.drop(2).product else 1
+          val fanOut = shape.head * hw
+          val fanIn = (if (shape.length > 1) shape(1) else shape.head) * hw
+          val scale = math.sqrt(3.0 / ((fanIn + fanOut) / 2.0))
+          Array.fill(n)((rng.nextDouble() * 2 - 1) * scale)
+        }
+      arr.set(values)
+    }
+    for ((name, arr) <- symbol.listAuxiliaryStates().zip(exec.auxArrays)) {
+      val v = if (name.endsWith("var")) 1.0 else 0.0
+      arr.set(Array.fill(arr.size)(v))
+    }
+    this
+  }
+
+  private def update(lr: Double, mom: Double, wd: Double,
+                     rescale: Double): Unit = {
+    for (name <- trainable) {
+      (exec.argDict(name), exec.gradDict(name)) match {
+        case (w, Some(g)) =>
+          if (mom > 0) {
+            val m = momentum.getOrElseUpdate(name, NDArray.zeros(w.shape))
+            NDArray.invoke("sgd_mom_update", Seq(w, g, m),
+                           Map("lr" -> lr.toString,
+                               "momentum" -> mom.toString,
+                               "wd" -> wd.toString,
+                               "rescale_grad" -> rescale.toString),
+                           out = Seq(w))
+          } else {
+            NDArray.invoke("sgd_update", Seq(w, g),
+                           Map("lr" -> lr.toString, "wd" -> wd.toString,
+                               "rescale_grad" -> rescale.toString),
+                           out = Seq(w))
+          }
+        case _ => ()
+      }
+    }
+  }
+
+  private def batchAccuracy(probs: Array[Double],
+                            labels: Array[Double]): Int = {
+    val nCls = probs.length / labels.length
+    labels.indices.count { i =>
+      val row = probs.slice(i * nCls, (i + 1) * nCls)
+      row.indexOf(row.max) == labels(i).toInt
+    }
+  }
+
+  def fit(iter: DataIter, numEpoch: Int, learningRate: Double = 0.01,
+          momentumArg: Double = 0.0, wd: Double = 0.0,
+          quiet: Boolean = false): Double = {
+    if (exec == null) {
+      iter.reset()
+      require(iter.hasNext, "empty iterator")
+      bind(Seq(dataName -> iter.data.shape, labelName -> iter.label.shape))
+    }
+    initParams()
+    trainable = symbol.listArguments()
+      .filterNot(n => n == dataName || n == labelName)
+    var lastAcc = 0.0
+    val batchRows = exec.argDict(dataName).shape.head
+    for (epoch <- 1 to numEpoch) {
+      iter.reset()
+      var hit = 0
+      var seen = 0
+      while (iter.hasNext) {
+        // iter.data/label and forward() outputs are caller-owned
+        // handles (c_api.cc ownership contract): dispose per batch,
+        // like the perl DESTROY / R finalizer siblings
+        val d = iter.data
+        val l = iter.label
+        exec.argDict(dataName).copyFrom(d)
+        val labels = l.toArray
+        exec.argDict(labelName).set(labels)
+        val outs = exec.forward(isTrain = true)
+        exec.backward()
+        update(learningRate, momentumArg, wd, 1.0 / batchRows)
+        hit += batchAccuracy(outs.head.toArray, labels)
+        seen += labels.length
+        d.dispose(); l.dispose(); outs.foreach(_.dispose())
+      }
+      lastAcc = hit.toDouble / seen
+      if (!quiet) println(f"Epoch[$epoch] Train-accuracy=$lastAcc%.4f")
+    }
+    lastAcc
+  }
+
+  def score(iter: DataIter): Double = {
+    require(exec != null, "call fit or bind first")
+    iter.reset()
+    var hit = 0
+    var seen = 0
+    while (iter.hasNext) {
+      val d = iter.data
+      val l = iter.label
+      exec.argDict(dataName).copyFrom(d)
+      val labels = l.toArray
+      val outs = exec.forward(isTrain = false)
+      hit += batchAccuracy(outs.head.toArray, labels)
+      seen += labels.length
+      d.dispose(); l.dispose(); outs.foreach(_.dispose())
+    }
+    hit.toDouble / seen
+  }
+}

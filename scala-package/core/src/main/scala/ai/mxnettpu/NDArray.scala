@@ -1,0 +1,88 @@
+package ai.mxnettpu
+
+import Base._
+
+/** Device tensor over the shim tier (reference counterpart:
+  * scala-package core NDArray.scala). Data crosses as Double (the .C
+  * tier is float32-only on device, like the reference scala API's
+  * Float surface); shapes are row-major as in the python frontend.
+  */
+class NDArray private[mxnettpu] (private[mxnettpu] val handle: Array[Byte],
+                                 private var owned: Boolean = true) {
+
+  def shape: IndexedSeq[Int] = {
+    val ndim = Array(16)
+    val out = new Array[Int](16)
+    check(rc => lib.MXRNDArrayGetShape(handle, ndim, out, rc))
+    out.take(ndim(0)).toIndexedSeq
+  }
+
+  def size: Int = shape.product
+
+  def toArray: Array[Double] = {
+    val n = size
+    val out = new Array[Double](n)
+    check(rc => lib.MXRNDArraySyncCopyToDouble(handle, out, Array(n), rc))
+    out
+  }
+
+  def set(values: Array[Double]): this.type = {
+    check(rc => lib.MXRNDArraySyncCopyFromDouble(
+      handle, values, Array(values.length), rc))
+    this
+  }
+
+  def copyFrom(other: NDArray): this.type = set(other.toArray)
+
+  def dispose(): Unit = if (owned) {
+    check(rc => lib.MXRNDArrayFree(handle, rc))
+    owned = false
+  }
+
+  def +(other: NDArray): NDArray = NDArray.invoke("elemwise_add", Seq(this, other))
+  def -(other: NDArray): NDArray = NDArray.invoke("elemwise_sub", Seq(this, other))
+  def *(other: NDArray): NDArray = NDArray.invoke("elemwise_mul", Seq(this, other))
+  def /(other: NDArray): NDArray = NDArray.invoke("elemwise_div", Seq(this, other))
+}
+
+object NDArray {
+  def empty(shape: Seq[Int], devType: Int = 1, devId: Int = 0): NDArray = {
+    val h = newHandle()
+    check(rc => lib.MXRNDArrayCreate(shape.toArray, Array(shape.length),
+                                     Array(devType), Array(devId), h, rc))
+    new NDArray(h)
+  }
+
+  def array(values: Array[Double], shape: Seq[Int]): NDArray =
+    empty(shape).set(values)
+
+  def zeros(shape: Seq[Int]): NDArray =
+    array(new Array[Double](shape.product), shape)
+
+  def ones(shape: Seq[Int]): NDArray =
+    array(Array.fill(shape.product)(1.0), shape)
+
+  /** Imperative op invoke; `out` writes in place (sgd_update style). */
+  def invoke(op: String, inputs: Seq[NDArray],
+             params: Map[String, String] = Map.empty,
+             out: Seq[NDArray] = Seq.empty): Seq[NDArray] = {
+    val inBuf = packHandles(inputs.map(_.handle))
+    val keys = if (params.isEmpty) Array("") else params.keys.toArray
+    val vals = if (params.isEmpty) Array("") else keys.map(params)
+    if (out.nonEmpty) {
+      val outBuf = packHandles(out.map(_.handle))
+      check(rc => lib.MXRImperativeInvoke(
+        Array(op), Array(inputs.length), inBuf, Array(out.length),
+        Array(out.length), outBuf, Array(params.size), keys, vals, rc))
+      out
+    } else {
+      val cap = 16
+      val outBuf = new Array[Byte](8 * cap)
+      val nOut = Array(0)
+      check(rc => lib.MXRImperativeInvoke(
+        Array(op), Array(inputs.length), inBuf, nOut, Array(cap),
+        outBuf, Array(params.size), keys, vals, rc))
+      unpackHandles(outBuf, nOut(0)).map(new NDArray(_))
+    }
+  }
+}

@@ -1,0 +1,65 @@
+package ai.mxnettpu
+
+import Base._
+
+/** Declarative graph node (reference counterpart: scala-package core
+  * Symbol.scala). Graphs built here serialize to the same JSON every
+  * other frontend reads.
+  */
+class Symbol private[mxnettpu] (private[mxnettpu] val handle: Array[Byte]) {
+
+  private def list(which: Int): Array[String] = {
+    val (buf, len) = strBuf()
+    check(rc => lib.MXRSymbolList(handle, Array(which), buf, len, rc))
+    splitLines(buf(0))
+  }
+
+  def listArguments(): Array[String] = list(0)
+  def listOutputs(): Array[String] = list(1)
+  def listAuxiliaryStates(): Array[String] = list(2)
+
+  def toJson: String = {
+    val (buf, len) = strBuf(1048576)
+    check(rc => lib.MXRSymbolSaveToJSON(handle, buf, len, rc))
+    buf(0).trim
+  }
+
+  def dispose(): Unit = check(rc => lib.MXRSymbolFree(handle, rc))
+}
+
+object Symbol {
+  def variable(name: String): Symbol = {
+    val h = newHandle()
+    check(rc => lib.MXRSymbolCreateVariable(Array(name), h, rc))
+    new Symbol(h)
+  }
+
+  def loadJson(json: String): Symbol = {
+    val h = newHandle()
+    check(rc => lib.MXRSymbolCreateFromJSON(Array(json), h, rc))
+    new Symbol(h)
+  }
+
+  /** Create an op node and compose its inputs (keyword composition
+    * when `inputs` keys are non-empty).
+    */
+  def create(op: String, attrs: Map[String, String] = Map.empty,
+             inputs: Seq[(String, Symbol)] = Seq.empty,
+             name: String = ""): Symbol = {
+    val keys = if (attrs.isEmpty) Array("") else attrs.keys.toArray
+    val vals = if (attrs.isEmpty) Array("") else keys.map(attrs)
+    val h = newHandle()
+    check(rc => lib.MXRSymbolCreateAtomic(Array(op), Array(attrs.size),
+                                          keys, vals, h, rc))
+    val sym = new Symbol(h)
+    if (inputs.nonEmpty) {
+      val hasKeys = if (inputs.forall(_._1.nonEmpty)) 1 else 0
+      val inNames = inputs.map(_._1).toArray
+      val argBuf = packHandles(inputs.map(_._2.handle))
+      check(rc => lib.MXRSymbolCompose(
+        sym.handle, Array(if (name.isEmpty) op.toLowerCase else name),
+        Array(inputs.length), Array(hasKeys), inNames, argBuf, rc))
+    }
+    sym
+  }
+}

@@ -1,0 +1,37 @@
+package ai.mxnettpu.examples
+
+import ai.mxnettpu._
+
+/** MNIST MLP in pure Scala through the shim tier — the flow the perl
+  * and R bindings run, printing SCALA_MNIST_OK at >=0.95 accuracy.
+  *
+  * Usage:
+  *   MXTPU_CAPI_LIB=.../libmxtpu_c_api.so \
+  *   sbt "runMain ai.mxnettpu.examples.TrainMnist <images> <labels>"
+  */
+object TrainMnist {
+  def main(args: Array[String]): Unit = {
+    require(args.length >= 2, "usage: TrainMnist <images> <labels>")
+    println(s"framework version: ${Base.version()}")
+    Base.randomSeed(0)
+
+    val it = DataIter.mnistIter(args(0), args(1), batchSize = 64)
+
+    val data = Symbol.variable("data")
+    val fc1 = Symbol.create("FullyConnected",
+      Map("num_hidden" -> "64"), Seq("data" -> data), "fc1")
+    val act = Symbol.create("Activation",
+      Map("act_type" -> "relu"), Seq("data" -> fc1), "relu1")
+    val fc2 = Symbol.create("FullyConnected",
+      Map("num_hidden" -> "10"), Seq("data" -> act), "fc2")
+    val net = Symbol.create("SoftmaxOutput", Map.empty,
+      Seq("data" -> fc2), "softmax")
+
+    val mod = new Module(net)
+    mod.fit(it, numEpoch = 12, learningRate = 0.2, momentumArg = 0.9)
+    val acc = mod.score(it)
+    println(f"final accuracy: $acc%.4f")
+    require(acc >= 0.95, s"accuracy $acc below bar")
+    println("SCALA_MNIST_OK")
+  }
+}

@@ -1,0 +1,23 @@
+"""Shared subprocess environment for language-binding tests.
+
+Every binding consumer (R, scala, the generators) spawns a process that
+loads libmxtpu_c_api.so, whose embedded CPython needs the repo and the
+venv's site-packages on PYTHONPATH and a CPU platform pin. One helper
+so the recipe cannot drift between test files.
+"""
+import os
+import sysconfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def subprocess_env(**extra):
+    """os.environ + embedded-CPython paths + CPU pin (+ overrides)."""
+    env = dict(os.environ)
+    paths = sysconfig.get_paths()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [ROOT, paths["purelib"], paths["platlib"],
+                    env.get("PYTHONPATH", "")] if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env

@@ -253,12 +253,9 @@ def test_r_ops_generator_in_sync(tmp_path):
     drift."""
     _lib()  # ensure the library exists for the generator
     out = tmp_path / "ops.generated.R"
-    env = dict(os.environ)
-    paths = sysconfig.get_paths()
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in [ROOT, paths["purelib"], paths["platlib"],
-                    env.get("PYTHONPATH", "")] if p)
-    env["JAX_PLATFORMS"] = "cpu"
+    from tests.binding_env import subprocess_env
+
+    env = subprocess_env()
     r = subprocess.run(
         [sys.executable, os.path.join(PKG, "scripts", "gen_r_ops.py"),
          str(out)],
@@ -280,14 +277,9 @@ def test_r_trains_mnist(tmp_path):
     from tests.test_perl_binding import _write_mnist
 
     imgs, lbls = _write_mnist(tmp_path)
-    env = dict(os.environ)
-    env["MXTPU_CAPI_LIB"] = LIB
-    env["MXTPU_R_PKG"] = PKG
-    paths = sysconfig.get_paths()
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in [ROOT, paths["purelib"], paths["platlib"],
-                    env.get("PYTHONPATH", "")] if p)
-    env["JAX_PLATFORMS"] = "cpu"
+    from tests.binding_env import subprocess_env
+
+    env = subprocess_env(MXTPU_CAPI_LIB=LIB, MXTPU_R_PKG=PKG)
     r = subprocess.run(
         ["Rscript", os.path.join(PKG, "tests", "train_mnist.R"),
          imgs, lbls],

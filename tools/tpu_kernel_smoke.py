@@ -11,6 +11,7 @@ Writes a timestamped record to stdout; exit 0 iff everything compiled
 and matched.
 """
 import argparse
+import os
 import datetime
 import sys
 import traceback
@@ -19,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 from mxnet_tpu.kernels import fused_block as fb  # noqa: E402
 
 
@@ -171,6 +173,18 @@ def main():
                                  out_mask=(x, m_gamma, bias,
                                            bias, m_inv),
                                  interpret=it)))
+
+    # --- VMEM-pressure isolation: the single worst accumulator ---
+    # 3x3x512x512 f32 wgrad accumulation = 9.4 MB resident across the
+    # whole grid. Run it alone so a VMEM overflow is distinguishable
+    # from a structural lowering failure in the smaller cases above.
+    if not args.quick:
+        xb = _rand(ks[0], (2, 8, 8, 512))
+        gb = _rand(ks[1], (2, 8, 8, 512))
+        results.append(run_case(
+            "conv_wgrad k3 s1 VMEM-worst (512->512)",
+            lambda it: fb.conv_wgrad(xb, gb, (3, 3, 512, 512), stride=1,
+                                     interpret=it)))
 
     # --- full bottleneck unit fwd+bwd (train), both stride variants ---
     def unit_case(stride, csq, cin):
