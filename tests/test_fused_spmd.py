@@ -203,6 +203,22 @@ def test_fused_trainstep_mixed_dp_tp_mesh():
                                    atol=2e-6, err_msg=k)
 
 
+def test_parity_catches_dropped_psum(monkeypatch):
+    """Planted bug: run the shard_map bwd with axis=None (no psums —
+    every shard keeps only its local weight-grad/stat contribution).
+    The kernel-level parity test MUST fail, proving it guards the
+    cross-shard reductions and not just shapes."""
+    orig = fb._unit_bwd
+
+    def buggy(stride, eps, interpret, res, g, axis=None, axis_size=1):
+        return orig(stride, eps, interpret, res, g,
+                    axis=None, axis_size=axis_size)
+
+    monkeypatch.setattr(fb, "_unit_bwd", buggy)
+    with pytest.raises(AssertionError):
+        test_bottleneck_spmd_matches_single_device(1, False)
+
+
 def test_init_params_deterministic():
     """Same seed => identical params: init_params must seed the
     module-owned initializer RNG, not just global numpy (regression —
