@@ -21,3 +21,63 @@ def subprocess_env(**extra):
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra)
     return env
+
+
+def assert_balanced_source(path, line_comment="#", block_comment=None,
+                           fname=None):
+    """Structural lint for sources with no local toolchain (R, scala):
+    balanced ()/[]/{} outside strings and comments, no unterminated
+    string. Catches typo-level breakage Rscript/scalac would.
+    ``block_comment``: optional ("/*", "*/") pair (scala/java docs
+    contain apostrophes that must not read as char literals).
+
+    Deliberately simple: no triple-quoted strings, multi-line string
+    literals, scala symbol literals ('foo) or nested block comments —
+    none appear in these source trees; if one is ever added, extend
+    this checker rather than weakening the assert."""
+    fname = fname or os.path.basename(path)
+    text = open(path).read()
+    stack = []
+    pairs = {")": "(", "]": "[", "}": "{"}
+    in_str = None
+    escape = False
+    in_block = False
+    for ln, line in enumerate(text.splitlines(), 1):
+        i = 0
+        while i < len(line):
+            ch = line[i]
+            if in_block:
+                end = line.find(block_comment[1], i)
+                if end < 0:
+                    i = len(line)
+                else:
+                    in_block = False
+                    i = end + len(block_comment[1])
+                continue
+            if in_str:
+                if escape:
+                    escape = False
+                elif ch == "\\":
+                    escape = True
+                elif ch == in_str:
+                    in_str = None
+                i += 1
+                continue
+            if line.startswith(line_comment, i):
+                break
+            if block_comment and line.startswith(block_comment[0], i):
+                in_block = True
+                i += len(block_comment[0])
+                continue
+            if ch in "\"'":
+                in_str = ch
+            elif ch in "([{":
+                stack.append((ch, ln))
+            elif ch in ")]}":
+                assert stack and stack[-1][0] == pairs[ch], (
+                    "%s:%d: unbalanced %r" % (fname, ln, ch))
+                stack.pop()
+            i += 1
+        assert in_str is None, "%s:%d: unterminated string" % (fname, ln)
+    assert not stack, "%s: unclosed %r from line %d" % (
+        fname, stack[-1][0], stack[-1][1])

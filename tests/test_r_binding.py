@@ -287,3 +287,18 @@ def test_r_trains_mnist(tmp_path):
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-3000:]
     assert "R_MNIST_OK" in out, out[-2000:]
+
+
+def test_r_sources_structurally_balanced():
+    """No R interpreter exists here, so pin the next-best invariant:
+    balanced delimiters outside strings/comments in every R source
+    (shared checker: tests/binding_env.assert_balanced_source)."""
+    from tests.binding_env import assert_balanced_source
+
+    r_dir = os.path.join(PKG, "R")
+    count = 0
+    for fname in sorted(os.listdir(r_dir)):
+        if fname.endswith(".R"):
+            assert_balanced_source(os.path.join(r_dir, fname))
+            count += 1
+    assert count >= 10, "expected the full R source set, saw %d" % count

@@ -101,3 +101,22 @@ def test_scala_trains_mnist(tmp_path):
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-3000:]
     assert "SCALA_MNIST_OK" in out, out[-2000:]
+
+
+def test_scala_sources_structurally_balanced():
+    """No JVM here: pin balanced delimiters outside strings/comments
+    across every scala source (incl. the generated Ops.scala) — the
+    typo-level check scalac would otherwise provide."""
+    from tests.binding_env import assert_balanced_source
+
+    src_root = os.path.join(PKG, "core", "src", "main", "scala", "ai",
+                            "mxnettpu")
+    count = 0
+    for dirpath, _dirs, files in os.walk(src_root):
+        for fname in sorted(files):
+            if fname.endswith(".scala"):
+                assert_balanced_source(os.path.join(dirpath, fname),
+                                       line_comment="//",
+                                       block_comment=("/*", "*/"))
+                count += 1
+    assert count >= 8, "expected the full scala source set, saw %d" % count
