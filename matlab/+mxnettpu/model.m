@@ -1,0 +1,145 @@
+classdef model < handle
+%MODEL mxnet_tpu model: load a checkpoint and run forward.
+%
+% Reference counterpart: matlab/+mxnet/model.m (the reference's
+% matlab binding over the C predict API). Same surface here over
+% libmxtpu_predict.so (src/c_predict.cc): load('prefix', epoch)
+% reads prefix-symbol.json + prefix-NNNN.params, forward(data)
+% returns the output activations. Requires MATLAB's foreign-function
+% interface (loadlibrary/calllib — not implemented by GNU Octave,
+% same constraint as the reference binding).
+%
+% Example:
+%   addpath('matlab')
+%   m = mxnettpu.model;
+%   m.load('model/lenet', 12);
+%   probs = m.forward(single(img));
+
+properties
+% the symbol definition, json format
+  symbol
+% raw bytes of the params file
+  params
+% print progress info
+  verbose
+end
+
+properties (Access = private)
+  predictor
+  loaded
+% input size the live predictor was created for (recreate on change)
+  prev_input_size
+end
+
+methods
+  function obj = model()
+  %CONSTRUCTOR
+  obj.predictor = libpointer('voidPtr', 0);
+  obj.verbose = 1;
+  obj.loaded = false;
+  obj.prev_input_size = [];
+  mxnettpu.model.ensure_lib();
+  end
+
+  function delete(obj)
+  %DESTRUCTOR
+  obj.free_predictor();
+  end
+
+  function load(obj, model_prefix, num_epoch)
+  %LOAD read prefix-symbol.json and prefix-NNNN.params
+  obj.symbol = fileread([model_prefix, '-symbol.json']);
+  fid = fopen(sprintf('%s-%04d.params', model_prefix, num_epoch), 'rb');
+  assert(fid >= 0, 'cannot open params file');
+  obj.params = fread(fid, inf, 'uint8=>uint8');
+  fclose(fid);
+  obj.free_predictor();
+  obj.prev_input_size = [];
+  if obj.verbose
+    fprintf('loaded %s (%d param bytes)\n', model_prefix, ...
+            numel(obj.params));
+  end
+  obj.loaded = true;
+  end
+
+  function out = forward(obj, data)
+  %FORWARD run the network on a single-precision input array.
+  %
+  % data follows the matlab convention of the reference binding:
+  % column-major with dims reversed vs the backend row-major shape
+  % (an HxWxCxN image batch enters as matlab size [W H C N]).
+  assert(obj.loaded, 'call load() first');
+  data = single(data);
+  siz = size(data);
+  % reuse the live predictor while the input size is unchanged
+  % (reference pattern: model.m prev_input_size); recreating frees
+  % the old handle first so repeated forwards never leak
+  if ~isequal(siz, obj.prev_input_size)
+    obj.free_predictor();
+    cshape = uint32(fliplr(siz));          % backend row-major shape
+    indptr = uint32([0, numel(cshape)]);
+    keys = {'data'};
+    phandle = libpointer('voidPtrPtr', libpointer('voidPtr', 0));
+    rc = calllib('libmxtpu_predict', 'MXPredCreate', obj.symbol, ...
+                 obj.params, int32(numel(obj.params)), int32(1), ...
+                 int32(0), uint32(1), keys, indptr, cshape, phandle);
+    mxnettpu.model.check(rc, 'MXPredCreate');
+    obj.predictor = phandle.Value;
+    obj.prev_input_size = siz;
+  end
+
+  rc = calllib('libmxtpu_predict', 'MXPredSetInput', obj.predictor, ...
+               'data', data(:), uint32(numel(data)));
+  mxnettpu.model.check(rc, 'MXPredSetInput');
+
+  rc = calllib('libmxtpu_predict', 'MXPredForward', obj.predictor);
+  mxnettpu.model.check(rc, 'MXPredForward');
+
+  % output 0 shape
+  pdim = libpointer('uint32Ptr', uint32(0));
+  pshape = libpointer('uint32PtrPtr', libpointer('uint32Ptr', uint32(0)));
+  rc = calllib('libmxtpu_predict', 'MXPredGetOutputShape', ...
+               obj.predictor, uint32(0), pshape, pdim);
+  mxnettpu.model.check(rc, 'MXPredGetOutputShape');
+  ndim = double(pdim.Value);
+  setdatatype(pshape.Value, 'uint32Ptr', ndim);
+  oshape = double(pshape.Value.Value(1:ndim));
+  n = prod(oshape);
+
+  pout = libpointer('singlePtr', zeros(n, 1, 'single'));
+  rc = calllib('libmxtpu_predict', 'MXPredGetOutput', obj.predictor, ...
+               uint32(0), pout, uint32(n));
+  mxnettpu.model.check(rc, 'MXPredGetOutput');
+  % backend row-major -> matlab column-major with reversed dims
+  out = reshape(pout.Value, fliplr(oshape));
+  end
+end
+
+methods (Access = private)
+  function free_predictor(obj)
+  if obj.predictor.Value ~= 0
+    calllib('libmxtpu_predict', 'MXPredFree', obj.predictor);
+    obj.predictor = libpointer('voidPtr', 0);
+  end
+  end
+end
+
+methods (Static)
+  function ensure_lib()
+  if ~libisloaded('libmxtpu_predict')
+    root = getenv('MXTPU_ROOT');
+    assert(~isempty(root), 'set MXTPU_ROOT to the repo checkout');
+    sofile = fullfile(root, 'mxnet_tpu', 'lib', 'libmxtpu_predict.so');
+    header = fullfile(root, 'src', 'c_predict_api.h');
+    loadlibrary(sofile, header);
+  end
+  end
+
+  function check(rc, name)
+  if rc ~= 0
+    err = calllib('libmxtpu_predict', 'MXGetLastError');
+    error('%s failed: %s', name, err);
+  end
+  end
+end
+end

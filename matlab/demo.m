@@ -1,0 +1,26 @@
+%DEMO classify a synthetic digit with a trained checkpoint.
+%
+% Reference counterpart: matlab/demo.m (inception classification).
+% Train any model with the python frontend and save a checkpoint,
+% e.g.:
+%   python examples/image-classification/train_mnist.py
+% then:
+%   setenv('MXTPU_ROOT', '/path/to/repo')
+%   addpath('matlab'); demo
+
+% required environment: MXTPU_ROOT (repo checkout), MXTPU_DEMO_PREFIX
+% (checkpoint prefix), MXTPU_DEMO_EPOCH (checkpoint epoch number)
+prefix = getenv('MXTPU_DEMO_PREFIX');
+assert(~isempty(prefix), 'set MXTPU_DEMO_PREFIX to a checkpoint prefix');
+epoch = str2double(getenv('MXTPU_DEMO_EPOCH'));
+assert(isfinite(epoch), 'set MXTPU_DEMO_EPOCH to the checkpoint epoch');
+
+m = mxnettpu.model;
+m.load(prefix, epoch);
+
+% a batch of one flat 784-pixel image (the mnist MLP input layout)
+img = rand(784, 1, 'single');
+probs = m.forward(img);
+[p, label] = max(probs(:, 1));
+fprintf('predicted class %d with probability %.4f\n', label - 1, p);
+fprintf('MATLAB_DEMO_OK\n');

@@ -67,6 +67,36 @@ def _save_state(st):
         json.dump(st, f, indent=1)
 
 
+def _foreign_bench_running():
+    """True if a python process whose SCRIPT is bench.py exists outside
+    this watcher. Inspects argv structure rather than grepping command
+    lines — the driver's own prompt text contains the words "python"
+    and "bench.py", so a pgrep -f pattern would false-positive on it."""
+    me = os.getpid()
+    try:
+        kids = subprocess.run(["pgrep", "-P", str(me)],
+                              capture_output=True, text=True, timeout=10)
+        mine = {int(p) for p in kids.stdout.split() if p.strip()}
+    except Exception:
+        mine = set()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == me or pid in mine:
+            continue
+        try:
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if not argv or b"python" not in os.path.basename(argv[0]):
+            continue
+        if any(os.path.basename(a) == b"bench.py" for a in argv[1:3]):
+            return True
+    return False
+
+
 def _probe():
     try:
         r = subprocess.run(
@@ -133,6 +163,16 @@ def main():
                          "tunnel was unhealthy")
     args = ap.parse_args()
     while True:
+        # never contend with a driver-run bench for the (single-client)
+        # tunnel: a probe or stage grabbing the backend while bench.py
+        # initializes could sabotage the round's one real measurement
+        if _foreign_bench_running():
+            print("[%s] bench.py active elsewhere — standing down"
+                  % _now(), flush=True)
+            if args.once:
+                return 1   # keep --once's one-cycle contract
+            time.sleep(60)
+            continue
         st = _load_state()
         if all(st.get(n, {}).get("status") in ("done", "failed")
                for n, _, _ in STAGES):
