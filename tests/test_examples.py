@@ -233,6 +233,14 @@ def test_chinese_text_cnn():
     assert acc > 0.9, out[-1500:]
 
 
+def test_memcost():
+    """Remat memory-cost report (ref example/memcost): all three remat
+    modes compile; conv-remat must not raise temp memory."""
+    out = _run("memcost/memcost.py", "--depth", "20")
+    assert "memcost ok: True" in out, out[-1500:]
+    assert out.count("remat=") >= 3, out[-1500:]
+
+
 @pytest.mark.nightly
 @pytest.mark.parametrize("script,marker", [
     ("nce-loss/toy_nce.py", "NCE_OK"),
