@@ -80,6 +80,22 @@ def _interleave_zeros(v, axis, offset):
     return stacked.reshape(shape)
 
 
+def _subsample2(a, off_r, nr, off_c, nc):
+    """``a[off_r:off_r+2*nr:2, off_c:off_c+2*nc:2, :]`` for a 3D value,
+    Mosaic-safe: jnp multi-axis strided indexing lowers to a >2D gather,
+    which the TPU lowering rejects ("Only 2D gather is supported").
+    Instead take a contiguous even-length slice, split each spatial axis
+    into (count, 2), and select the parity lane with a static unit index.
+    When ``off + 2*count`` overruns by one (the dy=2 halo case), shift the
+    window one left — the selected elements are the same, at parity 1."""
+    rows, cols, ch = a.shape
+    sr = off_r if off_r + 2 * nr <= rows else off_r - 1
+    sc = off_c if off_c + 2 * nc <= cols else off_c - 1
+    a = a[sr:sr + 2 * nr, sc:sc + 2 * nc, :]
+    a = a.reshape(nr, 2, nc, 2, ch)
+    return a[:, off_r - sr, :, off_c - sc, :]
+
+
 def _apply_prologue(x, pro, compute_dtype):
     """BN-apply (+ ReLU) on a VMEM-resident value, f32 math."""
     if pro is None:
@@ -117,7 +133,7 @@ def _nine_shift_matmul(hp, w_ref, th_out, w_out, stride):
             if stride == 1:
                 xs = hp[dy:dy + th_out, dx:dx + w_out, :]
             else:
-                xs = hp[dy:dy + 2 * th_out - 1:2, dx:dx + 2 * w_out - 1:2, :]
+                xs = _subsample2(hp, dy, th_out, dx, w_out)
             acc += jnp.dot(xs.reshape(th_out * w_out, ci), w_ref[dy, dx],
                            preferred_element_type=jnp.float32)
     return acc
@@ -125,13 +141,22 @@ def _nine_shift_matmul(hp, w_ref, th_out, w_out, stride):
 
 def _accumulate_out(ref, value, is_first):
     """Accumulate into an output ref revisited across the whole grid."""
+    _accumulate_slot(ref, ..., value, is_first)
+
+
+def _accumulate_slot(ref, idx, value, is_first):
+    """Accumulate into one static (dy, dx) slot of a revisited (k, k, Ci,
+    Co) output ref. Writing tap-by-tap keeps peak VMEM at one (Ci, Co)
+    partial instead of materializing all k*k taps before the store — the
+    stacked form overflowed the 16 MB scoped-vmem limit at 3x3x512x512
+    (9.4 MB accumulator + 9.4 MB stacked taps)."""
     @pl.when(is_first)
     def _():
-        ref[...] = value
+        ref[idx] = value
 
     @pl.when(jnp.logical_not(is_first))
     def _():
-        ref[...] = ref[...] + value
+        ref[idx] = ref[idx] + value
 
 
 def _vec_spec(cdim):
@@ -244,7 +269,7 @@ def conv_fwd(x, w, *, stride=1, prologue=None, emit_stats=False,
         else:
             hv = _apply_prologue(xc, pro, dtype)
             if stride == 2:
-                hv = hv[0::2, 0::2, :]
+                hv = _subsample2(hv, 0, th, 0, wo)
             acc = jnp.dot(hv.reshape(th * wo, ci), w_ref[0, 0],
                           preferred_element_type=jnp.float32)
 
@@ -354,27 +379,26 @@ def conv_wgrad(x, g_parts, w_shape, *, stride=1, x_prologue=None,
             hv = _apply_prologue(xin, pro, dtype)
             hv = _mask_halo_rows(hv, i, top_bad=True, bottom_bad=(stride == 1))
             hp = _pad_w(hv)
-            dws = []
             for dy in range(3):
                 for dx in range(3):
                     if stride == 1:
                         xs = hp[dy:dy + th, dx:dx + wo, :]
                     else:
-                        xs = hp[dy:dy + 2 * th - 1:2, dx:dx + 2 * wo - 1:2, :]
-                    dws.append(jax.lax.dot_general(
+                        xs = _subsample2(hp, dy, th, dx, wo)
+                    cur = jax.lax.dot_general(
                         xs.reshape(th * wo, ci), gf,
                         dimension_numbers=(((0,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32))
-            dw = jnp.stack(dws).reshape(3, 3, ci, co)
+                        preferred_element_type=jnp.float32)
+                    _accumulate_slot(dw_ref, (dy, dx), cur, is_first)
         else:
             hv = _apply_prologue(xc, pro, dtype)
             if stride == 2:
-                hv = hv[0::2, 0::2, :]
+                hv = _subsample2(hv, 0, th, 0, wo)
             dw = jax.lax.dot_general(
                 hv.reshape(th * wo, ci), gf,
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32).reshape(1, 1, ci, co)
-        _accumulate_out(dw_ref, dw, is_first)
+            _accumulate_out(dw_ref, dw, is_first)
 
     return pl.pallas_call(
         kernel,

@@ -38,10 +38,26 @@ def _close(a, b, tol):
 
 _COMPILED = False  # interpret= value for the "compiled" side; main() may
 # set it to None (auto) in --cpu plumbing-validation mode
+_LOWER_ONLY = False  # --lower: cross-lower for TPU on the CPU host
 
 
 def run_case(name, fn, tol=2e-2):
     """fn(interpret) -> pytree of arrays. Compare TPU vs interpret."""
+    if _LOWER_ONLY:
+        # Mosaic lowering (jaxpr -> TPU MLIR) happens at lowering time,
+        # not execution time, so cross-lowering on the CPU host catches
+        # every "NotImplementedError: ..." class of failure without a
+        # tunnel window. It cannot catch VMEM overflows or mosaic-to-LLO
+        # compile errors — those still need the on-chip run.
+        try:
+            jax.jit(lambda: fn(False)).trace().lower(
+                lowering_platforms=("tpu",))
+            print(f"LOWER-OK {name}")
+            return True
+        except Exception:
+            tb = traceback.format_exc()
+            print(f"LOWER-FAIL {name}\n{tb[-1500:]}")
+            return False
     try:
         got = jax.tree.map(np.asarray, fn(_COMPILED))
     except Exception:
@@ -71,16 +87,21 @@ def main():
                     help="plumbing validation off-TPU: runs every case "
                          "interpret-vs-interpret so shape/arg bugs in the "
                          "harness itself surface without a tunnel window")
+    ap.add_argument("--lower", action="store_true",
+                    help="Mosaic lowering check off-TPU: cross-lower every "
+                         "case for the tpu platform on the CPU host; "
+                         "catches lowering-rule failures without a tunnel")
     args = ap.parse_args()
 
-    if args.cpu:
+    if args.cpu or args.lower:
         jax.config.update("jax_platforms", "cpu")
-        global _COMPILED
+        global _COMPILED, _LOWER_ONLY
         _COMPILED = None  # auto-interpret off-TPU
+        _LOWER_ONLY = args.lower
     print("timestamp:", datetime.datetime.now(datetime.timezone.utc)
           .isoformat())
     print("backend:", jax.default_backend(), jax.devices())
-    if jax.default_backend() != "tpu" and not args.cpu:
+    if jax.default_backend() != "tpu" and not (args.cpu or args.lower):
         print("NOT ON TPU — smoke is meaningless; aborting")
         return 2
 
