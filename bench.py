@@ -70,7 +70,9 @@ def _measure(variant):
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
 
-    for per_dev_batch in (256, 128, 64, 32):
+    # 512 measured fastest on v5e (2690 img/s vs 2648 at 256, 2560 at
+    # 1024 — TPU_EVIDENCE/ and PROFILE.md round-5 second window)
+    for per_dev_batch in (512, 256, 128, 64, 32):
         batch = per_dev_batch * n_dev
         try:
             ts = TrainStep(

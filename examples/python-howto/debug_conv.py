@@ -1,0 +1,36 @@
+"""How-to: bind a tiny conv net by hand and inspect every array.
+
+Mirrors the reference's example/python-howto/debug_conv.py: skip
+Module, simple_bind the symbol directly, poke inputs, and read
+intermediate shapes — the executor-level debugging workflow.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+data = mx.sym.Variable("data")
+conv = mx.sym.Convolution(data, name="conv1", num_filter=8,
+                          kernel=(3, 3), pad=(1, 1))
+act = mx.sym.Activation(conv, name="relu1", act_type="relu")
+pool = mx.sym.Pooling(act, name="pool1", kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+
+# shape inference before any binding
+arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 8, 8))
+print("args:", dict(zip(pool.list_arguments(), arg_shapes)))
+print("out: ", out_shapes)
+assert out_shapes[0] == (2, 8, 4, 4)
+
+ex = pool.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+ex.arg_dict["data"][:] = x
+ex.arg_dict["conv1_weight"][:] = 0.1
+ex.arg_dict["conv1_bias"][:] = 0.0
+ex.forward(is_train=False)
+out = ex.outputs[0].asnumpy()
+print("output shape:", out.shape, "max:", out.max())
+assert out.shape == (2, 8, 4, 4)
+assert (out >= 0).all(), "relu output must be non-negative"
+# all 8 filters share the same weights, so their maps must agree
+assert np.allclose(out[:, 0], out[:, 1], atol=1e-5)
+print("DEBUG_CONV_OK")

@@ -1,0 +1,35 @@
+"""Torch plugin: call torch kernels as framework operators.
+
+Mirrors the reference's example/torch/torch_function.py behavior (it
+drives lua-torch tensor functions through mxnet.th): here any
+``torch.*`` / ``torch.nn.functional.*`` function runs as a Custom op
+via the plugin bridge — imperatively on NDArrays or inside a Symbol
+graph — with backward flowing through torch.autograd.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+import plugin.torch.torch_module  # noqa: F401  registers 'torch_op'
+
+x = mx.nd.array(np.linspace(-2, 2, 9, dtype=np.float32).reshape(3, 3))
+
+# imperative: run torch ops through the symbolic bridge one node deep
+sym_x = mx.sym.Variable("x")
+for fn, ref in [("relu", np.maximum(x.asnumpy(), 0)),
+                ("tanh", np.tanh(x.asnumpy())),
+                ("sigmoid", 1 / (1 + np.exp(-x.asnumpy())))]:
+    s = mx.sym.Custom(sym_x, op_type="torch_op", fn=fn)
+    ex = s.bind(mx.cpu(), {"x": x})
+    got = ex.forward()[0].asnumpy()
+    assert np.allclose(got, ref, atol=1e-5), fn
+    print("torch %s matches numpy reference" % fn)
+
+# two-arg torch function
+a = mx.nd.array(np.full((2, 2), 3.0, np.float32))
+b = mx.nd.array(np.full((2, 2), 4.0, np.float32))
+s = mx.sym.Custom(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                  op_type="torch_op", fn="mul", num_args=2)
+got = s.bind(mx.cpu(), {"a": a, "b": b}).forward()[0].asnumpy()
+assert np.allclose(got, 12.0)
+print("torch mul(a, b) =", got[0, 0])
+print("TORCH_FUNCTION_OK")

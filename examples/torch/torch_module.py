@@ -1,0 +1,43 @@
+"""Torch plugin: a torch activation inside a trained Module.
+
+Mirrors the reference's example/torch/torch_module.py behavior (an
+mxnet MLP whose middle layers are lua-torch nn modules): the hidden
+activation here is torch's gelu running through the plugin bridge,
+trained end to end — backward crosses framework boundaries twice per
+step (XLA -> torch.autograd -> XLA).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+import plugin.torch.torch_module  # noqa: F401  registers 'torch_op'
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 1000
+    x = rng.randn(n, 30).astype(np.float32)
+    w = rng.randn(30, 6).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, {"softmax_label": y},
+                           batch_size=100, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=48)
+    net = mx.sym.Custom(net, op_type="torch_op", fn="gelu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=6)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="acc", num_epoch=8)
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    print("train accuracy with torch gelu: %.4f" % acc)
+    assert acc > 0.9, "torch-activation MLP failed to learn"
+    print("TORCH_MODULE_OK")
+
+
+if __name__ == "__main__":
+    main()

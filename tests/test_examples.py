@@ -109,9 +109,21 @@ def test_autoencoder():
     ("adversary/fgsm.py", "FGSM_OK"),
     ("dec/dec.py", "DEC_OK"),
     ("bayesian-methods/sgld_logistic.py", "SGLD_OK"),
+    # round-5 saturation of the reference example tree: module/,
+    # python-howto/, torch/ (plugin bridge), caffe/ (converter bridge)
+    ("module/mnist_mlp.py", "MODULE_MLP_OK"),
+    ("module/sequential_module.py", "SEQUENTIAL_MODULE_OK"),
+    ("module/python_loss.py", "PYTHON_LOSS_OK"),
+    ("python-howto/data_iter.py", "DATA_ITER_OK"),
+    ("python-howto/debug_conv.py", "DEBUG_CONV_OK"),
+    ("python-howto/monitor_weights.py", "MONITOR_WEIGHTS_OK"),
+    ("python-howto/multiple_outputs.py", "MULTIPLE_OUTPUTS_OK"),
+    ("torch/torch_function.py", "TORCH_FUNCTION_OK"),
+    ("torch/torch_module.py", "TORCH_MODULE_OK"),
+    ("caffe/caffe_net.py", "CAFFE_NET_OK"),
 ])
 def test_example_domain(script, marker):
-    """Round-4 domain families (ref example/<domain>): each script is
+    """Domain families (ref example/<domain>): each script is
     self-verifying (asserts its own learning outcome) and prints a
     marker on success."""
     out = _run(script, timeout=900)
@@ -255,3 +267,5 @@ def test_example_domain_nightly(script, marker):
     REINFORCE) run on the nightly tier."""
     out = _run(script, timeout=900)
     assert marker in out, out[-1500:]
+
+
