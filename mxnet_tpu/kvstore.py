@@ -17,6 +17,7 @@ XLA ops between reduce and update.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -593,5 +594,12 @@ def create(name="local"):
     if name in ("tpu", "dist_sync_tpu"):
         return TPUKVStore(name)
     if name.startswith("dist"):
+        uri = os.environ.get("MXNET_PS_SERVER_URI")
+        if name == "dist_async" and uri:
+            # true server-side-optimizer tier (ref dist_async contract):
+            # pushes apply on arrival at the parameter server
+            from .kvstore_server import ServerKVStore
+
+            return ServerKVStore(uri, name)
         return DistKVStore(name)
     raise MXNetError("unknown kvstore type %r" % name)

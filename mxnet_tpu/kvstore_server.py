@@ -1,28 +1,466 @@
-"""KVStore server entry point — serverless-parity shim.
+"""KVStore parameter server: a real server-side-optimizer tier, plus
+the serverless-parity shim.
 
 Reference counterpart: ``python/mxnet/kvstore_server.py`` (the server
-main loop driven by DMLC_ROLE=server, executing optimizer updates on
-sharded keys; kvstore_dist_server.h:113). The TPU backend has **no
-server processes** — aggregation is an XLA all-reduce over the device
-mesh and the optimizer runs replicated (or ZeRO-sharded) on workers
-(see kvstore.DistKVStore, parallel/spmd.py zero=True).
+main loop driven by DMLC_ROLE=server) and ``kvstore_dist_server.h``
+(merge buffers + server-executed optimizer, :113-500).
 
-This module keeps reference launch scripts working: a process started
-with DMLC_ROLE=server or =scheduler exits immediately with success
-(the jax coordinator, spawned inside worker 0's process, already plays
-the scheduler's rendezvous role).
+Two tiers, chosen by configuration:
+
+1. **Serverless (TPU default).** Aggregation is an XLA all-reduce over
+   the device mesh and the optimizer runs replicated on workers (see
+   kvstore.DistKVStore, parallel/spmd.py zero=True). A process started
+   with DMLC_ROLE=server/scheduler and no server opt-in exits 0 so
+   reference launch scripts keep working — the jax coordinator (spawned
+   inside worker 0) already plays the scheduler's rendezvous role.
+
+2. **Real server (``MXNET_KVSTORE_SERVER=1``).** ``KVStoreServer``
+   holds the weights, applies pushes through a server-side optimizer
+   (exactly the reference's dist_async contract: each worker's push is
+   applied when it arrives — no global synchronisation — and pulls
+   return the freshest weights), and answers pulls/barriers over a
+   length-prefixed TCP protocol. ``kvstore.create('dist_async')``
+   connects to it when ``MXNET_PS_SERVER_URI`` is set (see
+   ``ServerKVStore``). This is the behavioral equivalent of the
+   reference's server-side-optimizer mode, runnable on CPU hosts.
+
+Protocol: 4-byte big-endian length + payload. Payloads are tuples
+``(op, key, meta, raw_bytes)`` encoded with pickle but decoded by a
+restricted unpickler — arrays travel as (dtype, shape, bytes), never
+as pickled objects, and the unpickler refuses every global lookup.
+Like the reference's ps-lite transport this is an in-cluster protocol
+with no auth; do not expose the port beyond the job.
 """
 from __future__ import annotations
 
+import io
 import os
+import pickle
+import socket
+import struct
 import sys
+import threading
+
+import numpy as np
 
 
+# ---------------------------------------------------------------------------
+# wire helpers
+# ---------------------------------------------------------------------------
+class _SafeUnpickler(pickle.Unpickler):
+    """Only plain data crosses the wire: refuse every global lookup."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            "kvstore_server protocol carries data only (%s.%s refused)"
+            % (module, name))
+
+
+def _pack(obj):
+    return pickle.dumps(obj, protocol=4)
+
+
+def _unpack(raw):
+    return _SafeUnpickler(io.BytesIO(raw)).load()
+
+
+def _send_msg(sock, obj):
+    raw = _pack(obj)
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("kvstore_server: peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    return _unpack(_recv_exact(sock, n))
+
+
+def _arr_to_wire(a):
+    a = np.ascontiguousarray(a)
+    return (str(a.dtype), a.shape, a.tobytes())
+
+
+def _arr_from_wire(w):
+    dtype, shape, raw = w
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+class KVStoreServer:
+    """Weights + server-side optimizer behind a TCP endpoint.
+
+    Mirrors kvstore_dist_server.h semantics: ``init`` is first-writer-
+    wins, each ``push`` is applied on arrival under the server's
+    updater (optimizer state lives server-side, keyed like the
+    reference's per-key store), ``pull`` returns the current weights,
+    ``barrier`` blocks until every worker arrives. dist_async = push
+    without waiting for the barrier.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1):
+        self._store = {}
+        self._updater = None
+        self._opt_config = None
+        self._lock = threading.Lock()
+        self._num_workers = num_workers
+        self._barrier_cond = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.addr = "%s:%d" % self._sock.getsockname()[:2]
+
+    # -- op handlers --------------------------------------------------------
+    def _apply_push(self, key, grad):
+        with self._lock:
+            if key not in self._store:
+                raise KeyError("push before init: %r" % (key,))
+            if self._updater is None:
+                self._store[key] += grad
+            else:
+                from .ndarray import array
+
+                w = array(self._store[key])
+                self._updater(key, array(grad), w)
+                self._store[key] = w.asnumpy()
+
+    def _set_optimizer(self, name, kwargs):
+        from . import optimizer
+
+        with self._lock:
+            if self._opt_config is not None:
+                # first-writer-wins, like init: every worker's
+                # init_optimizer sends the config (module.py:349 has no
+                # rank gate), and replacing the updater would wipe the
+                # accumulated momentum/Adam state mid-training. A
+                # *different* config is a real job misconfiguration.
+                if self._opt_config != (name, kwargs):
+                    raise ValueError(
+                        "conflicting server optimizer: have %r, got %r"
+                        % (self._opt_config, (name, kwargs)))
+                return
+            opt = optimizer.create(name, **kwargs)
+            self._updater = optimizer.get_updater(opt)
+            self._opt_config = (name, kwargs)
+
+    def _barrier(self):
+        with self._barrier_cond:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self._num_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cond.notify_all()
+                return
+            while self._barrier_gen == gen and not self._stop.is_set():
+                self._barrier_cond.wait(timeout=0.5)
+
+    def _dispatch(self, op, key, meta, wire):
+        """One op -> ('ok', payload). Raises on bad requests; _handle
+        converts that to the protocol's ('err', text) reply."""
+        if op == "init":
+            with self._lock:
+                self._store.setdefault(key, _arr_from_wire(wire))
+            return None
+        if op == "push":
+            self._apply_push(key, _arr_from_wire(wire))
+            return None
+        if op == "pull":
+            with self._lock:
+                if key not in self._store:
+                    raise KeyError("pull before init: %r" % (key,))
+                return _arr_to_wire(self._store[key])
+        if op == "set_optimizer":
+            self._set_optimizer(key, meta)
+            return None
+        if op == "barrier":
+            self._barrier()
+            return None
+        if op == "save_opt":
+            with self._lock:
+                if self._updater is None:
+                    raise ValueError("no server optimizer installed")
+                return self._updater.get_states()
+        if op == "load_opt":
+            with self._lock:
+                if self._updater is None:
+                    raise ValueError("no server optimizer installed")
+                self._updater.set_states(wire)
+            return None
+        raise ValueError("unknown op %r" % (op,))
+
+    def _handle(self, conn):
+        try:
+            while not self._stop.is_set():
+                op, key, meta, wire = _recv_msg(conn)
+                if op == "stop":
+                    _send_msg(conn, ("ok", None))
+                    self.shutdown()
+                    return
+                try:
+                    payload = self._dispatch(op, key, meta, wire)
+                except Exception as e:  # bad request: reply, keep serving
+                    _send_msg(conn, ("err", "%s: %s"
+                                     % (type(e).__name__, e)))
+                    continue
+                _send_msg(conn, ("ok", payload))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def serve_forever(self):
+        """Accept loop; returns after a client sends ``stop``."""
+        self._sock.settimeout(0.5)
+        threads = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=2)
+
+    def serve_in_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._stop.set()
+        with self._barrier_cond:
+            self._barrier_cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class ServerKVStore:
+    """KVStore client speaking to a KVStoreServer (dist_async tier).
+
+    Constructed by ``kvstore.create('dist_async')`` when
+    ``MXNET_PS_SERVER_URI`` is set. API-compatible with the in-process
+    KVStore for the dense ops the server tier covers; the optimizer
+    runs SERVER-side (``set_optimizer``), so ``push`` sends raw
+    gradients and ``pull`` returns updated weights — the reference's
+    dist_async worker loop (kvstore_dist.h push/pull RPCs).
+    """
+
+    server_side = True  # Module: route updates through the server, not
+    # the fused SPMD step (the server IS the update engine here)
+
+    def __init__(self, uri, kv_type="dist_async"):
+        self.type = kv_type
+        host, port = uri.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=60)
+        self._wlock = threading.Lock()
+
+    @property
+    def num_workers(self):
+        return int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                                  os.environ.get("DMLC_NUM_WORKER", "1")))
+
+    @property
+    def rank(self):
+        return int(os.environ.get("MXNET_TPU_WORKER_ID",
+                                  os.environ.get("DMLC_RANK", "0")))
+
+    def _rpc(self, op, key=None, meta=None, wire=None):
+        with self._wlock:
+            _send_msg(self._sock, (op, key, meta, wire))
+            status, payload = _recv_msg(self._sock)
+        if status != "ok":
+            from .base import MXNetError
+
+            raise MXNetError("kvstore_server: %s" % (payload,))
+        return payload
+
+    @staticmethod
+    def _np(value):
+        return value.asnumpy() if hasattr(value, "asnumpy") \
+            else np.asarray(value)
+
+    def _merged(self, value):
+        """A per-device list reduces to one array before crossing the
+        wire (the local Comm::Reduce step of the reference worker)."""
+        if isinstance(value, (list, tuple)):
+            arrs = [self._np(v) for v in value]
+            return arrs[0] if len(arrs) == 1 else np.sum(arrs, axis=0)
+        return self._np(value)
+
+    def init(self, key, value):
+        for k, v in _iter_kv(key, value):
+            self._rpc("init", k, None, _arr_to_wire(self._merged(v)))
+
+    def push(self, key, value, priority=0):
+        for k, v in _iter_kv(key, value):
+            self._rpc("push", k, None, _arr_to_wire(self._merged(v)))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .base import MXNetError
+
+        if out is None:
+            raise MXNetError("kvstore.pull requires out=")
+        for k, o in _iter_kv(key, out):
+            w = _arr_from_wire(self._rpc("pull", k))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t[:] = w
+
+    def set_optimizer(self, optimizer_or_name, **kwargs):
+        """Install the server-side optimizer (ref: the worker sends its
+        serialized optimizer to every server, kvstore.cc
+        set_optimizer). Accepts a name + kwargs or an Optimizer
+        instance — its scalar hyperparameters (matched against the
+        subclass __init__ signature) travel; optimizer STATE lives only
+        on the server, and non-scalar config (lr schedulers, param
+        dicts) stays worker-side by design."""
+        if isinstance(optimizer_or_name, str):
+            name, kw = optimizer_or_name, kwargs
+        else:
+            import inspect
+
+            opt = optimizer_or_name
+            name = type(opt).__name__.lower()
+            kw = dict(kwargs)
+            for klass in type(opt).__mro__:           # subclass kwargs ride
+                if not hasattr(klass, "__init__"):    # **kwargs to the base
+                    continue
+                try:
+                    params = inspect.signature(klass.__init__).parameters
+                except (TypeError, ValueError):
+                    continue
+                for p in params:
+                    attr = "lr" if p == "learning_rate" else p
+                    if p in ("self", "args", "kwargs") \
+                            or not hasattr(opt, attr):
+                        continue
+                    v = getattr(opt, attr)
+                    if isinstance(v, (int, float, str, bool)):
+                        kw.setdefault(p, v)
+        self._rpc("set_optimizer", name, kw)
+
+    def set_gradient_compression(self, compression_params):
+        from .base import MXNetError
+
+        raise MXNetError("the server tier does not implement gradient "
+                         "compression; use the serverless dist tiers")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Server-side optimizer state -> local file (the
+        update_on_kvstore branch of Module.save_optimizer_states,
+        module.py:475)."""
+        states = self._rpc("save_opt")
+        with open(fname, "wb") as f:
+            f.write(states)
+
+    def load_optimizer_states(self, fname):
+        """Local file -> server-side optimizer state. The blob is the
+        server's own Updater serialization; it is unpickled SERVER-side
+        with the same trust as any locally-loaded checkpoint file."""
+        with open(fname, "rb") as f:
+            self._rpc("load_opt", wire=f.read())
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense-backed row_sparse_pull (the server stores dense
+        weights): fetch the full value once, then materialize the
+        requested rows per out, matching kvstore_local.h PullRowSparse
+        semantics (unique-sorted ids)."""
+        from .base import MXNetError
+
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        from .ndarray import ndarray as nd
+        from .ndarray.sparse import RowSparseNDArray
+
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o in _iter_kv(key, out):
+            w = _arr_from_wire(self._rpc("pull", k))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            if len(rids) == 1 and len(targets) > 1:
+                rids = list(rids) * len(targets)
+            for t, rid in zip(targets, rids):
+                ids = np.unique(np.asarray(
+                    rid.asnumpy() if hasattr(rid, "asnumpy") else rid,
+                    np.int64))
+                ids = np.clip(ids, 0, w.shape[0] - 1)
+                taken = nd.array(w[ids])
+                if isinstance(t, RowSparseNDArray):
+                    newo = RowSparseNDArray(taken, nd.array(ids),
+                                            w.shape, ctx=t.ctx)
+                    t._rebind_sparse(newo)
+                else:
+                    dense = np.zeros(w.shape, w.dtype)
+                    dense[ids] = w[ids]
+                    t[:] = dense
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def stop_server(self):
+        self._rpc("stop")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _iter_kv(key, value):
+    """Pair keys with values. A single key takes the WHOLE value (which
+    may be a per-device list); a key list zips positionally."""
+    if isinstance(key, (list, tuple)):
+        for k, v in zip(key, value):
+            yield str(k), v
+    else:
+        yield str(key), value
+
+
+# ---------------------------------------------------------------------------
+# entry point (DMLC_ROLE dispatch)
+# ---------------------------------------------------------------------------
 def _init_kvstore_server_module():
     role = os.environ.get("DMLC_ROLE", "worker").lower()
-    if role in ("server", "scheduler"):
-        # serverless backend: nothing to run (see module docstring)
+    if role not in ("server", "scheduler"):
+        return
+    if role == "server" and os.environ.get("MXNET_KVSTORE_SERVER") == "1":
+        host = os.environ.get("MXNET_PS_BIND_HOST", "127.0.0.1")
+        port = int(os.environ.get("MXNET_PS_BIND_PORT",
+                                  os.environ.get("DMLC_PS_ROOT_PORT", "0")))
+        nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                                os.environ.get("DMLC_NUM_WORKER", "1")))
+        server = KVStoreServer(host=host, port=port, num_workers=nw)
+        print("kvstore_server listening on %s" % server.addr, flush=True)
+        server.serve_forever()
         sys.exit(0)
+    # serverless tier: nothing to run (see module docstring)
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -306,6 +306,7 @@ class Module(BaseModule):
         self._fused = None
         fused_types = ("tpu", "dist_sync", "dist_sync_device", "dist_async")
         if (kvstore is not None and kvstore.type in fused_types
+                and not getattr(kvstore, "server_side", False)
                 and self.for_training):
             from .spmd_group import FusedSPMDGroup
 

@@ -1,0 +1,277 @@
+"""The real parameter-server tier (mxnet_tpu/kvstore_server.py).
+
+Reference bar: kvstore_dist_server.h:113-500 — server-held weights,
+server-side optimizer applied per arriving push (dist_async), barrier
+across workers — and python/mxnet/kvstore_server.py (the DMLC_ROLE
+entry point). The serverless shim behavior (exit 0 without opt-in) is
+covered by tests/test_dist.py::test_kvstore_server_role_shim.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.kvstore_server import (KVStoreServer, ServerKVStore,
+                                      _SafeUnpickler, _pack)
+
+
+@pytest.fixture
+def server():
+    srv = KVStoreServer(num_workers=2)
+    srv.serve_in_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_push_pull_default_sum(server):
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((3,), np.float32))
+    kv.push("w", np.array([1.0, 2.0, 3.0], np.float32))
+    kv.push("w", np.array([1.0, 1.0, 1.0], np.float32))
+    out = np.empty((3,), np.float32)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out, [2.0, 3.0, 4.0])
+    kv.close()
+
+
+def test_server_side_optimizer_matches_local_sgd(server):
+    """Server-applied SGD must equal the local updater doing the same
+    sequence — the server-side-optimizer contract."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 5).astype(np.float32)
+    grads = [rng.randn(4, 5).astype(np.float32) for _ in range(4)]
+
+    kv = ServerKVStore(server.addr)
+    kv.init("0", w0)
+    kv.set_optimizer("sgd", learning_rate=0.1)
+    for g in grads:
+        kv.push("0", g)
+    got = np.empty_like(w0)
+    kv.pull("0", out=got)
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0)
+    for g in grads:
+        upd("0", mx.nd.array(g), w)
+    np.testing.assert_allclose(got, w.asnumpy(), rtol=1e-5, atol=1e-6)
+    kv.close()
+
+
+def test_async_pushes_from_two_workers(server):
+    """dist_async semantics: two clients push concurrently with no
+    barrier between pushes; every push lands exactly once (sum-updates
+    commute, so the final value is order-independent)."""
+    kv0 = ServerKVStore(server.addr)
+    kv0.init("w", np.zeros((8,), np.float32))
+
+    def worker(seed):
+        kv = ServerKVStore(server.addr)
+        rng = np.random.RandomState(seed)
+        for _ in range(20):
+            kv.push("w", rng.rand(8).astype(np.float32))
+        kv.barrier()
+        kv.close()
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in (1, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+
+    expect = np.zeros((8,), np.float32)
+    for s in (1, 2):
+        rng = np.random.RandomState(s)
+        for _ in range(20):
+            expect += rng.rand(8).astype(np.float32)
+    got = np.empty((8,), np.float32)
+    kv0.pull("w", out=got)
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+    kv0.close()
+
+
+def test_factory_routes_dist_async_to_server(server, monkeypatch):
+    import mxnet_tpu as mx
+
+    monkeypatch.setenv("MXNET_PS_SERVER_URI", server.addr)
+    kv = mx.kvstore.create("dist_async")
+    assert isinstance(kv, ServerKVStore)
+    kv.init("k", np.ones((2,), np.float32))
+    out = np.empty((2,), np.float32)
+    kv.pull("k", out=out)
+    np.testing.assert_allclose(out, 1.0)
+    kv.close()
+
+
+def test_module_fit_through_server(server, monkeypatch):
+    """The user-facing path: Module(kvstore='dist_async') with a server
+    URI routes every update through the server-side optimizer (no fused
+    SPMD step) and still learns the task."""
+    import mxnet_tpu as mx
+
+    monkeypatch.setenv("MXNET_PS_SERVER_URI", server.addr)
+    rng = np.random.RandomState(0)
+    n = 600
+    x = rng.randn(n, 20).astype(np.float32)
+    w = rng.randn(20, 5).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, {"softmax_label": y},
+                           batch_size=100, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=5)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            kvstore="dist_async", eval_metric="acc", num_epoch=8)
+    assert isinstance(mod._kvstore, ServerKVStore)
+    assert mod._update_on_kvstore
+    assert mod._fused is None, "server tier must bypass the fused step"
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.9, "server-side-optimizer training failed: %s" % acc
+
+
+def test_entrypoint_serves_when_opted_in(tmp_path):
+    """DMLC_ROLE=server + MXNET_KVSTORE_SERVER=1 runs a live server
+    process; a client trains a key through it, then stops it."""
+    env = dict(os.environ)
+    env.update(DMLC_ROLE="server", MXNET_KVSTORE_SERVER="1",
+               MXNET_PS_BIND_PORT="0", JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "listening on" in line, line
+        addr = line.strip().rsplit(" ", 1)[-1]
+        kv = ServerKVStore(addr)
+        kv.init("w", np.full((2,), 5.0, np.float32))
+        kv.set_optimizer("sgd", learning_rate=1.0)
+        kv.push("w", np.ones((2,), np.float32))
+        out = np.empty((2,), np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, 4.0)  # 5 - 1.0*grad
+        kv.stop_server()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        proc.kill()
+
+
+def test_bad_requests_get_error_replies(server):
+    """Protocol errors reply ('err', ...) and keep the connection
+    alive — a typo'd key must not kill the worker's kvstore link."""
+    import mxnet_tpu as mx
+
+    kv = ServerKVStore(server.addr)
+    out = np.empty((2,), np.float32)
+    with pytest.raises(mx.MXNetError, match="pull before init"):
+        kv.pull("missing", out=out)
+    with pytest.raises(mx.MXNetError, match="not registered|Unknown|unknown"):
+        kv.set_optimizer("not_an_optimizer")
+    # connection still serves after both errors
+    kv.init("w", np.ones((2,), np.float32))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out, 1.0)
+    kv.close()
+
+
+def test_set_optimizer_first_writer_wins(server):
+    """Every worker sends set_optimizer (module.py:349); repeats with
+    the same config must NOT reset server-side momentum state."""
+    import mxnet_tpu as mx
+
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((2,), np.float32))
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+    kv.push("w", np.ones((2,), np.float32))
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)  # worker 2
+    kv.push("w", np.ones((2,), np.float32))
+    got = np.empty((2,), np.float32)
+    kv.pull("w", out=got)
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.zeros((2,))
+    for _ in range(2):
+        upd("w", mx.nd.ones((2,)), w)
+    np.testing.assert_allclose(got, w.asnumpy(), rtol=1e-5)
+    # a DIFFERENT config is a misconfiguration -> error reply
+    with pytest.raises(mx.MXNetError, match="conflicting"):
+        kv.set_optimizer("sgd", learning_rate=0.5)
+    kv.close()
+
+
+def test_optimizer_state_roundtrip(server, tmp_path):
+    """save/load_optimizer_states moves the SERVER-side momentum."""
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((3,), np.float32))
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+    kv.push("w", np.ones((3,), np.float32))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    w_at_save = np.empty((3,), np.float32)
+    kv.pull("w", out=w_at_save)
+
+    kv.push("w", np.ones((3,), np.float32))    # momentum advances
+    after_two = np.empty((3,), np.float32)
+    kv.pull("w", out=after_two)
+
+    kv.load_optimizer_states(fname)            # rewind momentum
+    # re-prime the weight to the post-save value and repeat push 2:
+    # identical momentum must reproduce the identical step
+    import mxnet_tpu as mx  # noqa: F401  (NDArray backend for updater)
+
+    kv.push("w", np.ones((3,), np.float32))
+    replay = np.empty((3,), np.float32)
+    kv.pull("w", out=replay)
+    delta_orig = after_two - w_at_save
+    delta_replay = replay - after_two
+    np.testing.assert_allclose(delta_replay, delta_orig, rtol=1e-5)
+    kv.close()
+
+
+def test_row_sparse_pull_dense_backed(server):
+    kv = ServerKVStore(server.addr)
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("emb", w)
+    out = np.zeros((4, 3), np.float32)
+    import mxnet_tpu as mx
+
+    t = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=t, row_ids=mx.nd.array([2, 0, 2]))
+    got = t.asnumpy()
+    np.testing.assert_allclose(got[0], w[0])
+    np.testing.assert_allclose(got[2], w[2])
+    np.testing.assert_allclose(got[1], 0.0)
+    np.testing.assert_allclose(got[3], 0.0)
+    assert kv.rank == 0
+    kv.close()
+    del out
+
+
+def test_wire_protocol_refuses_objects():
+    """The restricted unpickler must reject anything but plain data —
+    a hostile peer cannot make the server construct objects."""
+    import io
+    import pickle
+
+    evil = pickle.dumps(np.float32(1.0))  # requires a global lookup
+    with pytest.raises(pickle.UnpicklingError):
+        _SafeUnpickler(io.BytesIO(evil)).load()
+    ok = _SafeUnpickler(io.BytesIO(_pack(("push", "k", None,
+                                          ("float32", (1,), b"\0\0\0\0"))))
+                        ).load()
+    assert ok[0] == "push"
