@@ -70,9 +70,13 @@ def _measure(variant):
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
 
-    # 512 measured fastest on v5e (2690 img/s vs 2648 at 256, 2560 at
-    # 1024 — TPU_EVIDENCE/ and PROFILE.md round-5 second window)
-    for per_dev_batch in (512, 256, 128, 64, 32):
+    # unfused: 512 measured fastest on v5e (2690 img/s vs 2648 at 256,
+    # 2560 at 1024 — TPU_EVIDENCE/ and PROFILE.md round-5 second
+    # window). fused: 256 is the largest on-chip-validated batch; a 512
+    # attempt can spend minutes in Mosaic compile before falling back.
+    ladder = (512, 256, 128, 64, 32) if variant == "unfused" \
+        else (256, 128, 64, 32)
+    for per_dev_batch in ladder:
         batch = per_dev_batch * n_dev
         try:
             ts = TrainStep(
