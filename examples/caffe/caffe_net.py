@@ -33,6 +33,8 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" top: "loss" }
 
 
 def main():
+    np.random.seed(0)  # iterator shuffle order
+    mx.random.seed(0)  # reproducible initializer draws
     symbol, input_dim = convert_symbol(MLP_PROTOTXT)
     print("converted caffe net, input_dim:", input_dim)
 

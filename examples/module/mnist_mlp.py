@@ -37,6 +37,8 @@ def build_mlp():
 
 
 def main():
+    np.random.seed(0)  # iterator shuffle order
+    mx.random.seed(0)  # reproducible initializer draws
     ap = argparse.ArgumentParser()
     ap.add_argument("--num-epochs", type=int, default=8)
     ap.add_argument("--num-examples", type=int, default=2000)

@@ -31,6 +31,8 @@ def hinge_grad_func(scores, labels):
 
 
 def main():
+    np.random.seed(0)  # iterator shuffle order
+    mx.random.seed(0)  # reproducible initializer draws
     rng = np.random.RandomState(0)
     n = 1200
     x = rng.randn(n, 50).astype(np.float32)

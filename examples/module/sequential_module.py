@@ -24,6 +24,8 @@ def classifier_net():
 
 
 def main():
+    np.random.seed(0)  # iterator shuffle order
+    mx.random.seed(0)  # reproducible initializer draws
     rng = np.random.RandomState(0)
     n = 1500
     x = rng.randn(n, 100).astype(np.float32)

@@ -13,6 +13,8 @@ import plugin.torch.torch_module  # noqa: F401  registers 'torch_op'
 
 
 def main():
+    np.random.seed(0)  # iterator shuffle order
+    mx.random.seed(0)  # reproducible initializer draws
     rng = np.random.RandomState(0)
     n = 1000
     x = rng.randn(n, 30).astype(np.float32)

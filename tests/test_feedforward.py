@@ -19,6 +19,8 @@ def _net():
 
 
 def test_feedforward_fit_score_predict_roundtrip(tmp_path):
+    np.random.seed(5)  # FeedForward.fit shuffles via the global RNG
+    mx.random.seed(5)  # initializer draws
     x, y = _problem()
     model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=8,
                                  optimizer="sgd", learning_rate=0.5,
@@ -43,6 +45,8 @@ def test_feedforward_fit_score_predict_roundtrip(tmp_path):
 
 
 def test_feedforward_create_with_iter():
+    np.random.seed(5)
+    mx.random.seed(5)
     x, y = _problem(seed=1)
     it = mx.io.NDArrayIter(x, y, 50, shuffle=True,
                            label_name="softmax_label")
@@ -57,6 +61,8 @@ def test_feedforward_create_with_iter():
 def test_feedforward_fit_after_score(tmp_path):
     """fit() after predict/score must rebind for training (review repro:
     the cached inference-bound module made fit a no-op/crash)."""
+    np.random.seed(5)  # FeedForward.fit shuffles via the global RNG
+    mx.random.seed(5)  # initializer draws
     x, y = _problem(seed=2)
     model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=2,
                                  optimizer="sgd", learning_rate=0.5,
@@ -95,6 +101,8 @@ def test_feedforward_num_epoch_required():
 
 
 def test_feedforward_return_data_and_composite_metric():
+    np.random.seed(5)
+    mx.random.seed(5)
     x, y = _problem(seed=4)
     model = mx.model.FeedForward(_net(), ctx=mx.cpu(), num_epoch=4,
                                  optimizer="sgd", learning_rate=0.5,

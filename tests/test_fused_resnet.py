@@ -159,6 +159,7 @@ def test_fused_resnet_trains_and_infers():
     """End-to-end: Module.fit on the fused graph learns a separable
     task, aux moving stats move, and score() (inference mode, moving
     stats) agrees with training accuracy direction."""
+    mx.random.seed(5)  # pin initializer draws (deterministic training)
     rng = np.random.RandomState(0)
     n = 32
     x = rng.randn(n, 3, 64, 64).astype(np.float32)
