@@ -1,0 +1,54 @@
+"""Per-kernel on-chip timing: fused Pallas conv_fwd vs the identical
+XLA graph (conv + BN-apply prologue + stats epilogue).
+
+Produces the PROFILE.md round-5 per-kernel numbers (stage-3 shape,
+batch 64): the fused deficit is MXU utilization in the nine-shift
+matmul, not HBM traffic. Run on a TPU host:
+
+    python tools/bench_kernel.py
+"""
+import sys, time
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from jax import lax
+from mxnet_tpu.kernels import fused_block as fb
+
+def timeit(f, *args, n=50):
+    f(*args)[0].block_until_ready() if isinstance(f(*args), tuple) else jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), r)
+    return (time.perf_counter() - t0) / n * 1e3
+
+key = jax.random.PRNGKey(0)
+ks = jax.random.split(key, 8)
+# ResNet-50 stage 3 shape, batch 64: 14x14x1024 -> squeeze 256, 3x3
+n, h, w, ci, co = 64, 14, 14, 256, 256
+x = jax.random.normal(ks[0], (n, h, w, ci), jnp.float32).astype(jnp.bfloat16)
+w33 = jax.random.normal(ks[1], (3, 3, ci, co), jnp.float32).astype(jnp.bfloat16)
+scale = jax.random.uniform(ks[2], (ci,), jnp.float32, 0.5, 1.5)
+bias = jax.random.normal(ks[3], (ci,), jnp.float32) * 0.1
+
+@jax.jit
+def pallas_fused(x, w33, scale, bias):
+    return fb.conv_fwd(x, w33, stride=1, prologue=(scale, bias, True),
+                       emit_stats=True, interpret=False)
+
+@jax.jit
+def xla_fused(x, w33, scale, bias):
+    hv = jnp.maximum(x.astype(jnp.float32) * scale + bias, 0.0).astype(jnp.bfloat16)
+    dn = lax.conv_dimension_numbers(x.shape, w33.shape, ("NHWC", "HWIO", "NHWC"))
+    y = lax.conv_general_dilated(hv, w33, (1, 1), "SAME", dimension_numbers=dn,
+                                 preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    yf = y.astype(jnp.float32)
+    s = jnp.stack([jnp.sum(yf, axis=(0, 1, 2)), jnp.sum(yf * yf, axis=(0, 1, 2))])
+    return y, s
+
+t_pallas = timeit(pallas_fused, x, w33, scale, bias)
+t_xla = timeit(xla_fused, x, w33, scale, bias)
+flops = 2 * n * h * w * ci * co * 9
+print(f"stage3 3x3 conv+BNapply+stats, batch {n}:")
+print(f"  pallas fused: {t_pallas:.3f} ms  ({flops/t_pallas/1e9:.1f} TFLOP/s)")
+print(f"  xla graph:    {t_xla:.3f} ms  ({flops/t_xla/1e9:.1f} TFLOP/s)")
