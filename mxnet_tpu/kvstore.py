@@ -104,16 +104,26 @@ class KVStore:
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
-        if len(rids) == 1 and len(outs[0]) > 1:
-            rids = rids * len(outs[0])
         for k, olist in zip(keys, outs):
             src = self._store[k]
-            for o, rid in zip(olist, rids):
+            # per-key broadcast (a shared single-rid list must not be
+            # sized off key 0's target count — keys can differ)
+            key_rids = rids * len(olist) \
+                if len(rids) == 1 and len(olist) > 1 else rids
+            for o, rid in zip(olist, key_rids):
                 # unique-sort requested ids first (ref kvstore_local.h
                 # PullRowSparse does the same); the row_sparse result
                 # then satisfies the canonical unique-index invariant
                 # without the constructor summing repeated requests
-                rid = nd.array(np.unique(np.asarray(rid.asnumpy(), np.int64)))
+                ids = np.unique(np.asarray(rid.asnumpy(), np.int64))
+                if ids.size and (ids[0] < 0 or ids[-1] >= src.shape[0]):
+                    # same contract as the server tier: wrong data
+                    # (clip to last row) is worse than an error
+                    raise MXNetError(
+                        "row_sparse_pull: row_ids out of range for key "
+                        "%r: [%d, %d] vs %d rows"
+                        % (k, int(ids[0]), int(ids[-1]), src.shape[0]))
+                rid = nd.array(ids)
                 taken = nd.invoke("take", [src, rid], {"axis": 0, "mode": "clip"})
                 from .ndarray.sparse import RowSparseNDArray
 

@@ -43,6 +43,9 @@ import threading
 
 import numpy as np
 
+from . import kvstore
+from .base import MXNetError
+
 
 # ---------------------------------------------------------------------------
 # wire helpers
@@ -92,6 +95,38 @@ def _arr_to_wire(a):
 def _arr_from_wire(w):
     dtype, shape, raw = w
     return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+def _state_to_wire(v):
+    """Optimizer-state pytree -> tagged plain data. Arrays travel as
+    (dtype, shape, bytes) like every other tensor on this protocol —
+    never as a pickle blob (``load_opt`` used to feed network bytes to
+    ``pickle.loads`` via Updater.set_states, contradicting the module's
+    no-globals guarantee)."""
+    if v is None:
+        return ("none",)
+    if isinstance(v, (bool, int, float, str)):
+        return ("py", v)
+    if isinstance(v, (list, tuple)):
+        tag = "list" if isinstance(v, list) else "tuple"
+        return (tag, [_state_to_wire(i) for i in v])
+    arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+    return ("nd",) + _arr_to_wire(arr)
+
+
+def _state_from_wire(w):
+    tag = w[0]
+    if tag == "none":
+        return None
+    if tag == "py":
+        return w[1]
+    if tag == "list":
+        return [_state_from_wire(i) for i in w[1]]
+    if tag == "tuple":
+        return tuple(_state_from_wire(i) for i in w[1])
+    if tag == "nd":
+        return _arr_from_wire(w[1:])
+    raise ValueError("bad optimizer-state wire tag %r" % (tag,))
 
 
 # ---------------------------------------------------------------------------
@@ -194,12 +229,20 @@ class KVStoreServer:
             with self._lock:
                 if self._updater is None:
                     raise ValueError("no server optimizer installed")
-                return self._updater.get_states()
+                return [(k, _state_to_wire(v)) for k, v in
+                        self._updater.get_states_map().items()]
         if op == "load_opt":
             with self._lock:
                 if self._updater is None:
                     raise ValueError("no server optimizer installed")
-                self._updater.set_states(wire)
+                if not isinstance(wire, (list, tuple)):
+                    raise ValueError(
+                        "load_opt expects [(key, state-wire)] pairs, got "
+                        "%s (raw optimizer blobs are not accepted: the "
+                        "server never unpickles network bytes)"
+                        % type(wire).__name__)
+                states = {k: _state_from_wire(w) for k, w in wire}
+                self._updater.set_states_from_map(states)
             return None
         raise ValueError("unknown op %r" % (op,))
 
@@ -259,22 +302,25 @@ class KVStoreServer:
 # ---------------------------------------------------------------------------
 # client
 # ---------------------------------------------------------------------------
-class ServerKVStore:
+class ServerKVStore(kvstore.KVStore):
     """KVStore client speaking to a KVStoreServer (dist_async tier).
 
     Constructed by ``kvstore.create('dist_async')`` when
-    ``MXNET_PS_SERVER_URI`` is set. API-compatible with the in-process
-    KVStore for the dense ops the server tier covers; the optimizer
-    runs SERVER-side (``set_optimizer``), so ``push`` sends raw
-    gradients and ``pull`` returns updated weights — the reference's
-    dist_async worker loop (kvstore_dist.h push/pull RPCs).
+    ``MXNET_PS_SERVER_URI`` is set. Subclasses :class:`kvstore.KVStore`
+    (overriding every op with its RPC counterpart) so a preconstructed
+    instance passes ``_create_kvstore``'s isinstance check and can be
+    handed straight to ``Module.fit``/``init_optimizer`` like any other
+    store. The optimizer runs SERVER-side (``set_optimizer``), so
+    ``push`` sends raw gradients and ``pull`` returns updated weights —
+    the reference's dist_async worker loop (kvstore_dist.h push/pull
+    RPCs).
     """
 
     server_side = True  # Module: route updates through the server, not
     # the fused SPMD step (the server IS the update engine here)
 
     def __init__(self, uri, kv_type="dist_async"):
-        self.type = kv_type
+        super().__init__(kv_type)
         host, port = uri.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=60)
@@ -365,6 +411,17 @@ class ServerKVStore:
                         kw.setdefault(p, v)
         self._rpc("set_optimizer", name, kw)
 
+    def set_updater(self, updater):
+        """The optimizer runs SERVER-side on this tier; a client-side
+        updater would never be consulted by push(). Fail fast instead
+        of silently training with the wrong update rule (the base
+        class would just store it)."""
+        raise MXNetError(
+            "ServerKVStore applies updates server-side: use "
+            "set_optimizer(name, **kwargs), not a client updater")
+
+    _set_updater = set_updater
+
     def set_gradient_compression(self, compression_params):
         from .base import MXNetError
 
@@ -374,17 +431,29 @@ class ServerKVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         """Server-side optimizer state -> local file (the
         update_on_kvstore branch of Module.save_optimizer_states,
-        module.py:475)."""
-        states = self._rpc("save_opt")
+        module.py:475). State crosses the wire as tagged plain data
+        (_state_to_wire); the file keeps the reference's
+        pickle-of-numpy-map format, so it interoperates with
+        Updater.get_states checkpoints."""
+        wire = self._rpc("save_opt")
+        states_map = {k: _state_from_wire(w) for k, w in wire}
         with open(fname, "wb") as f:
-            f.write(states)
+            f.write(pickle.dumps(states_map, protocol=4))
 
     def load_optimizer_states(self, fname):
-        """Local file -> server-side optimizer state. The blob is the
-        server's own Updater serialization; it is unpickled SERVER-side
-        with the same trust as any locally-loaded checkpoint file."""
+        """Local file -> server-side optimizer state. The local
+        checkpoint is unpickled HERE, client-side, with the same trust
+        as any locally-loaded checkpoint file — what crosses the wire
+        is the tagged plain-data encoding, which the server decodes
+        without ever unpickling peer bytes."""
         with open(fname, "rb") as f:
-            self._rpc("load_opt", wire=f.read())
+            states_map = pickle.loads(f.read())
+        if isinstance(states_map, tuple) and len(states_map) == 2 \
+                and isinstance(states_map[1], dict):
+            states_map = states_map[0]  # (states, optimizer) dumps
+        self._rpc("load_opt",
+                  wire=[(k, _state_to_wire(v))
+                        for k, v in states_map.items()])
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Dense-backed row_sparse_pull (the server stores dense
@@ -402,13 +471,25 @@ class ServerKVStore:
         for k, o in _iter_kv(key, out):
             w = _arr_from_wire(self._rpc("pull", k))
             targets = o if isinstance(o, (list, tuple)) else [o]
+            # per-key broadcast: computed fresh inside the loop — the
+            # old `rids = list(rids) * len(targets)` rebinding leaked a
+            # grown list into every subsequent key's iteration
             if len(rids) == 1 and len(targets) > 1:
-                rids = list(rids) * len(targets)
-            for t, rid in zip(targets, rids):
+                key_rids = list(rids) * len(targets)
+            else:
+                key_rids = list(rids)
+            for t, rid in zip(targets, key_rids):
                 ids = np.unique(np.asarray(
                     rid.asnumpy() if hasattr(rid, "asnumpy") else rid,
                     np.int64))
-                ids = np.clip(ids, 0, w.shape[0] - 1)
+                if ids.size and (ids[0] < 0 or ids[-1] >= w.shape[0]):
+                    # clipping silently returned the LAST row's data for
+                    # any out-of-range id — wrong values are worse than
+                    # an error (kvstore_local.h asserts the same bound)
+                    raise MXNetError(
+                        "row_sparse_pull: row_ids out of range for key "
+                        "%r: [%d, %d] vs %d rows"
+                        % (k, int(ids[0]), int(ids[-1]), w.shape[0]))
                 taken = nd.array(w[ids])
                 if isinstance(t, RowSparseNDArray):
                     newo = RowSparseNDArray(taken, nd.array(ids),

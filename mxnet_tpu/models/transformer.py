@@ -35,6 +35,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..util import shard_map as _shard_map
+
 from ..parallel.ring import ring_attention_inner, full_attention
 
 __all__ = ["TransformerConfig", "init_params", "param_specs", "make_loss_fn",
@@ -279,7 +281,7 @@ def make_loss_fn(config, mesh, data_axes=("dp",)):
 
     def loss_fn(params, tokens):
         sp_params = {k: specs[k] for k in params}
-        return jax.shard_map(
+        return _shard_map(
             local_loss_seqsplit, mesh=mesh,
             in_specs=(sp_params, token_spec), out_specs=P(),
             check_vma=False,

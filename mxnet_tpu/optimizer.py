@@ -515,6 +515,21 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
     def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], dict):
+            states_map, _opt_state = data
+        else:
+            states_map = data
+        self.set_states_from_map(states_map)
+
+    def set_states_from_map(self, states_map):
+        """Install states from a plain {index: numpy/scalar pytree} map.
+
+        The pickle-free entry point: kvstore_server's ``load_opt``
+        decodes its wire format (dtype/shape/bytes triples — never a
+        pickle) into such a map, so optimizer state arriving over the
+        network is installed without ever calling ``pickle.loads`` on
+        peer-controlled bytes."""
         def _to_nd(x):
             if isinstance(x, numpy.ndarray):
                 return nd.array(x)
@@ -522,15 +537,12 @@ class Updater:
                 return type(x)(_to_nd(i) for i in x)
             return x
 
-        data = pickle.loads(states)
-        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], dict):
-            states_map, _opt_state = data
-        else:
-            states_map = data
         self.states = {k: _to_nd(v) for k, v in states_map.items()}
         self.states_synced = {k: True for k in self.states}
 
-    def get_states(self, dump_optimizer=False):
+    def get_states_map(self):
+        """Plain {index: numpy/scalar pytree} snapshot of the states
+        (the pickle-free counterpart of set_states_from_map)."""
         def _to_np(x):
             if isinstance(x, NDArray):
                 return x.asnumpy()
@@ -538,8 +550,10 @@ class Updater:
                 return type(x)(_to_np(i) for i in x)
             return x
 
-        states_map = {k: _to_np(v) for k, v in self.states.items()}
-        return pickle.dumps(states_map)
+        return {k: _to_np(v) for k, v in self.states.items()}
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps(self.get_states_map())
 
 
 def get_updater(optimizer):

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..util import shard_map as _shard_map
+
 __all__ = ["pipeline_apply", "pipeline"]
 
 
@@ -86,5 +88,5 @@ def pipeline(stage_fn, stacked_params, x, mesh, *, axis_name="pp",
         return pipeline_apply(stage_fn, local, xin, axis_name=axis_name,
                               n_microbatches=n_microbatches)
 
-    return jax.shard_map(inner, mesh=mesh, in_specs=(pspec, dspec),
-                         out_specs=P(), check_vma=False)(stacked_params, x)
+    return _shard_map(inner, mesh=mesh, in_specs=(pspec, dspec),
+                      out_specs=P(), check_vma=False)(stacked_params, x)

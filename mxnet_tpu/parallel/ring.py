@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..util import shard_map as _shard_map
+
 __all__ = [
     "ring_attention_inner", "ring_attention",
     "ulysses_attention_inner", "ulysses_attention",
@@ -140,8 +142,8 @@ def ring_attention(q, k, v, mesh=None, *, axis_name="sp", causal=False,
     spec = P(batch_axis, None, axis_name, None)
     fn = functools.partial(ring_attention_inner, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)
 
 
 def ulysses_attention_inner(q, k, v, *, axis_name="sp", causal=False,
@@ -179,5 +181,5 @@ def ulysses_attention(q, k, v, mesh=None, *, axis_name="sp", causal=False,
     spec = P(batch_axis, None, axis_name, None)
     fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale, attn_fn=attn_fn)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

@@ -32,3 +32,20 @@ def pytest_configure(config):
         "markers",
         "nightly: slow integration tests (real short trainings with "
         "accuracy asserts — ref tests/python/train tier)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the default tier-1 run "
+        "(`pytest tests/ -q -m 'not slow'`, ROADMAP.md)")
+
+
+def pytest_collection_modifyitems(config, items):
+    # nightly implies slow: the tier-1 gate filters on `-m 'not slow'`
+    # (ROADMAP.md), so the nightly tier must carry the slow marker or
+    # the default run silently includes the minutes-long trainings —
+    # exactly the round-5 failure mode (default suite >> the 870 s
+    # tier-1 budget). Run everything with -m "nightly or not nightly".
+    import pytest
+
+    for item in items:
+        if "nightly" in item.keywords:
+            item.add_marker(pytest.mark.slow)

@@ -16,6 +16,7 @@ import mxnet_tpu as mx
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.nightly
 def test_amalgamation_builds_and_serves_predict(tmp_path):
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "amalgamation",

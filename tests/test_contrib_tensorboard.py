@@ -3,6 +3,7 @@ LogMetricsCallback) — scalars written as real TF event files."""
 import glob
 import struct
 
+import pytest
 import numpy as np
 
 import mxnet_tpu as mx
@@ -22,6 +23,7 @@ def _read_records(path):
     return recs
 
 
+@pytest.mark.nightly
 def test_log_metrics_callback(tmp_path):
     cb = mx.contrib.tensorboard.LogMetricsCallback(str(tmp_path),
                                                    prefix="train")

@@ -40,6 +40,7 @@ def _compile_example(src_name, out_path):
     assert r.returncode == 0, r.stderr[-2500:]
 
 
+@pytest.mark.nightly
 def test_cpp_mlp_trains(tmp_path):
     _build_capi_or_skip()
     exe = str(tmp_path / "mlp_train")
@@ -71,6 +72,7 @@ def test_op_wrapper_generator_in_sync(tmp_path):
             "op.h out of date: re-run cpp-package/scripts/op_wrapper_generator.py"
 
 
+@pytest.mark.nightly
 def test_cpp_conv_trains_with_generated_wrappers(tmp_path):
     """Conv net built from the generated typed wrappers
     (op::Convolution/Pooling/Concat/...) compiles and learns."""

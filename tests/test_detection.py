@@ -128,6 +128,7 @@ def test_det_augmenters_keep_boxes_consistent():
     assert a_lab.shape[1] == 5
 
 
+@pytest.mark.nightly
 def test_image_det_record_iter_and_ssd_training(det_rec):
     """The VERDICT bar: pack → ImageDetRecordIter with augmentation →
     a few SSD train steps through MultiBoxTarget."""

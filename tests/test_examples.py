@@ -32,18 +32,21 @@ def test_train_mnist_mlp():
     assert acc > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_gluon_mnist():
     out = _run("gluon/mnist.py", "--epochs", "2")
     acc = float(re.search(r"validation accuracy: ([0-9.]+)", out).group(1))
     assert acc > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_lstm_bucketing():
     out = _run("rnn/lstm_bucketing.py", "--num-epochs", "2")
     ppl = [float(m) for m in re.findall(r"perplexity=([0-9.]+)", out)]
     assert len(ppl) >= 2 and ppl[-1] < ppl[0], out[-1500:]
 
 
+@pytest.mark.nightly
 def test_model_parallel_lstm():
     out = _run("model-parallel/lstm.py", "--num-steps", "40")
     accs = [float(m) for m in re.findall(r"token accuracy ([0-9.]+)", out)]
@@ -90,17 +93,20 @@ def test_c_predict_example_compiles():
     os.remove(exe)
 
 
+@pytest.mark.nightly
 def test_dcgan():
     out = _run("gan/dcgan.py", "--num-steps", "100")
     assert "GAN_STRUCTURE_OK" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_autoencoder():
     out = _run("autoencoder/autoencoder.py", "--pretrain-epochs", "4",
                "--finetune-epochs", "10", "--num-examples", "1024")
     assert "AE_OK" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 @pytest.mark.parametrize("script,marker", [
     ("fcn-xs/fcn_xs.py", "FCN_XS_OK"),
     ("multi-task/example_multi_task.py", "MULTI_TASK_OK"),
@@ -130,6 +136,7 @@ def test_example_domain(script, marker):
     assert marker in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_svm_mnist():
     """SVMOutput's only end-to-end exercise (ref example/svm_mnist)."""
     out = _run("svm_mnist/svm_mnist.py",
@@ -139,6 +146,7 @@ def test_svm_mnist():
     assert acc > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_vae():
     """VAE (ref example/vae): ELBO must improve; prior samples emitted."""
     out = _run("vae/vae.py", "--epochs", "5", "--num-examples", "384")
@@ -146,6 +154,7 @@ def test_vae():
     assert "sample mean activation" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_numpy_ops_softmax():
     """Custom-op example surface (ref example/numpy-ops): numpy softmax
     head trains an MLP and matches the built-in op."""
@@ -157,6 +166,7 @@ def test_numpy_ops_softmax():
     assert err < 1e-5, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_numpy_ops_weighted_logistic():
     out = _run("numpy-ops/weighted_logistic_regression.py",
                "--num-steps", "80")
@@ -164,6 +174,7 @@ def test_numpy_ops_weighted_logistic():
     assert float(m.group(2)) > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_captcha():
     """Multi-digit captcha (ref example/captcha): 4 softmax heads over
     one trunk, whole-string accuracy."""
@@ -174,6 +185,7 @@ def test_captcha():
     assert acc > 0.6, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_rnn_time_major():
     """Time-major layout demo (ref example/rnn-time-major): both
     layouts converge alike."""
@@ -183,6 +195,7 @@ def test_rnn_time_major():
     assert len(accs) == 2 and min(accs) > 0.8, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_speech_recognition_bucketing():
     """Acoustic model over utterance-length buckets (ref
     example/speech_recognition): BucketingModule at its realistic
@@ -195,6 +208,7 @@ def test_speech_recognition_bucketing():
     assert "buckets trained: [20, 30, 40]" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_dsd():
     """Dense-sparse-dense flow (ref example/dsd): prune, masked
     retrain (mask invariant asserted in-script), re-dense."""
@@ -204,6 +218,7 @@ def test_dsd():
     assert "phase2 sparse" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_kaggle_ndsb1(tmp_path):
     """Class-folder image pipeline (ref example/kaggle-ndsb1) through
     the opencv plugin ImageIter."""
@@ -215,6 +230,7 @@ def test_kaggle_ndsb1(tmp_path):
     assert acc > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_adversarial_vae():
     """VAE-GAN (ref example/mxnet_adversarial_vae): ELBO improves and
     the discriminator actually engages."""
@@ -224,6 +240,7 @@ def test_adversarial_vae():
     assert "adversary engaged: True" in out, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_kaggle_ndsb2(tmp_path):
     """CDF regression with CRPS (ref example/kaggle-ndsb2): CSVIter
     disk pipeline, symbolic difference channels, 120-way sigmoid head."""
@@ -235,6 +252,7 @@ def test_kaggle_ndsb2(tmp_path):
     assert crps[-1] < 0.08, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_chinese_text_cnn():
     """Char-level CJK text CNN (ref
     example/cnn_chinese_text_classification)."""
@@ -245,6 +263,7 @@ def test_chinese_text_cnn():
     assert acc > 0.9, out[-1500:]
 
 
+@pytest.mark.nightly
 def test_memcost():
     """Remat memory-cost report (ref example/memcost): all three remat
     modes compile; conv-remat must not raise temp memory."""

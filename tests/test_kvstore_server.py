@@ -264,6 +264,116 @@ def test_row_sparse_pull_dense_backed(server):
     del out
 
 
+def test_load_opt_refuses_hostile_pickle(server, tmp_path):
+    """Regression (round-6 security fix): load_opt used to feed its
+    wire bytes to unrestricted pickle.loads via Updater.set_states —
+    remote code execution for any peer that can reach the port. The
+    state now travels as tagged plain data; a raw pickle blob (hostile
+    or not) must get an 'err' reply without ever being unpickled."""
+    import pickle
+
+    import mxnet_tpu as mx
+
+    marker = tmp_path / "owned"
+
+    class Evil:
+        def __reduce__(self):
+            return (os.mkdir, (str(marker),))
+
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((2,), np.float32))
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+    with pytest.raises(mx.MXNetError, match="never unpickles"):
+        kv._rpc("load_opt", wire=pickle.dumps({"0": Evil()}))
+    assert not marker.exists(), "hostile optimizer blob was executed"
+    # malformed tags inside the plain-data encoding also just err
+    with pytest.raises(mx.MXNetError, match="wire tag"):
+        kv._rpc("load_opt", wire=[("0", ("exploit", b"x"))])
+    # the connection still serves, and real state still loads
+    kv.push("w", np.ones((2,), np.float32))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+    out = np.empty((2,), np.float32)
+    kv.pull("w", out=out)
+    assert np.all(np.isfinite(out))
+    kv.close()
+
+
+def test_row_sparse_pull_out_of_range_raises(server):
+    """Regression: out-of-range row_ids were clipped to the last row —
+    silently wrong data. They must raise instead."""
+    import mxnet_tpu as mx
+
+    kv = ServerKVStore(server.addr)
+    kv.init("emb", np.arange(12, dtype=np.float32).reshape(4, 3))
+    t = mx.nd.zeros((4, 3))
+    with pytest.raises(mx.MXNetError, match="out of range"):
+        kv.row_sparse_pull("emb", out=t, row_ids=mx.nd.array([1, 7]))
+    with pytest.raises(mx.MXNetError, match="out of range"):
+        kv.row_sparse_pull("emb", out=t, row_ids=mx.nd.array([-1, 2]))
+    # in-range still works on the same connection
+    kv.row_sparse_pull("emb", out=t, row_ids=mx.nd.array([3]))
+    np.testing.assert_allclose(t.asnumpy()[3], [9.0, 10.0, 11.0])
+    kv.close()
+
+
+def test_row_sparse_pull_broadcast_stays_per_key(server):
+    """Regression: the single-row_id -> per-target broadcast used to
+    rebind ``rids`` and leak the grown list into the next key's
+    iteration, so a later key with more targets zip-truncated and left
+    targets unfilled."""
+    import mxnet_tpu as mx
+
+    kv = ServerKVStore(server.addr)
+    wa = np.arange(6, dtype=np.float32).reshape(2, 3)
+    wb = wa + 100.0
+    kv.init(["a", "b"], [wa, wb])
+    outs_a = [mx.nd.zeros((2, 3)) for _ in range(2)]
+    outs_b = [mx.nd.zeros((2, 3)) for _ in range(3)]
+    rid = mx.nd.array([1])
+    kv.row_sparse_pull(["a", "b"], out=[outs_a, outs_b], row_ids=[rid])
+    for t in outs_a:
+        np.testing.assert_allclose(t.asnumpy()[1], wa[1])
+    for t in outs_b:  # 3rd target was dropped by the leaked broadcast
+        np.testing.assert_allclose(t.asnumpy()[1], wb[1])
+    kv.close()
+
+
+def test_preconstructed_instance_through_module_fit(server):
+    """A ServerKVStore INSTANCE (not the 'dist_async' spec string)
+    passed to Module.fit must be accepted by _create_kvstore like every
+    other store — it now subclasses kvstore.KVStore."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import _create_kvstore
+
+    kv = ServerKVStore(server.addr)
+    got, update_on_kv = _create_kvstore(kv, 1, {})
+    assert got is kv and update_on_kv
+
+    np.random.seed(7)
+    mx.random.seed(7)
+    rng = np.random.RandomState(0)
+    x = rng.randn(200, 10).astype(np.float32)
+    w = rng.randn(10, 3).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, {"softmax_label": y},
+                           batch_size=50, shuffle=True)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=3)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.initializer.Xavier(), kvstore=kv,
+            eval_metric="acc", num_epoch=6)
+    assert mod._kvstore is kv
+    assert mod._update_on_kvstore
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.8, acc
+    kv.close()
+
+
 def test_wire_protocol_refuses_objects():
     """The restricted unpickler must reject anything but plain data —
     a hostile peer cannot make the server construct objects."""

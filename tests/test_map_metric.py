@@ -144,6 +144,7 @@ def det_rec64(tmp_path_factory):
     return prefix
 
 
+@pytest.mark.nightly
 def test_tiny_ssd_trains_to_map_one(det_rec64):
     """The VERDICT bar: target-assign → detect → NMS → metric end to
     end — brief training on a learnable set reaches mAP 1.0."""

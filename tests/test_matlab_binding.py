@@ -124,6 +124,7 @@ def test_matlab_call_sequence_over_predict_abi(tmp_path):
                     reason="MATLAB absent (Octave lacks "
                            "loadlibrary/calllib, same as the reference "
                            "binding's requirement)")
+@pytest.mark.nightly
 def test_matlab_demo_runs(tmp_path):
     _predict_lib()
     prefix, _x, _y = _train_checkpoint(tmp_path)

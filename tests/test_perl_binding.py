@@ -16,6 +16,10 @@ import sysconfig
 import numpy as np
 import pytest
 
+# binding-build tier: compiles the XS/C++ shim and trains through it —
+# minutes of cc/make per test (nightly, ISSUE-1 test tiering)
+pytestmark = pytest.mark.nightly
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
 

@@ -66,6 +66,7 @@ def _write_class_images(tmp_path, n_per_class=40, size=24):
     return img_list
 
 
+@pytest.mark.nightly
 def test_opencv_imageiter_feeds_module(tmp_path):
     """The plugin iter is a drop-in Module.fit data source: decode ->
     augment -> NCHW batches, trains a small conv net to separate the

@@ -270,6 +270,7 @@ def test_r_ops_generator_in_sync(tmp_path):
 
 @pytest.mark.skipif(shutil.which("Rscript") is None,
                     reason="R toolchain absent")
+@pytest.mark.nightly
 def test_r_trains_mnist(tmp_path):
     """The real binding: Rscript sources the package and trains MNIST
     through the shim (runs wherever R exists; the perl-test pattern)."""

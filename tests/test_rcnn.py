@@ -77,6 +77,7 @@ def test_proposal_target_sampling():
     assert weight[row, 8:12].sum() == 4.0 and weight[row, :8].sum() == 0.0
 
 
+@pytest.mark.nightly
 def test_rcnn_end_to_end_train():
     from train_rcnn import detect, train
 

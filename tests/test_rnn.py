@@ -24,6 +24,7 @@ def test_fused_rnn_lstm_shapes_and_grad():
     assert birnn(x).shape == (T, N, 2 * H)
 
 
+@pytest.mark.nightly
 def test_gluon_lstm_learns_sequence_sum():
     """Tiny regression: predict the running sum of inputs."""
     rng = np.random.RandomState(0)
@@ -71,6 +72,7 @@ def _lstm_lm_sym(seq_len, vocab=32, embed=8, hidden=16):
     return mx.sym.SoftmaxOutput(data=fc, label=lab, name="softmax")
 
 
+@pytest.mark.nightly
 def test_bucketing_module_variable_length_lm():
     """Per-length graphs share params; training reduces loss on both
     buckets (reference test_bucketing pattern)."""

@@ -81,6 +81,7 @@ def test_scala_sources_are_shim_complete():
 
 @pytest.mark.skipif(shutil.which("sbt") is None,
                     reason="JVM/sbt toolchain absent")
+@pytest.mark.nightly
 def test_scala_trains_mnist(tmp_path):
     """The real binding (runs wherever sbt exists; perl/R test
     pattern)."""

@@ -137,6 +137,7 @@ def test_sparse_retain_dense_fallback():
     np.testing.assert_allclose(out, expect)
 
 
+@pytest.mark.nightly
 def test_inception_v3_forward_and_hybrid():
     """Inception3 (ref: gluon/model_zoo/vision/inception.py:155) — eager
     and hybridized agree; output head is (N, classes)."""

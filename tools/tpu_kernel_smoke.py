@@ -91,6 +91,11 @@ def main():
                     help="Mosaic lowering check off-TPU: cross-lower every "
                          "case for the tpu platform on the CPU host; "
                          "catches lowering-rule failures without a tunnel")
+    ap.add_argument("--bench", action="store_true",
+                    help="after the smoke passes, run the loop-amortized "
+                         "per-kernel benchmark (tools/bench_kernel.py) — "
+                         "the MXU-ceiling measurement the tpu_watch "
+                         "evidence pipeline captures")
     args = ap.parse_args()
 
     if args.cpu or args.lower:
@@ -236,6 +241,19 @@ def main():
     ok = all(results)
     print(f"{'ALL PASS' if ok else 'FAILURES'}: "
           f"{sum(results)}/{len(results)}")
+    if args.bench and ok and not _LOWER_ONLY:
+        # parity first, speed second: a benchmark of a wrong kernel is
+        # noise. bench_kernel's last stdout line is a JSON summary.
+        import subprocess
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_kernel.py")]
+        if args.cpu:
+            cmd.append("--cpu")
+        print("--- loop-amortized kernel bench ---", flush=True)
+        rc = subprocess.call(cmd)
+        if rc not in (0, 4):     # 4 = ran, spread above the 10% bar
+            return rc
     return 0 if ok else 1
 
 

@@ -42,7 +42,7 @@ STAGES = [
     ("bench_unfused",
      [sys.executable, "bench.py", "--worker", "unfused"], 1500),
     ("smoke_full",
-     [sys.executable, "tools/tpu_kernel_smoke.py"], 2400),
+     [sys.executable, "tools/tpu_kernel_smoke.py", "--bench"], 2400),
     ("bench_fused",
      [sys.executable, "bench.py", "--worker", "fused"], 2400),
 ]
