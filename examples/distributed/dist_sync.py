@@ -1,12 +1,16 @@
-"""Multi-process data-parallel training with kvstore='dist_sync'.
+"""Multi-process data-parallel training (dist_sync or dist_async).
 
 Counterpart of the reference's nightly dist_lenet.py. Launch with:
 
+    # serverless collectives (one jax.distributed job, batched XLA
+    # all-reduce per step):
     python tools/launch.py -n 2 python examples/distributed/dist_sync.py
 
-Each worker joins one jax.distributed job; gradient sync is a single
-batched XLA collective over the DCN mesh axis per step (the serverless
-replacement for the reference's parameter-server push/pull).
+    # scheduler topology (1 tracker + 1 parameter server, server-side
+    # optimizer; the worker discovers its server through the tracker —
+    # no MXNET_PS_SERVER_URI needed):
+    python tools/launch.py -n 2 -s 1 \\
+        python examples/distributed/dist_sync.py --kv-store dist_async
 """
 import argparse
 
@@ -26,33 +30,48 @@ def synth(n, seed):
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kv-store", default="dist_sync",
+                   help="dist_sync (serverless collectives) or "
+                        "dist_async (parameter-server tier)")
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--num-samples", type=int, default=4000)
     p.add_argument("--lr", type=float, default=0.1)
     args = p.parse_args()
 
-    kv = mx.kv.create("dist_sync")
-    print("worker %d/%d up; dead nodes: %d"
-          % (kv.rank, kv.num_workers, kv.num_dead_node()))
+    kv = mx.kv.create(args.kv_store)
+    print("worker %d/%d up (%s); dead nodes: %d"
+          % (kv.rank, kv.num_workers, kv.type, kv.num_dead_node()),
+          flush=True)
 
     data = mx.sym.var("data")
     net = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=64, name="fc1"), act_type="relu")
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=10, name="fc2"), name="softmax")
 
     # each worker trains on its own shard
-    x, y = synth(4000, seed=kv.rank)
+    x, y = synth(args.num_samples, seed=kv.rank)
     train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
                               label_name="softmax_label")
+    eval_it = mx.io.NDArrayIter(x, y, args.batch_size,
+                                label_name="softmax_label")
     mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    loss0 = dict(mod.score(eval_it, mx.metric.create("ce")))["cross-entropy"]
+
     mod.fit(train, optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
-            initializer=mx.init.Xavier(),
             kvstore=kv, num_epoch=args.num_epochs)
 
+    eval_it.reset()
+    loss1 = dict(mod.score(eval_it, mx.metric.create("ce")))["cross-entropy"]
     score = dict(mod.score(mx.io.NDArrayIter(x, y, args.batch_size,
                                              label_name="softmax_label"),
                            mx.metric.Accuracy()))
-    print("worker %d final accuracy %.4f" % (kv.rank, score["accuracy"]))
+    print("worker %d loss %.4f -> %.4f final accuracy %.4f"
+          % (kv.rank, loss0, loss1, score["accuracy"]), flush=True)
+    assert loss1 < loss0, "training loss did not decrease"
     kv.barrier()
 
 

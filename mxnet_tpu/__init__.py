@@ -56,6 +56,7 @@ from . import storage  # noqa: F401
 from . import profiler  # noqa: F401
 from . import engine  # noqa: F401
 from . import dist  # noqa: F401
+from . import tracker  # noqa: F401
 from . import test_utils  # noqa: F401
 
 from .model import load_checkpoint, save_checkpoint  # noqa: F401

@@ -30,6 +30,13 @@ def env_spec():
     """Read the launcher env; returns (coordinator, num, rank) or None."""
     coord = os.environ.get("MXNET_TPU_COORDINATOR")
     if coord is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        if int(os.environ.get("DMLC_NUM_SERVER", "0") or 0) > 0:
+            # scheduler topology (tools/launch.py -s S): the root URI is
+            # the TRACKER's rendezvous endpoint, not a jax coordinator —
+            # joining jax.distributed against it would hang. The
+            # parameter-server tier (kvstore_server/tracker) owns this
+            # layout; the serverless collective path stays out.
+            return None
         coord = "%s:%s" % (os.environ["DMLC_PS_ROOT_URI"],
                            os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     num = os.environ.get("MXNET_TPU_NUM_WORKERS",

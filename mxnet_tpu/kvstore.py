@@ -604,12 +604,43 @@ def create(name="local"):
     if name in ("tpu", "dist_sync_tpu"):
         return TPUKVStore(name)
     if name.startswith("dist"):
-        uri = os.environ.get("MXNET_PS_SERVER_URI")
-        if name == "dist_async" and uri:
-            # true server-side-optimizer tier (ref dist_async contract):
-            # pushes apply on arrival at the parameter server
-            from .kvstore_server import ServerKVStore
+        if name == "dist_async":
+            uri = os.environ.get("MXNET_PS_SERVER_URI")
+            if uri:
+                # true server-side-optimizer tier (ref dist_async
+                # contract): pushes apply on arrival at the server
+                from .kvstore_server import ServerKVStore
 
-            return ServerKVStore(uri, name)
+                return ServerKVStore(uri, name)
+            from . import tracker
+
+            if tracker.tracker_env_spec() is not None:
+                # scheduler topology (tools/launch.py -n W -s S): the
+                # tracker published every server's URI at rendezvous —
+                # no hand-set MXNET_PS_SERVER_URI needed
+                from .kvstore_server import ServerKVStore
+
+                try:
+                    uris = tracker.discover_server_uris()
+                except tracker.TrackerError as e:
+                    raise MXNetError(
+                        "dist_async: scheduler rendezvous failed: %s" % e)
+                return ServerKVStore(uris, name,
+                                     tracker_client=tracker.worker_client())
+        else:
+            from . import tracker
+
+            if tracker.tracker_env_spec() is not None:
+                # scheduler topology, but this mode's sync path is the
+                # jax collective whose rendezvous env the topology
+                # replaces — each worker would silently train its own
+                # unsynchronized model copy (loss still decreases, so
+                # nothing would ever surface it)
+                raise MXNetError(
+                    "kvstore %r has no synchronization path under the "
+                    "scheduler topology (launch.py -s > 0): workers "
+                    "would train unsynchronized. Use --kv-store "
+                    "dist_async (parameter-server tier) or launch with "
+                    "-s 0 for the serverless collective path" % name)
         return DistKVStore(name)
     raise MXNetError("unknown kvstore type %r" % name)

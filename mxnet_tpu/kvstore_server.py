@@ -33,13 +33,14 @@ with no auth; do not expose the port beyond the job.
 """
 from __future__ import annotations
 
-import io
 import os
 import pickle
 import socket
-import struct
 import sys
 import threading
+import time
+import warnings
+import zlib
 
 import numpy as np
 
@@ -48,43 +49,12 @@ from .base import MXNetError
 
 
 # ---------------------------------------------------------------------------
-# wire helpers
+# wire helpers — the framing + restricted unpickler are SHARED with the
+# tracker protocol (tracker.py is stdlib-only, so this import is
+# cycle-free): one hardening surface, not two drifting copies
 # ---------------------------------------------------------------------------
-class _SafeUnpickler(pickle.Unpickler):
-    """Only plain data crosses the wire: refuse every global lookup."""
-
-    def find_class(self, module, name):
-        raise pickle.UnpicklingError(
-            "kvstore_server protocol carries data only (%s.%s refused)"
-            % (module, name))
-
-
-def _pack(obj):
-    return pickle.dumps(obj, protocol=4)
-
-
-def _unpack(raw):
-    return _SafeUnpickler(io.BytesIO(raw)).load()
-
-
-def _send_msg(sock, obj):
-    raw = _pack(obj)
-    sock.sendall(struct.pack(">I", len(raw)) + raw)
-
-
-def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("kvstore_server: peer closed")
-        buf += chunk
-    return buf
-
-
-def _recv_msg(sock):
-    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-    return _unpack(_recv_exact(sock, n))
+from .tracker import (_SafeUnpickler, _pack, _recv_exact,  # noqa: F401
+                      _recv_msg, _send_msg, _unpack)
 
 
 def _arr_to_wire(a):
@@ -143,7 +113,8 @@ class KVStoreServer:
     without waiting for the barrier.
     """
 
-    def __init__(self, host="127.0.0.1", port=0, num_workers=1):
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1,
+                 barrier_timeout=None):
         self._store = {}
         self._updater = None
         self._opt_config = None
@@ -152,6 +123,12 @@ class KVStoreServer:
         self._barrier_cond = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._barrier_errors = {}   # gen -> abort message
+        if barrier_timeout is None:
+            barrier_timeout = float(os.environ.get(
+                "MXNET_KVSTORE_BARRIER_TIMEOUT", "120"))
+        self._barrier_timeout = float(barrier_timeout)
+        self._conns = set()
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -173,9 +150,17 @@ class KVStoreServer:
                 self._updater(key, array(grad), w)
                 self._store[key] = w.asnumpy()
 
-    def _set_optimizer(self, name, kwargs):
+    def _set_optimizer(self, name, meta):
         from . import optimizer
 
+        # meta is {"kwargs": ..., "extras": ...}; a bare kwargs dict
+        # (older clients) is accepted as-is
+        meta = meta or {}
+        if "kwargs" in meta or "extras" in meta:
+            kwargs = meta.get("kwargs") or {}
+            extras = meta.get("extras") or {}
+        else:
+            kwargs, extras = meta, {}
         with self._lock:
             if self._opt_config is not None:
                 # first-writer-wins, like init: every worker's
@@ -183,16 +168,73 @@ class KVStoreServer:
                 # rank gate), and replacing the updater would wipe the
                 # accumulated momentum/Adam state mid-training. A
                 # *different* config is a real job misconfiguration.
-                if self._opt_config != (name, kwargs):
+                if self._opt_config != (name, kwargs, extras):
                     raise ValueError(
                         "conflicting server optimizer: have %r, got %r"
-                        % (self._opt_config, (name, kwargs)))
+                        % (self._opt_config, (name, kwargs, extras)))
                 return
             opt = optimizer.create(name, **kwargs)
+            self._apply_opt_extras(opt, extras)
             self._updater = optimizer.get_updater(opt)
-            self._opt_config = (name, kwargs)
+            self._opt_config = (name, kwargs, extras)
 
-    def _barrier(self):
+    @staticmethod
+    def _apply_opt_extras(opt, extras):
+        """Install the non-scalar optimizer config the client serialized
+        as plain wire data: per-parameter lr/wd multipliers, the
+        index->name map, and a reconstructed lr scheduler."""
+        if extras.get("idx2name"):
+            opt.idx2name = dict(extras["idx2name"])
+        if extras.get("lr_mult"):
+            # direct assignment: the client already ran set_lr_mult's
+            # normalization — re-running it here would double-apply
+            opt.lr_mult = dict(extras["lr_mult"])
+        if extras.get("wd_mult"):
+            opt.wd_mult = dict(extras["wd_mult"])
+        sched = extras.get("lr_scheduler")
+        if sched:
+            from . import lr_scheduler as lr_mod
+
+            cls_name, skw = sched
+            klass = getattr(lr_mod, cls_name, None)
+            if klass is None or not (isinstance(klass, type)
+                                     and issubclass(klass,
+                                                    lr_mod.LRScheduler)):
+                raise ValueError(
+                    "set_optimizer: unknown lr_scheduler class %r"
+                    % (cls_name,))
+            opt.lr_scheduler = klass(**dict(skw))
+
+    def _abort_barrier_locked(self, msg):
+        """Fail the in-flight barrier round: every waiter raises instead
+        of spinning (round-6 fix for the permanent hang when a worker
+        holding a pending arrival dies)."""
+        if self._barrier_count == 0:
+            return
+        self._barrier_errors[self._barrier_gen] = msg
+        while len(self._barrier_errors) > 8:
+            self._barrier_errors.pop(next(iter(self._barrier_errors)))
+        self._barrier_gen += 1
+        self._barrier_count = 0
+        self._barrier_cond.notify_all()
+
+    @staticmethod
+    def _conn_closed(conn):
+        """Non-consuming liveness probe of a waiter's own socket."""
+        try:
+            return conn.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT) == b""
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return True
+
+    def _barrier(self, conn=None):
+        """Dead-worker handling: each waiter's handler thread probes its
+        OWN socket (``_conn_closed``) every wait tick — a waiter whose
+        worker died aborts the round for every survivor; a worker that
+        never arrives is bounded by the overall timeout. Both reset the
+        count, so later barriers start clean (the seed leaked the dead
+        worker's +1 and every subsequent barrier deadlocked)."""
         with self._barrier_cond:
             gen = self._barrier_gen
             self._barrier_count += 1
@@ -201,10 +243,29 @@ class KVStoreServer:
                 self._barrier_gen += 1
                 self._barrier_cond.notify_all()
                 return
+            deadline = time.monotonic() + self._barrier_timeout
             while self._barrier_gen == gen and not self._stop.is_set():
-                self._barrier_cond.wait(timeout=0.5)
+                if time.monotonic() >= deadline:
+                    msg = ("barrier timed out after %.0fs (%d of %d "
+                           "workers arrived)"
+                           % (self._barrier_timeout, self._barrier_count,
+                              self._num_workers))
+                    self._abort_barrier_locked(msg)
+                    raise MXNetError(msg)
+                if conn is not None and self._conn_closed(conn):
+                    # this waiter's own worker died mid-barrier
+                    self._abort_barrier_locked(
+                        "barrier aborted: a waiting worker "
+                        "disconnected")
+                    raise ConnectionError("peer closed during barrier")
+                self._barrier_cond.wait(timeout=0.2)
+            err = self._barrier_errors.get(gen)
+            if err is not None:
+                raise MXNetError(err)
+            if self._stop.is_set() and self._barrier_gen == gen:
+                raise MXNetError("barrier aborted: server stopped")
 
-    def _dispatch(self, op, key, meta, wire):
+    def _dispatch(self, op, key, meta, wire, conn=None):
         """One op -> ('ok', payload). Raises on bad requests; _handle
         converts that to the protocol's ('err', text) reply."""
         if op == "init":
@@ -222,8 +283,10 @@ class KVStoreServer:
         if op == "set_optimizer":
             self._set_optimizer(key, meta)
             return None
+        if op == "num_workers":
+            return self._num_workers
         if op == "barrier":
-            self._barrier()
+            self._barrier(conn)
             return None
         if op == "save_opt":
             with self._lock:
@@ -255,7 +318,9 @@ class KVStoreServer:
                     self.shutdown()
                     return
                 try:
-                    payload = self._dispatch(op, key, meta, wire)
+                    payload = self._dispatch(op, key, meta, wire, conn=conn)
+                except (ConnectionError, OSError):
+                    raise  # this conn's own peer vanished: no reply path
                 except Exception as e:  # bad request: reply, keep serving
                     _send_msg(conn, ("err", "%s: %s"
                                      % (type(e).__name__, e)))
@@ -264,6 +329,7 @@ class KVStoreServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            self._conns.discard(conn)
             conn.close()
 
     def serve_forever(self):
@@ -277,6 +343,7 @@ class KVStoreServer:
                 continue
             except OSError:
                 break
+            self._conns.add(conn)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
@@ -293,6 +360,18 @@ class KVStoreServer:
         self._stop.set()
         with self._barrier_cond:
             self._barrier_cond.notify_all()
+        # unblock handler threads parked in recv so serve_forever's
+        # joins return immediately (a stopped server must not make its
+        # clients' next RPC hang until their own socket timeout)
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:
@@ -303,10 +382,12 @@ class KVStoreServer:
 # client
 # ---------------------------------------------------------------------------
 class ServerKVStore(kvstore.KVStore):
-    """KVStore client speaking to a KVStoreServer (dist_async tier).
+    """KVStore client speaking to KVStoreServer(s) (dist_async tier).
 
-    Constructed by ``kvstore.create('dist_async')`` when
-    ``MXNET_PS_SERVER_URI`` is set. Subclasses :class:`kvstore.KVStore`
+    Constructed by ``kvstore.create('dist_async')`` — either from a
+    hand-set ``MXNET_PS_SERVER_URI`` or, in the scheduler topology, from
+    the server URIs the tracker published at rendezvous (no env needed;
+    see ``mxnet_tpu/tracker.py``). Subclasses :class:`kvstore.KVStore`
     (overriding every op with its RPC counterpart) so a preconstructed
     instance passes ``_create_kvstore``'s isinstance check and can be
     handed straight to ``Module.fit``/``init_optimizer`` like any other
@@ -314,37 +395,107 @@ class ServerKVStore(kvstore.KVStore):
     ``push`` sends raw gradients and ``pull`` returns updated weights —
     the reference's dist_async worker loop (kvstore_dist.h push/pull
     RPCs).
+
+    With multiple servers, keys shard across them by a stable hash
+    (the reference's ps-lite key-to-server assignment,
+    kvstore_dist.h EncodeDefaultKey); every worker computes the same
+    assignment, so per-key state lives on exactly one server.
     """
 
     server_side = True  # Module: route updates through the server, not
     # the fused SPMD step (the server IS the update engine here)
 
-    def __init__(self, uri, kv_type="dist_async"):
+    def __init__(self, uri, kv_type="dist_async", tracker_client=None):
         super().__init__(kv_type)
-        host, port = uri.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=60)
-        self._wlock = threading.Lock()
+        from . import tracker as _trk
+
+        if isinstance(uri, str):
+            uris = [u for u in uri.split(",") if u]
+        else:
+            uris = list(uri)
+        if not uris:
+            raise MXNetError("ServerKVStore: no server URIs")
+        self._uris = uris
+        self._socks = [_trk.connect_with_backoff(u, deadline=30.0)
+                       for u in uris]
+        self._wlocks = [threading.Lock() for _ in uris]
+        self._tracker = tracker_client
+        self._num_workers_cache = None
 
     @property
     def num_workers(self):
-        return int(os.environ.get("MXNET_TPU_NUM_WORKERS",
-                                  os.environ.get("DMLC_NUM_WORKER", "1")))
+        env = os.environ.get("MXNET_TPU_NUM_WORKERS",
+                             os.environ.get("DMLC_NUM_WORKER"))
+        if env is not None:
+            return int(env)
+        if self._num_workers_cache is None:
+            # hand-set MXNET_PS_SERVER_URI with no DMLC env: the server
+            # knows the worker count it gates barriers on — asking it
+            # beats silently reporting 1
+            self._num_workers_cache = int(self._rpc_idx(0, "num_workers"))
+        return self._num_workers_cache
 
     @property
     def rank(self):
+        if self._tracker is not None:
+            return self._tracker.rank  # scheduler-assigned
         return int(os.environ.get("MXNET_TPU_WORKER_ID",
-                                  os.environ.get("DMLC_RANK", "0")))
+                                  os.environ.get("DMLC_RANK",
+                                                 os.environ.get(
+                                                     "DMLC_WORKER_ID",
+                                                     "0"))))
 
-    def _rpc(self, op, key=None, meta=None, wire=None):
-        with self._wlock:
-            _send_msg(self._sock, (op, key, meta, wire))
-            status, payload = _recv_msg(self._sock)
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Dead-peer count from the scheduler's heartbeat tracking
+        (ref: kvstore.h:330-340); 0 when running without a tracker."""
+        del node_id, timeout
+        if self._tracker is None:
+            return 0
+        return self._tracker.num_dead_node()
+
+    def _shard(self, key):
+        """key -> server index; stable across processes (builtin hash
+        is salted per-interpreter, crc32 is not)."""
+        if len(self._socks) == 1:
+            return 0
+        return zlib.crc32(str(key).encode()) % len(self._socks)
+
+    def _rpc_idx(self, idx, op, key=None, meta=None, wire=None,
+                 timeout=60.0):
+        sock = self._socks[idx]
+        try:
+            with self._wlocks[idx]:
+                sock.settimeout(timeout)
+                _send_msg(sock, (op, key, meta, wire))
+                status, payload = _recv_msg(sock)
+        except (socket.timeout, OSError, ConnectionError) as e:
+            # a timed-out request's reply would otherwise land unread
+            # and be consumed as the NEXT op's reply — invalidate the
+            # connection so later ops fail fast instead of desyncing
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise MXNetError(
+                "kvstore_server rpc %r to %s failed (%s: %s); "
+                "connection closed" % (op, self._uris[idx],
+                                       type(e).__name__, e))
         if status != "ok":
-            from .base import MXNetError
-
             raise MXNetError("kvstore_server: %s" % (payload,))
         return payload
+
+    def _rpc(self, op, key=None, meta=None, wire=None):
+        """Keyed data ops route to the key's shard; everything else
+        goes to server 0 (single-server compatibility surface)."""
+        if op in ("init", "push", "pull") and key is not None:
+            return self._rpc_idx(self._shard(key), op, key, meta, wire)
+        return self._rpc_idx(0, op, key, meta, wire)
+
+    def _rpc_all(self, op, key=None, meta=None, wire=None, timeout=60.0):
+        """Same op on every server, in rank order (deterministic across
+        workers, so multi-server barriers cannot deadlock)."""
+        return [self._rpc_idx(i, op, key, meta, wire, timeout=timeout)
+                for i in range(len(self._socks))]
 
     @staticmethod
     def _np(value):
@@ -378,14 +529,72 @@ class ServerKVStore(kvstore.KVStore):
             for t in targets:
                 t[:] = w
 
+    # lr schedulers representable as plain wire data: class name ->
+    # (ctor_param, instance_attr) pairs (ref lr_scheduler.py signatures)
+    _SCHED_WIRE = {
+        "FactorScheduler": (("step", "step"), ("factor", "factor"),
+                            ("stop_factor_lr", "stop_factor_lr"),
+                            ("base_lr", "base_lr")),
+        "MultiFactorScheduler": (("step", "step"), ("factor", "factor"),
+                                 ("base_lr", "base_lr")),
+        # base_lr maps from base_lr_orig: Optimizer.__init__ mutates
+        # .base_lr to learning_rate, but PolyScheduler decays from the
+        # ctor-time base_lr_orig snapshot — shipping the mutated value
+        # would rebuild a schedule decaying from the wrong anchor
+        "PolyScheduler": (("max_update", "max_update"), ("pwr", "power"),
+                          ("base_lr", "base_lr_orig")),
+        "LRScheduler": (("base_lr", "base_lr"),),
+    }
+
+    @classmethod
+    def _opt_extras(cls, opt):
+        """Serialize the non-scalar optimizer config that IS
+        representable as plain wire data (lr_mult/wd_mult/idx2name and
+        the stock lr schedulers); warn loudly about what is not
+        (param_dict holds live Parameter objects, custom scheduler
+        subclasses hold arbitrary state). These used to be silently
+        dropped — the server then trained with the wrong per-parameter
+        learning rates."""
+        extras, dropped = {}, []
+        if opt.idx2name:
+            extras["idx2name"] = dict(opt.idx2name)
+        if opt.lr_mult:
+            extras["lr_mult"] = dict(opt.lr_mult)
+        if opt.wd_mult:
+            extras["wd_mult"] = dict(opt.wd_mult)
+        if opt.lr_scheduler is not None:
+            spec = cls._SCHED_WIRE.get(type(opt.lr_scheduler).__name__)
+            if spec is not None and type(opt.lr_scheduler).__module__ \
+                    .endswith("lr_scheduler"):
+                extras["lr_scheduler"] = (
+                    type(opt.lr_scheduler).__name__,
+                    {ctor: getattr(opt.lr_scheduler, attr)
+                     for ctor, attr in spec})
+            else:
+                dropped.append("lr_scheduler (%s is not a stock "
+                               "mxnet_tpu.lr_scheduler class)"
+                               % type(opt.lr_scheduler).__name__)
+        if opt.param_dict:
+            dropped.append("param_dict (live Parameter objects cannot "
+                           "cross the data-only wire)")
+        if dropped:
+            warnings.warn(
+                "ServerKVStore.set_optimizer: DROPPING %s — the "
+                "server-side optimizer will run without it. Fold the "
+                "equivalent config into lr_mult/wd_mult or a stock "
+                "lr scheduler." % "; ".join(dropped), stacklevel=3)
+        return extras
+
     def set_optimizer(self, optimizer_or_name, **kwargs):
-        """Install the server-side optimizer (ref: the worker sends its
-        serialized optimizer to every server, kvstore.cc
-        set_optimizer). Accepts a name + kwargs or an Optimizer
-        instance — its scalar hyperparameters (matched against the
-        subclass __init__ signature) travel; optimizer STATE lives only
-        on the server, and non-scalar config (lr schedulers, param
-        dicts) stays worker-side by design."""
+        """Install the server-side optimizer on every server (ref: the
+        worker sends its serialized optimizer to every server,
+        kvstore.cc set_optimizer). Accepts a name + kwargs or an
+        Optimizer instance — its scalar hyperparameters (matched
+        against the subclass __init__ signature) travel, and so do
+        lr_mult/wd_mult/idx2name and stock lr schedulers (as plain wire
+        data). What cannot be represented (param_dict, custom scheduler
+        classes) is dropped with a loud warning, never silently."""
+        extras = {}
         if isinstance(optimizer_or_name, str):
             name, kw = optimizer_or_name, kwargs
         else:
@@ -409,7 +618,9 @@ class ServerKVStore(kvstore.KVStore):
                     v = getattr(opt, attr)
                     if isinstance(v, (int, float, str, bool)):
                         kw.setdefault(p, v)
-        self._rpc("set_optimizer", name, kw)
+            extras = self._opt_extras(opt)
+        self._rpc_all("set_optimizer", name,
+                      {"kwargs": kw, "extras": extras})
 
     def set_updater(self, updater):
         """The optimizer runs SERVER-side on this tier; a client-side
@@ -434,9 +645,12 @@ class ServerKVStore(kvstore.KVStore):
         module.py:475). State crosses the wire as tagged plain data
         (_state_to_wire); the file keeps the reference's
         pickle-of-numpy-map format, so it interoperates with
-        Updater.get_states checkpoints."""
-        wire = self._rpc("save_opt")
-        states_map = {k: _state_from_wire(w) for k, w in wire}
+        Updater.get_states checkpoints. With sharded servers the
+        per-server maps are disjoint by construction (each key's state
+        lives on its shard) and merge into one file."""
+        states_map = {}
+        for wire in self._rpc_all("save_opt"):
+            states_map.update({k: _state_from_wire(w) for k, w in wire})
         with open(fname, "wb") as f:
             f.write(pickle.dumps(states_map, protocol=4))
 
@@ -451,9 +665,11 @@ class ServerKVStore(kvstore.KVStore):
         if isinstance(states_map, tuple) and len(states_map) == 2 \
                 and isinstance(states_map[1], dict):
             states_map = states_map[0]  # (states, optimizer) dumps
-        self._rpc("load_opt",
-                  wire=[(k, _state_to_wire(v))
-                        for k, v in states_map.items()])
+        by_server = [[] for _ in self._socks]
+        for k, v in states_map.items():
+            by_server[self._shard(k)].append((k, _state_to_wire(v)))
+        for idx, pairs in enumerate(by_server):
+            self._rpc_idx(idx, "load_opt", wire=pairs)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Dense-backed row_sparse_pull (the server stores dense
@@ -501,16 +717,25 @@ class ServerKVStore(kvstore.KVStore):
                     t[:] = dense
 
     def barrier(self):
-        self._rpc("barrier")
+        """Barrier across workers, held at every server in rank order
+        (same visit order on every worker, so sharded barriers cannot
+        interleave into a deadlock). The server aborts the round with
+        an error — raised here — when a peer dies or its overall
+        timeout (MXNET_KVSTORE_BARRIER_TIMEOUT) expires."""
+        bt = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT", "120"))
+        self._rpc_all("barrier", timeout=bt + 30.0)
 
     def stop_server(self):
-        self._rpc("stop")
+        self._rpc_all("stop")
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._tracker is not None:
+            self._tracker.done()
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 def _iter_kv(key, value):
@@ -530,15 +755,60 @@ def _init_kvstore_server_module():
     role = os.environ.get("DMLC_ROLE", "worker").lower()
     if role not in ("server", "scheduler"):
         return
-    if role == "server" and os.environ.get("MXNET_KVSTORE_SERVER") == "1":
-        host = os.environ.get("MXNET_PS_BIND_HOST", "127.0.0.1")
-        port = int(os.environ.get("MXNET_PS_BIND_PORT",
-                                  os.environ.get("DMLC_PS_ROOT_PORT", "0")))
+    from . import tracker as trk
+
+    if role == "scheduler":
+        # scheduler topology: run the tracker rendezvous loop (ref: the
+        # dmlc tracker's scheduler node). Without the env contract the
+        # shim exits 0 so reference launch scripts keep working.
+        if trk.tracker_env_spec() is not None:
+            sys.exit(trk.main())
+        sys.exit(0)
+    if os.environ.get("MXNET_KVSTORE_SERVER") == "1":
+        spec = trk.tracker_env_spec()
+        # multi-host topology (scheduler on another host): bind the
+        # wildcard so remote workers can reach us, and advertise a
+        # routable address — publishing the loopback bind would strand
+        # every remote worker in connect retries
+        multi_host = spec is not None and \
+            spec[0].rsplit(":", 1)[0] not in ("127.0.0.1", "localhost")
+        host = os.environ.get("MXNET_PS_BIND_HOST",
+                              "" if multi_host else "127.0.0.1")
+        # scheduler topology: DMLC_PS_ROOT_PORT is the SCHEDULER's port
+        # (never bind it); manual MXNET_PS_SERVER_URI deployments keep
+        # the pre-tracker fallback of binding the root port directly
+        default_port = "0" if spec is not None \
+            else os.environ.get("DMLC_PS_ROOT_PORT", "0")
+        port = int(os.environ.get("MXNET_PS_BIND_PORT", default_port) or 0)
         nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
                                 os.environ.get("DMLC_NUM_WORKER", "1")))
         server = KVStoreServer(host=host, port=port, num_workers=nw)
+        client = None
+        if spec is not None:
+            advertise = os.environ.get("MXNET_PS_ADVERTISE_HOST")
+            if advertise is None and multi_host:
+                # the outbound interface toward the scheduler is the
+                # address workers can route back to (UDP connect does
+                # no I/O — it only resolves the local endpoint)
+                sched_host, sched_port = spec[0].rsplit(":", 1)
+                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:
+                    probe.connect((sched_host, int(sched_port)))
+                    advertise = probe.getsockname()[0]
+                finally:
+                    probe.close()
+            bound_port = server.addr.rsplit(":", 1)[1]
+            addr = "%s:%s" % (advertise, bound_port) if advertise \
+                else server.addr
+            # publish this server's URI to the scheduler; workers
+            # discover it at kvstore.create('dist_async') rendezvous.
+            # The scheduler's shutdown fan-out sends the 'stop' op
+            # here once every worker reports done.
+            client = trk.TrackerClient(spec[0], "server", addr=addr)
         print("kvstore_server listening on %s" % server.addr, flush=True)
         server.serve_forever()
+        if client is not None:
+            client.close()
         sys.exit(0)
     # serverless tier: nothing to run (see module docstring)
     sys.exit(0)

@@ -1,0 +1,626 @@
+"""Tracker: the scheduler-rendezvous process topology for dist training.
+
+Reference counterpart: the dmlc-core tracker behind ``tools/launch.py``
+(tools/launch.py:33-46) plus the ps-lite scheduler node (SURVEY §2.4,
+kvstore.h:267-311): one scheduler process accepts registrations from
+``DMLC_ROLE``-tagged servers and workers, assigns ranks per role, and
+publishes the server endpoints to every worker so
+``kvstore.create('dist_async')`` discovers its parameter server with no
+hand-set ``MXNET_PS_SERVER_URI``.
+
+Beyond rendezvous, the scheduler is the robustness layer of the
+topology:
+
+- **heartbeats + dead-node detection** — clients beat on a dedicated
+  connection; a node whose beats stop (or whose connections drop) is
+  marked dead, and ``num_dead_node`` reports the count (ref:
+  ps-lite heartbeats behind kvstore.h:330-340 get_num_dead_node);
+- **barrier recovery** — a tracker barrier whose peer dies is *aborted*
+  with an error to every survivor instead of spinning forever;
+- **bounded-backoff connect** — clients retry the scheduler (and
+  workers retry their servers) with exponential backoff up to a
+  deadline, so process start order does not matter;
+- **graceful shutdown fan-out** — when every worker reports ``done``
+  (or is dead), the scheduler sends ``stop`` to each registered server
+  and exits, so ``tools/launch.py`` jobs terminate cleanly.
+
+This module is deliberately **stdlib-only** (no jax/numpy): the
+scheduler process imports in milliseconds and the module is importable
+from anywhere in the package without cycles.
+
+Protocol: 4-byte big-endian length + restricted-pickle payload
+``(op, payload_dict)`` with replies ``("ok", payload)`` /
+``("err", text)`` — the same plain-data-only wire discipline as
+``kvstore_server`` (no global lookups ever unpickled). In-cluster
+protocol, no auth; do not expose the port beyond the job.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import socket
+import struct
+import sys
+import threading
+import time
+
+
+DEFAULT_HEARTBEAT_TIMEOUT = 30.0   # seconds without a beat => dead
+DEFAULT_HEARTBEAT_INTERVAL = 2.0   # client beat period
+DEFAULT_BARRIER_TIMEOUT = 120.0    # overall tracker-barrier bound
+
+
+class TrackerError(RuntimeError):
+    """Tracker-layer failure (connect exhausted, barrier broken, ...)."""
+
+
+# ---------------------------------------------------------------------------
+# wire helpers (restricted pickle: plain data only)
+# ---------------------------------------------------------------------------
+class _SafeUnpickler(pickle.Unpickler):
+    """Shared by the tracker AND kvstore_server protocols (one framing,
+    one hardening surface): refuse every global lookup."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            "this protocol carries data only (%s.%s refused)"
+            % (module, name))
+
+
+def _pack(obj):
+    return pickle.dumps(obj, protocol=4)
+
+
+def _unpack(raw):
+    return _SafeUnpickler(io.BytesIO(raw)).load()
+
+
+def _send_msg(sock, obj):
+    raw = _pack(obj)
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tracker: peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    return _unpack(_recv_exact(sock, n))
+
+
+def connect_with_backoff(uri, deadline=30.0, base_delay=0.05, max_delay=2.0):
+    """TCP connect with bounded exponential backoff (the topology's
+    answer to arbitrary process start order: a worker may come up before
+    its scheduler or server is listening). Raises TrackerError once the
+    deadline is exhausted."""
+    host, port = uri.rsplit(":", 1)
+    stop_at = time.monotonic() + float(deadline)
+    delay = base_delay
+    last_err = None
+    while True:
+        remaining = stop_at - time.monotonic()
+        if remaining <= 0:
+            raise TrackerError(
+                "could not connect to %s within %.0fs (last error: %s)"
+                % (uri, deadline, last_err))
+        try:
+            return socket.create_connection(
+                (host, int(port)), timeout=min(max(remaining, 0.1), 10.0))
+        except OSError as e:
+            last_err = e
+            time.sleep(min(delay, max(stop_at - time.monotonic(), 0)))
+            delay = min(delay * 2, max_delay)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+class _Node:
+    __slots__ = ("node_id", "role", "rank", "addr", "last_beat", "alive",
+                 "done")
+
+    def __init__(self, node_id, role, rank, addr):
+        self.node_id = node_id
+        self.role = role
+        self.rank = rank
+        self.addr = addr
+        self.last_beat = time.monotonic()
+        self.alive = True
+        self.done = False
+
+
+class Tracker:
+    """The scheduler process: registration, rank assignment, server-URI
+    publication, heartbeats, barriers with dead-peer recovery, shutdown
+    fan-out."""
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1,
+                 num_servers=0, heartbeat_timeout=DEFAULT_HEARTBEAT_TIMEOUT,
+                 barrier_timeout=DEFAULT_BARRIER_TIMEOUT):
+        self._num_workers = int(num_workers)
+        self._num_servers = int(num_servers)
+        self._heartbeat_timeout = float(heartbeat_timeout)
+        self._barrier_timeout = float(barrier_timeout)
+        self._cv = threading.Condition()
+        self._nodes = {}            # node_id -> _Node
+        self._next_id = 0
+        self._next_rank = {"worker": 0, "server": 0}
+        self._barriers = {}         # name -> {"gen": int, "arrived": set}
+        self._barrier_errors = {}   # (name, gen) -> message
+        self._stop = threading.Event()
+        self._fanned_out = False
+        self._conns = set()         # live client connections
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        self.addr = "%s:%d" % self._sock.getsockname()[:2]
+
+    # -- state helpers (lock held) -------------------------------------------
+    def _num_dead_locked(self):
+        return sum(1 for n in self._nodes.values()
+                   if not n.alive and not n.done)
+
+    def _servers_locked(self):
+        return sorted((n for n in self._nodes.values()
+                       if n.role == "server"), key=lambda n: n.rank)
+
+    def _abort_barrier_locked(self, name, msg):
+        b = self._barriers.get(name)
+        if b is None or not b["arrived"]:
+            return
+        self._barrier_errors[(name, b["gen"])] = msg
+        # prune: keep only the newest few abort records
+        while len(self._barrier_errors) > 32:
+            self._barrier_errors.pop(next(iter(self._barrier_errors)))
+        b["gen"] += 1
+        b["arrived"] = set()
+        self._cv.notify_all()
+
+    def _mark_dead_locked(self, node_id, why):
+        node = self._nodes.get(node_id)
+        if node is None or node.done or not node.alive:
+            return
+        node.alive = False
+        for name in list(self._barriers):
+            self._abort_barrier_locked(
+                name, "barrier %r broken: %s %d (rank %d) died (%s)"
+                % (name, node.role, node_id, node.rank, why))
+        self._cv.notify_all()
+        self._maybe_finish_locked()
+
+    def _maybe_finish_locked(self):
+        """All expected workers done-or-dead => shutdown fan-out."""
+        workers = [n for n in self._nodes.values() if n.role == "worker"]
+        if len(workers) < self._num_workers or self._fanned_out:
+            return
+        if all(n.done or not n.alive for n in workers):
+            self._fanned_out = True
+            servers = [n.addr for n in self._servers_locked() if n.addr]
+            threading.Thread(target=self._fan_out_stop, args=(servers,),
+                             daemon=True).start()
+
+    def _fan_out_stop(self, server_addrs):
+        """Send the kvstore_server protocol 'stop' to every server, then
+        stop the tracker itself (graceful job teardown)."""
+        for addr in server_addrs:
+            try:
+                s = connect_with_backoff(addr, deadline=5.0)
+                try:
+                    # kvstore_server wire: (op, key, meta, wire) 4-tuple
+                    _send_msg(s, ("stop", None, None, None))
+                    s.settimeout(5.0)
+                    _recv_msg(s)
+                finally:
+                    s.close()
+            except (TrackerError, OSError, ConnectionError):
+                pass  # server already gone
+        self.shutdown()
+
+    # -- op handlers ---------------------------------------------------------
+    def _op_register(self, conn_nodes, p):
+        role = p.get("role")
+        if role not in ("worker", "server"):
+            raise ValueError("register: bad role %r" % (role,))
+        with self._cv:
+            limit = (self._num_workers if role == "worker"
+                     else self._num_servers)
+            rank = self._next_rank[role]
+            if rank >= limit:
+                raise ValueError(
+                    "register: all %d %s ranks already assigned"
+                    % (limit, role))
+            self._next_rank[role] += 1
+            nid = self._next_id
+            self._next_id += 1
+            self._nodes[nid] = _Node(nid, role, rank, p.get("addr"))
+            conn_nodes.add(nid)
+            self._cv.notify_all()
+        return {"node_id": nid, "rank": rank,
+                "num_workers": self._num_workers,
+                "num_servers": self._num_servers}
+
+    def _op_get_servers(self, p):
+        """Block until every expected server registered; return their
+        URIs in rank order."""
+        timeout = float(p.get("timeout", 60.0))
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._stop.is_set():
+                servers = self._servers_locked()
+                if len(servers) >= self._num_servers:
+                    return [n.addr for n in servers]
+                dead = [n for n in servers if not n.alive]
+                if dead:
+                    raise TrackerError(
+                        "get_servers: server rank %d died during "
+                        "rendezvous" % dead[0].rank)
+                if time.monotonic() >= deadline:
+                    raise TrackerError(
+                        "get_servers: %d of %d servers registered within "
+                        "%.0fs" % (len(servers), self._num_servers, timeout))
+                self._cv.wait(timeout=0.2)
+            raise TrackerError("get_servers: tracker stopped")
+
+    def _op_heartbeat(self, conn_nodes, p):
+        nid = p.get("node_id")
+        with self._cv:
+            node = self._nodes.get(nid)
+            if node is None:
+                raise ValueError("heartbeat: unknown node %r" % (nid,))
+            conn_nodes.add(nid)
+            node.last_beat = time.monotonic()
+            return {"num_dead": self._num_dead_locked()}
+
+    def _op_barrier(self, p):
+        """All expected workers must arrive; a dead peer aborts the
+        round with an error to every waiter (instead of the reference's
+        infinite spin), and an overall timeout bounds the wait."""
+        nid = p.get("node_id")
+        name = p.get("name", "")
+        timeout = float(p.get("timeout") or self._barrier_timeout)
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            b = self._barriers.setdefault(name, {"gen": 0, "arrived": set()})
+            gen = b["gen"]
+            b["arrived"].add(nid)
+            if len(b["arrived"]) >= self._num_workers:
+                b["gen"] += 1
+                b["arrived"] = set()
+                self._cv.notify_all()
+                return None
+            while b["gen"] == gen and not self._stop.is_set():
+                if time.monotonic() >= deadline:
+                    msg = ("barrier %r timed out after %.0fs (%d of %d "
+                           "workers arrived)"
+                           % (name, timeout, len(b["arrived"]),
+                              self._num_workers))
+                    self._abort_barrier_locked(name, msg)
+                    raise TrackerError(msg)
+                self._cv.wait(timeout=0.2)
+            err = self._barrier_errors.get((name, gen))
+            if err is not None:
+                raise TrackerError(err)
+            if self._stop.is_set() and b["gen"] == gen:
+                raise TrackerError("barrier %r: tracker stopped" % (name,))
+            return None
+
+    def _op_done(self, p):
+        nid = p.get("node_id")
+        with self._cv:
+            node = self._nodes.get(nid)
+            if node is not None:
+                node.done = True
+            self._maybe_finish_locked()
+        return None
+
+    def _op_num_dead(self):
+        with self._cv:
+            return self._num_dead_locked()
+
+    def _op_nodes(self):
+        """Topology snapshot (debugging / tests)."""
+        with self._cv:
+            return [{"node_id": n.node_id, "role": n.role, "rank": n.rank,
+                     "addr": n.addr, "alive": n.alive, "done": n.done}
+                    for n in self._nodes.values()]
+
+    def _dispatch(self, conn_nodes, op, p):
+        if op == "register":
+            return self._op_register(conn_nodes, p)
+        if op == "get_servers":
+            return self._op_get_servers(p)
+        if op == "heartbeat":
+            return self._op_heartbeat(conn_nodes, p)
+        if op == "barrier":
+            return self._op_barrier(p)
+        if op == "done":
+            return self._op_done(p)
+        if op == "num_dead":
+            return self._op_num_dead()
+        if op == "nodes":
+            return self._op_nodes()
+        raise ValueError("unknown op %r" % (op,))
+
+    # -- connection loop -----------------------------------------------------
+    def _handle(self, conn):
+        conn_nodes = set()  # node_ids bound to this connection
+        try:
+            while not self._stop.is_set():
+                op, p = _recv_msg(conn)
+                if op == "stop":
+                    _send_msg(conn, ("ok", None))
+                    self.shutdown()
+                    return
+                try:
+                    payload = self._dispatch(conn_nodes, op, p or {})
+                except Exception as e:
+                    try:
+                        _send_msg(conn, ("err", "%s: %s"
+                                         % (type(e).__name__, e)))
+                    except OSError:
+                        raise ConnectionError("reply failed")
+                    continue
+                _send_msg(conn, ("ok", payload))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            self._conns.discard(conn)
+            conn.close()
+            # a dropped connection kills every node bound to it (fast
+            # dead detection for SIGKILLed processes; graceful exits
+            # sent "done" first, which _mark_dead respects)
+            with self._cv:
+                for nid in conn_nodes:
+                    self._mark_dead_locked(nid, "connection dropped")
+
+    def _monitor(self):
+        """Heartbeat scan: nodes whose beats stopped are dead."""
+        tick = max(self._heartbeat_timeout / 4.0, 0.2)
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            with self._cv:
+                for n in list(self._nodes.values()):
+                    if (n.alive and not n.done
+                            and now - n.last_beat > self._heartbeat_timeout):
+                        self._mark_dead_locked(n.node_id, "heartbeat lost")
+
+    def serve_forever(self):
+        self._sock.settimeout(0.5)
+        threading.Thread(target=self._monitor, daemon=True).start()
+        threads = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._conns.add(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=2)
+
+    def serve_in_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        # closing live conns unblocks handler threads parked in recv so
+        # serve_forever's joins return immediately instead of timing out
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class TrackerClient:
+    """One node's connection to the scheduler: registers on construction
+    (rank assignment), beats on a dedicated second connection so long
+    barrier waits never starve the heartbeat, and exposes the
+    rendezvous/barrier/failure-count surface."""
+
+    def __init__(self, uri, role, addr=None,
+                 connect_deadline=30.0,
+                 heartbeat_interval=None):
+        self._uri = uri
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._done_sent = False
+        self._sock = connect_with_backoff(uri, deadline=connect_deadline)
+        info = self._rpc("register", {"role": role, "addr": addr})
+        self.node_id = info["node_id"]
+        self.rank = info["rank"]
+        self.num_workers = info["num_workers"]
+        self.num_servers = info["num_servers"]
+        self.role = role
+        # heartbeats: dedicated connection + thread
+        if heartbeat_interval is None:
+            heartbeat_interval = float(os.environ.get(
+                "MXNET_TRACKER_HEARTBEAT_INTERVAL",
+                str(DEFAULT_HEARTBEAT_INTERVAL)))
+        self._hb_sock = connect_with_backoff(uri, deadline=connect_deadline)
+        self._hb_thread = threading.Thread(
+            target=self._beat, args=(float(heartbeat_interval),),
+            daemon=True)
+        self._hb_thread.start()
+
+    def _rpc(self, op, payload=None, timeout=60.0, sock=None, lock=None):
+        sock = sock or self._sock
+        try:
+            with (lock or self._lock):
+                sock.settimeout(timeout)
+                _send_msg(sock, (op, payload or {}))
+                status, reply = _recv_msg(sock)
+        except (socket.timeout, OSError, ConnectionError) as e:
+            # a timed-out request's late reply would otherwise be read
+            # as the NEXT op's reply — invalidate the connection and
+            # raise the domain error kvstore.create() knows to catch
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise TrackerError(
+                "tracker rpc %r to %s failed (%s: %s); connection closed"
+                % (op, self._uri, type(e).__name__, e))
+        if status != "ok":
+            raise TrackerError("tracker: %s" % (reply,))
+        return reply
+
+    def _beat(self, interval):
+        hb_lock = threading.Lock()
+        while not self._closed.wait(interval):
+            try:
+                self._rpc("heartbeat", {"node_id": self.node_id},
+                          timeout=10.0, sock=self._hb_sock, lock=hb_lock)
+            except (TrackerError, OSError, ConnectionError):
+                return  # tracker gone; stop beating
+
+    # -- surface -------------------------------------------------------------
+    def get_server_uris(self, timeout=60.0):
+        """Block until every server registered; URIs in rank order."""
+        return self._rpc("get_servers", {"timeout": timeout},
+                         timeout=timeout + 10.0)
+
+    def barrier(self, name="", timeout=None):
+        """Tracker barrier across all workers. Raises TrackerError on a
+        dead peer or on the overall timeout — never spins forever."""
+        timeout = float(timeout if timeout is not None
+                        else os.environ.get("MXNET_TRACKER_BARRIER_TIMEOUT",
+                                            str(DEFAULT_BARRIER_TIMEOUT)))
+        self._rpc("barrier",
+                  {"node_id": self.node_id, "name": name, "timeout": timeout},
+                  timeout=timeout + 15.0)
+
+    def num_dead_node(self):
+        return int(self._rpc("num_dead"))
+
+    def nodes(self):
+        return self._rpc("nodes")
+
+    def done(self):
+        """Report graceful completion (idempotent; swallows a dead
+        tracker — at-exit teardown must never raise)."""
+        if self._done_sent:
+            return
+        self._done_sent = True
+        try:
+            self._rpc("done", {"node_id": self.node_id}, timeout=10.0)
+        except (TrackerError, OSError, ConnectionError):
+            pass
+
+    def close(self):
+        self._closed.set()
+        for s in (self._sock, self._hb_sock):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# env contract + worker-side discovery singleton
+# ---------------------------------------------------------------------------
+def tracker_env_spec():
+    """(scheduler_uri, num_workers, num_servers) from the DMLC env, or
+    None when no scheduler topology is configured. The topology exists
+    exactly when DMLC_PS_ROOT_URI/PORT name the scheduler AND
+    DMLC_NUM_SERVER asks for parameter servers."""
+    host = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT")
+    try:
+        num_servers = int(os.environ.get("DMLC_NUM_SERVER", "0") or 0)
+    except ValueError:
+        return None
+    if not host or not port or num_servers <= 0:
+        return None
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
+    return ("%s:%s" % (host, port), num_workers, num_servers)
+
+
+_WORKER_CLIENT = None
+_WORKER_CLIENT_LOCK = threading.Lock()
+
+
+def worker_client():
+    """This process's TrackerClient (role=worker), created on first use
+    from the env contract; None when no scheduler topology is
+    configured. Registers an atexit hook that reports ``done`` so the
+    scheduler can fan out shutdown to the servers."""
+    global _WORKER_CLIENT
+    with _WORKER_CLIENT_LOCK:
+        if _WORKER_CLIENT is not None:
+            return _WORKER_CLIENT
+        spec = tracker_env_spec()
+        if spec is None:
+            return None
+        uri, _nw, _ns = spec
+        client = TrackerClient(uri, "worker")
+        import atexit
+
+        atexit.register(lambda: (client.done(), client.close()))
+        _WORKER_CLIENT = client
+        return client
+
+
+def discover_server_uris(timeout=60.0):
+    """Worker-side rendezvous: register with the scheduler and block
+    until every parameter server has published its URI. None when no
+    scheduler topology is configured in the env."""
+    client = worker_client()
+    if client is None:
+        return None
+    return client.get_server_uris(timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# scheduler entry point (DMLC_ROLE=scheduler)
+# ---------------------------------------------------------------------------
+def main():
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "0"))
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
+    num_servers = int(os.environ.get("DMLC_NUM_SERVER", "0") or 0)
+    hb_timeout = float(os.environ.get("MXNET_TRACKER_HEARTBEAT_TIMEOUT",
+                                      str(DEFAULT_HEARTBEAT_TIMEOUT)))
+    # bind-anywhere: the advertised host may be this host's external
+    # name; bind the wildcard so both loopback and external connects work
+    bind_host = "" if host not in ("127.0.0.1", "localhost") else host
+    tracker = Tracker(host=bind_host, port=port, num_workers=num_workers,
+                      num_servers=num_servers,
+                      heartbeat_timeout=hb_timeout)
+    print("tracker listening on %s (workers=%d servers=%d)"
+          % (tracker.addr, num_workers, num_servers), flush=True)
+    tracker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

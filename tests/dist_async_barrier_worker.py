@@ -1,0 +1,18 @@
+"""Helper for test_dist_async.py::test_killed_worker_mid_barrier —
+connects to a KVStoreServer, announces it is about to block in the
+barrier, then enters it. The test SIGKILLs this process mid-barrier and
+asserts the surviving worker's barrier RAISES instead of spinning."""
+import sys
+
+from mxnet_tpu.kvstore_server import ServerKVStore
+
+
+def main():
+    kv = ServerKVStore(sys.argv[1])
+    print("IN_BARRIER", flush=True)
+    kv.barrier()
+    print("RELEASED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

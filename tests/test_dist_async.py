@@ -1,0 +1,186 @@
+"""End-to-end scheduler topology: launch.py -n W -s S + dist_async.
+
+The ISSUE-2 acceptance surface, asserted in-suite:
+
+- ``tools/launch.py -n 2 -s 1 python examples/distributed/dist_sync.py
+  --kv-store dist_async`` runs end-to-end with NO hand-set
+  ``MXNET_PS_SERVER_URI`` (workers discover the parameter server
+  through the scheduler's rendezvous) and training loss decreases on
+  every worker;
+- killing a worker mid-barrier produces a RAISED timeout on the
+  survivors, not an infinite spin.
+
+Every subprocess is bounded by a hard timeout <= 60 s so the default
+tier's wall-time stays within budget (ref pattern:
+tests/nightly/dist_sync_kvstore.py, run here as a default-tier test
+because the model is tiny).
+"""
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.kvstore_server import KVStoreServer, ServerKVStore
+from mxnet_tpu.base import MXNetError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_launch_dist_async_end_to_end():
+    """1 scheduler + 1 server + 2 workers, rendezvous via the tracker:
+    no MXNET_PS_SERVER_URI anywhere in the env."""
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("DMLC_", "MXNET_TPU_", "MXNET_PS_")):
+            del env[k]
+    assert "MXNET_PS_SERVER_URI" not in env
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--timeout", "55",
+         sys.executable,
+         os.path.join(ROOT, "examples", "distributed", "dist_sync.py"),
+         "--kv-store", "dist_async", "--num-epochs", "2",
+         "--num-samples", "1200", "--batch-size", "100"],
+        env=env, capture_output=True, text=True, timeout=60)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    losses = re.findall(r"worker (\d) loss ([\d.]+) -> ([\d.]+)", out)
+    assert len(losses) == 2, "expected 2 workers to report, got:\n" + out[-2000:]
+    for rank, loss0, loss1 in losses:
+        assert float(loss1) < float(loss0), \
+            "worker %s loss did not decrease: %s -> %s" % (rank, loss0, loss1)
+    # both ranks assigned by the scheduler, not hand-set
+    assert {r for r, _, _ in losses} == {"0", "1"}
+
+
+def test_launch_manual_mode_prints_topology_env():
+    """--launcher manual with -s prints the per-role env contract for
+    external orchestrators (k8s/slurm)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--launcher", "manual",
+         "--coordinator", "h0:9091", "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    out = proc.stdout
+    for role in ("scheduler", "server", "worker"):
+        assert re.search(r"--- %s" % role, out), out
+    assert "DMLC_PS_ROOT_URI=h0" in out
+    assert "DMLC_PS_ROOT_PORT=9091" in out
+    assert "DMLC_NUM_SERVER=1" in out
+    assert "DMLC_ROLE=scheduler" in out
+    assert "DMLC_ROLE=server" in out
+    assert "DMLC_ROLE=worker" in out
+    assert "MXNET_KVSTORE_SERVER=1" in out
+    assert "mxnet_tpu.tracker" in out
+    assert "mxnet_tpu.kvstore_server" in out
+
+
+def test_killed_worker_mid_barrier_raises_on_survivor():
+    """A worker process SIGKILLed while blocked inside the barrier must
+    produce a raised error on the survivor within the configured
+    timeout — the seed behavior was an infinite spin (the dead worker's
+    pending count never drained)."""
+    srv = KVStoreServer(num_workers=2, barrier_timeout=3.0)
+    srv.serve_in_background()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(ROOT, "tests", "dist_async_barrier_worker.py"),
+         srv.addr],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "IN_BARRIER" in line, line
+        time.sleep(0.3)          # let it actually block in the barrier
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        time.sleep(0.6)  # let the server's liveness probe (0.2 s tick)
+        # observe the dropped connection and abort the doomed round —
+        # otherwise the survivor can reuse the dead worker's stale
+        # arrival and sail through
+
+        survivor = ServerKVStore(srv.addr)
+        t0 = time.monotonic()
+        with pytest.raises(MXNetError,
+                           match="barrier (aborted|timed out)"):
+            survivor.barrier()
+        # raised within the barrier timeout budget, no infinite spin
+        assert time.monotonic() - t0 < 30
+        survivor.close()
+    finally:
+        proc.kill()
+        srv.shutdown()
+
+
+def test_barrier_count_resets_after_drop_then_completes():
+    """After an aborted round (dropped peer), a fresh full complement
+    of workers must complete the next barrier — the leaked count used
+    to deadlock every later barrier permanently."""
+    srv = KVStoreServer(num_workers=2, barrier_timeout=15.0)
+    srv.serve_in_background()
+    try:
+        import threading
+
+        ghost = ServerKVStore(srv.addr)
+        t = threading.Thread(target=lambda: _swallow(ghost.barrier))
+        t.start()
+        time.sleep(0.3)          # ghost holds a pending arrival...
+        # ...and dies. shutdown() (not close()) sends the FIN even while
+        # the ghost's own thread is blocked in recv — close() from
+        # another thread leaves the file description pinned by that
+        # syscall and no FIN ever reaches the server. A real process
+        # death (the SIGKILL test above) closes everything kernel-side.
+        import socket as _socket
+
+        ghost._socks[0].shutdown(_socket.SHUT_RDWR)
+        ghost._socks[0].close()
+        t.join(timeout=10)
+        time.sleep(0.6)  # liveness probe aborts the ghost's round
+
+        a, b = ServerKVStore(srv.addr), ServerKVStore(srv.addr)
+        done = []
+        ts = [threading.Thread(target=lambda c=c: (c.barrier(),
+                                                   done.append(1)))
+              for c in (a, b)]
+        t0 = time.monotonic()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=15)
+        assert len(done) == 2, "stale barrier count deadlocked the round"
+        assert time.monotonic() - t0 < 15
+        a.close()
+        b.close()
+    finally:
+        srv.shutdown()
+
+
+def test_dist_sync_refused_under_scheduler_topology(monkeypatch):
+    """dist_sync's sync path is the jax collective whose rendezvous env
+    the scheduler topology replaces — creating it under -s > 0 must
+    raise, not silently train N unsynchronized model copies."""
+    import mxnet_tpu as mx
+
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.delenv("MXNET_TPU_COORDINATOR", raising=False)
+    with pytest.raises(mx.MXNetError, match="scheduler topology"):
+        mx.kv.create("dist_sync")
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass

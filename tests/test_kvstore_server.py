@@ -115,6 +115,7 @@ def test_module_fit_through_server(server, monkeypatch):
     import mxnet_tpu as mx
 
     monkeypatch.setenv("MXNET_PS_SERVER_URI", server.addr)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")  # one actual worker
     np.random.seed(5)  # iterator shuffle order
     mx.random.seed(5)  # initializer draws
     rng = np.random.RandomState(0)
@@ -340,13 +341,16 @@ def test_row_sparse_pull_broadcast_stays_per_key(server):
     kv.close()
 
 
-def test_preconstructed_instance_through_module_fit(server):
+def test_preconstructed_instance_through_module_fit(server, monkeypatch):
     """A ServerKVStore INSTANCE (not the 'dist_async' spec string)
     passed to Module.fit must be accepted by _create_kvstore like every
     other store — it now subclasses kvstore.KVStore."""
     import mxnet_tpu as mx
     from mxnet_tpu.model import _create_kvstore
 
+    # one actual worker drives this test; without the env the store
+    # asks the fixture server, whose barrier width is 2
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
     kv = ServerKVStore(server.addr)
     got, update_on_kv = _create_kvstore(kv, 1, {})
     assert got is kv and update_on_kv
@@ -387,3 +391,132 @@ def test_wire_protocol_refuses_objects():
                                           ("float32", (1,), b"\0\0\0\0"))))
                         ).load()
     assert ok[0] == "push"
+
+
+def test_barrier_timeout_raises_instead_of_spinning():
+    """Regression (ISSUE 2 satellite): a barrier that can never
+    complete (peer missing) used to spin forever; the configurable
+    overall timeout must raise on the waiter instead."""
+    import time
+
+    import mxnet_tpu as mx
+
+    srv = KVStoreServer(num_workers=2, barrier_timeout=1.5)
+    srv.serve_in_background()
+    try:
+        kv = ServerKVStore(srv.addr)
+        t0 = time.monotonic()
+        with pytest.raises(mx.MXNetError, match="barrier timed out"):
+            kv.barrier()
+        assert time.monotonic() - t0 < 10
+        # the aborted round reset the count: a full complement now works
+        kv2 = ServerKVStore(srv.addr)
+        done = []
+        ts = [threading.Thread(target=lambda c=c: (c.barrier(),
+                                                   done.append(1)))
+              for c in (kv, kv2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(done) == 2
+        kv.close()
+        kv2.close()
+    finally:
+        srv.shutdown()
+
+
+def test_set_optimizer_serializes_scheduler_and_mults(server):
+    """Regression (ISSUE 2 satellite): lr_scheduler / lr_mult / wd_mult
+    / idx2name were silently dropped by ServerKVStore.set_optimizer —
+    the server then trained with the wrong per-parameter LRs. They now
+    travel as plain wire data and steer the server-side updater."""
+    import mxnet_tpu as mx
+
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5,
+                                            base_lr=1.0)
+    opt = mx.optimizer.create("sgd", learning_rate=1.0,
+                              lr_scheduler=sched,
+                              param_idx2name={0: "w"})
+    opt.set_lr_mult({"w": 0.5})
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((2,), np.float32))
+    kv.set_optimizer(opt)
+    kv.push("w", np.ones((2,), np.float32))
+    got = np.empty((2,), np.float32)
+    kv.pull("w", out=got)
+
+    # replay locally with an identically-configured optimizer
+    ref_opt = mx.optimizer.create(
+        "sgd", learning_rate=1.0,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(step=1, factor=0.5,
+                                                     base_lr=1.0),
+        param_idx2name={0: "w"})
+    ref_opt.set_lr_mult({"w": 0.5})
+    upd = mx.optimizer.get_updater(ref_opt)
+    w = mx.nd.zeros((2,))
+    upd("w", mx.nd.ones((2,)), w)
+    np.testing.assert_allclose(got, w.asnumpy(), rtol=1e-6)
+    assert not np.allclose(got, -1.0), \
+        "scheduler/lr_mult were dropped (bare lr=1.0 step applied)"
+    kv.close()
+
+
+def test_set_optimizer_warns_on_unrepresentable_config(server):
+    """What cannot cross the data-only wire (param_dict with live
+    Parameter objects, custom scheduler subclasses) must produce a loud
+    warning, never a silent drop."""
+    import mxnet_tpu as mx
+
+    class MyFancySched(mx.lr_scheduler.LRScheduler):
+        def __call__(self, num_update):
+            return self.base_lr
+
+    class FakeParam:
+        lr_mult = 2.0
+        wd_mult = 1.0
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                              lr_scheduler=MyFancySched())
+    opt.param_dict = {"w": FakeParam()}
+    kv = ServerKVStore(server.addr)
+    with pytest.warns(UserWarning, match="DROPPING.*lr_scheduler"):
+        kv.set_optimizer(opt)
+    kv.close()
+
+
+def test_sharded_servers_split_keys_and_merge_opt_state(tmp_path):
+    """Two servers: keys shard by stable hash; push/pull route to the
+    right shard, barriers visit every server, and optimizer-state
+    save/load merges and re-splits the per-shard maps."""
+    import mxnet_tpu as mx  # noqa: F401
+
+    srv_a = KVStoreServer(num_workers=1)
+    srv_b = KVStoreServer(num_workers=1)
+    srv_a.serve_in_background()
+    srv_b.serve_in_background()
+    try:
+        kv = ServerKVStore([srv_a.addr, srv_b.addr])
+        keys = ["fc%d_weight" % i for i in range(8)]
+        for i, k in enumerate(keys):
+            kv.init(k, np.full((3,), float(i), np.float32))
+        kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+        for k in keys:
+            kv.push(k, np.ones((3,), np.float32))
+        for i, k in enumerate(keys):
+            out = np.empty((3,), np.float32)
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out, float(i) - 0.1, rtol=1e-5)
+        # keys really are split across the two stores
+        assert 0 < len(srv_a._store) < len(keys)
+        assert len(srv_a._store) + len(srv_b._store) == len(keys)
+        kv.barrier()  # visits both servers (num_workers=1 each)
+        fname = str(tmp_path / "sharded.states")
+        kv.save_optimizer_states(fname)
+        kv.load_optimizer_states(fname)  # re-splits by the same hash
+        kv.push(keys[0], np.ones((3,), np.float32))  # still serving
+        kv.stop_server()
+        kv.close()
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()

@@ -1,0 +1,215 @@
+"""Tracker (scheduler-rendezvous) subsystem — mxnet_tpu/tracker.py.
+
+Reference bar: the dmlc tracker behind tools/launch.py + the ps-lite
+scheduler node (SURVEY §2.4, kvstore.h:267-340): DMLC_ROLE-tagged
+registration, rank assignment, server-URI publication to workers,
+heartbeat-driven dead-node detection, and barriers that RECOVER (raise)
+when a peer dies instead of spinning forever.
+
+All tests run the Tracker in-process with short timeouts; every wait is
+bounded. The process-topology integration lives in test_dist_async.py.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.tracker import (Tracker, TrackerClient, TrackerError,
+                               connect_with_backoff, tracker_env_spec)
+
+
+@pytest.fixture
+def tracker():
+    trk = Tracker(num_workers=2, num_servers=1, heartbeat_timeout=2.0)
+    trk.serve_in_background()
+    yield trk
+    trk.shutdown()
+
+
+def test_register_assigns_ranks_per_role(tracker):
+    w0 = TrackerClient(tracker.addr, "worker")
+    w1 = TrackerClient(tracker.addr, "worker")
+    s0 = TrackerClient(tracker.addr, "server", addr="127.0.0.1:7777")
+    assert (w0.rank, w1.rank) == (0, 1)
+    assert s0.rank == 0
+    assert w0.num_workers == 2 and w0.num_servers == 1
+    # over-registration is a job misconfiguration, not a silent rank
+    with pytest.raises(TrackerError, match="already assigned"):
+        TrackerClient(tracker.addr, "worker")
+    for c in (w0, w1, s0):
+        c.close()
+
+
+def test_server_uri_publication_blocks_until_rendezvous(tracker):
+    """get_server_uris arriving BEFORE the server registers must wait
+    for it (process start order is arbitrary), then deliver its URI."""
+    w = TrackerClient(tracker.addr, "worker")
+    got = {}
+
+    def fetch():
+        got["uris"] = w.get_server_uris(timeout=10.0)
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    time.sleep(0.3)  # worker is already waiting...
+    s = TrackerClient(tracker.addr, "server", addr="10.0.0.5:9000")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["uris"] == ["10.0.0.5:9000"]
+    w.close()
+    s.close()
+
+
+def test_connect_backoff_tolerates_late_scheduler():
+    """Bounded exponential backoff: a client started before its
+    scheduler is listening connects once the scheduler comes up."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    addr = "%s:%d" % sock.getsockname()[:2]
+    sock.close()  # port free: nothing listening yet
+    trk_box = {}
+
+    def late_start():
+        time.sleep(0.5)
+        host, port = addr.rsplit(":", 1)
+        trk = Tracker(host=host, port=int(port), num_workers=1,
+                      num_servers=0)
+        trk_box["trk"] = trk
+        trk.serve_in_background()
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        c = TrackerClient(addr, "worker", connect_deadline=10.0)
+        assert c.rank == 0
+        c.close()
+    finally:
+        t.join()
+        trk_box["trk"].shutdown()
+
+
+def test_connect_backoff_is_bounded():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    addr = "%s:%d" % sock.getsockname()[:2]
+    sock.close()
+    t0 = time.monotonic()
+    with pytest.raises(TrackerError, match="could not connect"):
+        connect_with_backoff(addr, deadline=0.6)
+    assert time.monotonic() - t0 < 10, "backoff must respect its deadline"
+
+
+def test_dead_node_detection_on_connection_drop(tracker):
+    w0 = TrackerClient(tracker.addr, "worker")
+    w1 = TrackerClient(tracker.addr, "worker")
+    assert w0.num_dead_node() == 0
+    w1.close()  # SIGKILL equivalent: both conns drop, no "done" sent
+    deadline = time.monotonic() + 5
+    while w0.num_dead_node() != 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert w0.num_dead_node() == 1
+    w0.close()
+
+
+def test_dead_node_detection_on_heartbeat_loss():
+    """A wedged process whose sockets stay open but whose beats stop is
+    dead too (ps-lite heartbeat semantics)."""
+    trk = Tracker(num_workers=2, num_servers=0, heartbeat_timeout=1.0)
+    trk.serve_in_background()
+    try:
+        w0 = TrackerClient(trk.addr, "worker", heartbeat_interval=0.2)
+        w1 = TrackerClient(trk.addr, "worker", heartbeat_interval=30.0)
+        deadline = time.monotonic() + 6
+        while w0.num_dead_node() != 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert w0.num_dead_node() == 1, "silent worker never marked dead"
+        w0.close()
+        w1.close()
+    finally:
+        trk.shutdown()
+
+
+def test_barrier_completes_across_workers(tracker):
+    w0 = TrackerClient(tracker.addr, "worker")
+    w1 = TrackerClient(tracker.addr, "worker")
+    done = []
+
+    def arrive(c):
+        c.barrier("b1", timeout=10.0)
+        done.append(c.rank)
+
+    ts = [threading.Thread(target=arrive, args=(c,)) for c in (w0, w1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert sorted(done) == [0, 1]
+    w0.close()
+    w1.close()
+
+
+def test_barrier_recovers_when_peer_dies(tracker):
+    """The reference spins forever when a worker dies mid-barrier; the
+    tracker aborts the round with an error to every survivor."""
+    w0 = TrackerClient(tracker.addr, "worker")
+    w1 = TrackerClient(tracker.addr, "worker")
+    err = {}
+
+    def arrive():
+        try:
+            w0.barrier("doomed", timeout=30.0)
+        except TrackerError as e:
+            err["e"] = str(e)
+
+    t = threading.Thread(target=arrive)
+    t.start()
+    time.sleep(0.4)      # w0 is waiting inside the barrier...
+    w1.close()           # ...when its peer is killed
+    t.join(timeout=10)
+    assert not t.is_alive(), "survivor still spinning after peer death"
+    assert "died" in err["e"], err
+    w0.close()
+
+
+def test_barrier_overall_timeout_raises(tracker):
+    w0 = TrackerClient(tracker.addr, "worker")
+    t0 = time.monotonic()
+    with pytest.raises(TrackerError, match="timed out"):
+        w0.barrier("alone", timeout=1.0)
+    assert time.monotonic() - t0 < 8
+    w0.close()
+
+
+def test_done_fans_out_server_shutdown():
+    """When every worker reports done, the scheduler sends the
+    kvstore_server 'stop' op to each registered server and exits."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    trk = Tracker(num_workers=1, num_servers=1)
+    serve_thread = trk.serve_in_background()
+    srv = KVStoreServer(num_workers=1)
+    srv_thread = threading.Thread(target=srv.serve_forever)
+    srv_thread.start()
+    s = TrackerClient(trk.addr, "server", addr=srv.addr)
+    w = TrackerClient(trk.addr, "worker")
+    assert w.get_server_uris(timeout=10.0) == [srv.addr]
+    w.done()
+    srv_thread.join(timeout=10)
+    assert not srv_thread.is_alive(), "fan-out never stopped the server"
+    serve_thread.join(timeout=10)
+    assert not serve_thread.is_alive(), "tracker kept running after done"
+    w.close()
+    s.close()
+
+
+def test_tracker_env_spec_contract(monkeypatch):
+    monkeypatch.delenv("DMLC_PS_ROOT_URI", raising=False)
+    monkeypatch.delenv("DMLC_NUM_SERVER", raising=False)
+    assert tracker_env_spec() is None
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.1.1.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9091")
+    assert tracker_env_spec() is None, "no servers => no scheduler topology"
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+    assert tracker_env_spec() == ("10.1.1.1:9091", 4, 2)

@@ -2,20 +2,33 @@
 """Distributed job launcher — the dmlc tracker replacement.
 
 Reference counterpart: ``tools/launch.py`` → dmlc-core tracker spawning
-scheduler + servers + workers over ssh/mpi/local (SURVEY §2.4). The
-TPU-native job has only **workers** (one process per host; the jax
-coordinator plays the scheduler's rendezvous role, there are no
-parameter servers), so this launcher spawns N worker processes with the
-rendezvous env and waits.
+scheduler + servers + workers over ssh/mpi/local (SURVEY §2.4). Two
+process topologies, chosen by ``-s``:
+
+**Serverless collectives** (``-s 0``, the default): only workers exist
+— one process per host; the jax coordinator plays the scheduler's
+rendezvous role and gradient sync is a batched XLA collective
+(``--kv-store dist_sync``).
+
+**Scheduler topology** (``-s S`` with S > 0): the reference's full
+process layout. One scheduler (``mxnet_tpu.tracker``) is spawned first,
+then S parameter servers (``mxnet_tpu.kvstore_server``) that register
+with it and publish their URIs, then N workers running your command.
+``kvstore.create('dist_async')`` inside each worker discovers its
+server through the scheduler — no hand-set ``MXNET_PS_SERVER_URI``.
+When every worker reports done, the scheduler fans ``stop`` out to the
+servers, so the whole job exits cleanly.
 
 Usage (reference-compatible):
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
+    python tools/launch.py -n 2 -s 1 python train.py --kv-store dist_async
 
 Modes:
-    --launcher local  (default) N processes on this host, each seeing
+    --launcher local  (default) all processes on this host, each seeing
                       the same devices (CPU testing: combine with
                       XLA_FLAGS=--xla_force_host_platform_device_count=K)
-    --launcher manual print the env each host must export, for running
+    --launcher manual print the env each role must export (scheduler /
+                      server / worker blocks when -s > 0), for running
                       one process per host by hand / with your own
                       orchestrator (k8s, slurm, GKE).
 """
@@ -25,6 +38,9 @@ import signal
 import socket
 import subprocess
 import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_port():
@@ -35,60 +51,167 @@ def _free_port():
     return port
 
 
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference compatibility; the TPU "
-                         "backend has no server processes (ignored)")
-    ap.add_argument("--launcher", choices=("local", "manual"),
-                    default="local")
-    ap.add_argument("--coordinator", default=None,
-                    help="host:port rendezvous (default: 127.0.0.1:random)")
-    ap.add_argument("--env", action="append", default=[],
-                    help="extra KEY=VALUE for workers (repeatable)")
-    ap.add_argument("command", nargs=argparse.REMAINDER)
-    args = ap.parse_args()
-    if not args.command:
-        ap.error("no command given")
+def _base_env(args, coord):
+    """The DMLC env contract shared by every role in both topologies
+    (kvstore.h:267-311: DMLC_PS_ROOT_URI/PORT name the rendezvous
+    endpoint; NUM_WORKER/NUM_SERVER size the job). ``--env`` overrides
+    are applied by the per-role builders, last."""
+    env = dict(os.environ)
+    host, port = coord.rsplit(":", 1)
+    env["DMLC_PS_ROOT_URI"] = host
+    env["DMLC_PS_ROOT_PORT"] = port
+    env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    env["DMLC_NUM_SERVER"] = str(args.num_servers)
+    # spawned helper processes (tracker/server modules) must import
+    # mxnet_tpu regardless of the caller's cwd
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
 
-    coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
 
-    def worker_env(rank):
-        env = dict(os.environ)
-        env["MXNET_TPU_COORDINATOR"] = coord
-        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
-        env["MXNET_TPU_WORKER_RANK"] = str(rank)
-        # DMLC aliases so reference scripts keep working
-        host, port = coord.rsplit(":", 1)
-        env["DMLC_PS_ROOT_URI"] = host
-        env["DMLC_PS_ROOT_PORT"] = port
-        env["DMLC_NUM_WORKER"] = str(args.num_workers)
+def _apply_env_overrides(env, args):
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def _role_env(args, coord, role, rank=0):
+    env = _base_env(args, coord)
+    env["DMLC_ROLE"] = role
+    if role == "server":
+        env["MXNET_KVSTORE_SERVER"] = "1"
+        env["DMLC_SERVER_ID"] = str(rank)
+    if role == "worker":
         env["DMLC_WORKER_ID"] = str(rank)
-        env["DMLC_ROLE"] = "worker"
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
-        return env
+        env["DMLC_RANK"] = str(rank)
+        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_TPU_WORKER_ID"] = str(rank)
+    return _apply_env_overrides(env, args)
 
-    if args.launcher == "manual":
+
+def _serverless_worker_env(args, coord, rank):
+    """Legacy serverless contract (-s 0): jax.distributed rendezvous
+    (the DMLC aliases from _base_env keep reference scripts working)."""
+    env = _base_env(args, coord)
+    env["DMLC_ROLE"] = "worker"
+    env["DMLC_WORKER_ID"] = str(rank)
+    env["MXNET_TPU_COORDINATOR"] = coord
+    env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+    env["MXNET_TPU_WORKER_RANK"] = str(rank)
+    return _apply_env_overrides(env, args)
+
+
+def _print_env(env, keys_prefix=("MXNET_TPU_", "MXNET_KVSTORE_", "DMLC_"),
+               rank_keys=()):
+    for k, v in sorted(env.items()):
+        if k.startswith(keys_prefix):
+            v = "<rank>" if k in rank_keys else v
+            print("export %s=%s" % (k, v))
+
+
+def _manual(args, coord):
+    if args.num_servers <= 0:
         print("# export on host i (i = 0..%d):" % (args.num_workers - 1))
-        for k, v in sorted(worker_env(0).items()):
-            if k.startswith(("MXNET_TPU_", "DMLC_")):
-                v = "<rank>" if k in ("MXNET_TPU_WORKER_RANK",
-                                      "DMLC_WORKER_ID") else v
-                print("export %s=%s" % (k, v))
+        _print_env(_serverless_worker_env(args, coord, 0),
+                   rank_keys=("MXNET_TPU_WORKER_RANK", "DMLC_WORKER_ID"))
         print("# then run on every host: %s" % " ".join(args.command))
         return 0
+    print("# --- scheduler (run first, one process) ---")
+    _print_env(_role_env(args, coord, "scheduler"))
+    print("# run: %s -m mxnet_tpu.tracker" % sys.executable)
+    print("# --- server i (i = 0..%d) ---" % (args.num_servers - 1))
+    _print_env(_role_env(args, coord, "server", 0),
+               rank_keys=("DMLC_SERVER_ID",))
+    print("# run: %s -m mxnet_tpu.kvstore_server" % sys.executable)
+    print("# --- worker i (i = 0..%d) ---" % (args.num_workers - 1))
+    _print_env(_role_env(args, coord, "worker", 0),
+               rank_keys=("DMLC_WORKER_ID", "DMLC_RANK",
+                          "MXNET_TPU_WORKER_ID"))
+    print("# run: %s" % " ".join(args.command))
+    return 0
 
+
+def _wait_procs(procs, deadline):
+    """Wait for every proc, honoring an absolute deadline (None = no
+    limit). Returns (rc, timed_out) with rc = first nonzero status."""
+    rc = 0
+    pending = list(procs)
+    while pending:
+        if deadline is not None and time.monotonic() > deadline:
+            return rc, True
+        for p in list(pending):
+            try:
+                p.wait(timeout=0.25)
+            except subprocess.TimeoutExpired:
+                continue
+            rc = p.returncode or rc
+            pending.remove(p)
+    return rc, False
+
+
+def _spawn_topology(args, coord):
+    """scheduler + S servers + W workers; workers' collective exit
+    status is the job's."""
+    procs = []  # (name, Popen)
+
+    def spawn(name, cmd, env):
+        procs.append((name, subprocess.Popen(cmd, env=env)))
+
+    # -c, not -m: the package __init__ already imports .tracker, and
+    # runpy warns when re-executing an imported submodule as __main__
+    tracker_cmd = [sys.executable, "-c",
+                   "import sys; from mxnet_tpu import tracker; "
+                   "sys.exit(tracker.main())"]
+    deadline = (time.monotonic() + args.timeout) if args.timeout else None
+    try:
+        spawn("scheduler", tracker_cmd,
+              _role_env(args, coord, "scheduler"))
+        for i in range(args.num_servers):
+            spawn("server%d" % i,
+                  [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+                  _role_env(args, coord, "server", i))
+        workers = []
+        for rank in range(args.num_workers):
+            spawn("worker%d" % rank, args.command,
+                  _role_env(args, coord, "worker", rank))
+            workers.append(procs[-1][1])
+
+        rc, timed_out = _wait_procs(workers, deadline)
+        if timed_out:
+            print("launch.py: timeout after %ds, killing the job"
+                  % args.timeout, file=sys.stderr)
+            return 124
+        # workers done: the tracker fans out server shutdown itself
+        # (workers' done reports); give the helpers a grace window
+        helpers = [p for _name, p in procs if p not in workers]
+        _rc, timed_out = _wait_procs(helpers, time.monotonic() + 15)
+        if timed_out:
+            print("launch.py: scheduler/server did not exit after the "
+                  "workers; killing them", file=sys.stderr)
+            rc = rc or 1
+        return rc
+    except KeyboardInterrupt:
+        for _name, p in procs:
+            p.send_signal(signal.SIGTERM)
+        return 1
+    finally:
+        for _name, p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _spawn_serverless(args, coord):
     procs = []
+    deadline = (time.monotonic() + args.timeout) if args.timeout else None
     try:
         for rank in range(args.num_workers):
-            procs.append(subprocess.Popen(args.command,
-                                          env=worker_env(rank)))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
+            procs.append(subprocess.Popen(
+                args.command, env=_serverless_worker_env(args, coord, rank)))
+        rc, timed_out = _wait_procs(procs, deadline)
+        if timed_out:
+            print("launch.py: timeout after %ds, killing the job"
+                  % args.timeout, file=sys.stderr)
+            return 124
         return rc
     except KeyboardInterrupt:
         for p in procs:
@@ -98,6 +221,41 @@ def main():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="number of parameter-server processes; > 0 "
+                         "spawns the full scheduler topology (1 tracker "
+                         "+ S KVStoreServers + N workers) so "
+                         "--kv-store dist_async runs server-side "
+                         "optimization; 0 (default) runs the serverless "
+                         "collective path")
+    ap.add_argument("--launcher", choices=("local", "manual"),
+                    default="local")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port rendezvous — the jax coordinator "
+                         "(-s 0) or the scheduler/tracker (-s > 0) "
+                         "(default: 127.0.0.1:random)")
+    ap.add_argument("--timeout", type=int, default=0,
+                    help="kill the whole job after this many seconds "
+                         "(0 = no limit); exit code 124 on expiry")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for all roles (repeatable)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
+
+    if args.launcher == "manual":
+        return _manual(args, coord)
+    if args.num_servers > 0:
+        return _spawn_topology(args, coord)
+    return _spawn_serverless(args, coord)
 
 
 if __name__ == "__main__":
