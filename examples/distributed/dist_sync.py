@@ -11,8 +11,14 @@ Counterpart of the reference's nightly dist_lenet.py. Launch with:
     # no MXNET_PS_SERVER_URI needed):
     python tools/launch.py -n 2 -s 1 \\
         python examples/distributed/dist_sync.py --kv-store dist_async
+
+    # elastic: coordinated checkpoints every epoch; a crashed worker or
+    # server is respawned and resumes from the checkpointed epoch:
+    python tools/launch.py -n 2 -s 1 --max-restarts 1 \\
+        python examples/distributed/dist_sync.py --kv-store dist_async
 """
 import argparse
+import os
 
 import numpy as np
 
@@ -37,12 +43,69 @@ def main():
     p.add_argument("--num-epochs", type=int, default=4)
     p.add_argument("--num-samples", type=int, default=4000)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="coordinated checkpoint dir (default: "
+                        "MXNET_CHECKPOINT_DIR from the launcher; "
+                        "checkpointing is off when neither is set)")
+    p.add_argument("--checkpoint-period", type=int, default=None,
+                   help="checkpoint every N epochs (default: "
+                        "MXNET_CHECKPOINT_PERIOD or 1)")
     args = p.parse_args()
 
     kv = mx.kv.create(args.kv_store)
-    print("worker %d/%d up (%s); dead nodes: %d"
-          % (kv.rank, kv.num_workers, kv.type, kv.num_dead_node()),
+    restart = int(os.environ.get("DMLC_RESTART_COUNT", "0") or 0)
+    print("worker %d/%d up (%s, restart %d); dead nodes: %d"
+          % (kv.rank, kv.num_workers, kv.type, restart, kv.num_dead_node()),
           flush=True)
+
+    # elastic recovery: resume from the newest coordinated checkpoint
+    # (epoch + this worker's RNG state); the weights themselves live on
+    # the parameter server and arrive through init_optimizer's pull
+    manager = None
+    begin_epoch = 0
+    resume_aux = None
+    if not getattr(kv, "server_side", False) and (
+            args.checkpoint_dir or os.environ.get("MXNET_CHECKPOINT_DIR")):
+        print("WARNING: checkpointing requested but kvstore %r has no "
+              "server-held state to snapshot — the coordinated "
+              "checkpoint path needs the dist_async parameter-server "
+              "tier (launch.py -s > 0); NO checkpoints will be written"
+              % kv.type, flush=True)
+    if getattr(kv, "server_side", False):
+        if args.checkpoint_dir:
+            manager = mx.CheckpointManager(
+                args.checkpoint_dir,
+                period=args.checkpoint_period
+                if args.checkpoint_period is not None
+                else os.environ.get("MXNET_CHECKPOINT_PERIOD", 1),
+                retain=os.environ.get("MXNET_CHECKPOINT_RETAIN", 2))
+        else:
+            # launcher-driven config: MXNET_CHECKPOINT_DIR (+ optional
+            # _PERIOD/_RETAIN); None when checkpointing is off
+            manager = mx.CheckpointManager.from_env()
+            if manager is not None and args.checkpoint_period is not None:
+                # re-route through the constructor so the CLI override
+                # gets the same period >= 1 validation
+                manager = mx.CheckpointManager(
+                    manager.directory, period=args.checkpoint_period,
+                    retain=manager.retain)
+    if manager is not None:
+        # NOTE: resume is unconditional on the directory's contents (a
+        # fresh process pointed at a populated dir continues that run —
+        # that is what makes a full-job restart work); pass a fresh
+        # --checkpoint-dir to start a new run from epoch 0.
+        ck = manager.latest()
+        if ck is not None:
+            begin_epoch = ck.epoch
+            state = ck.worker_state(kv.rank)
+            if state and state.get("numpy_rng") is not None:
+                np.random.set_state(state["numpy_rng"])
+            # aux state (BN stats etc.) never lives on the server —
+            # restore it from the checkpoint (arg weights arrive via
+            # the server pull in init_optimizer)
+            _arg, resume_aux = ck.split_weights()
+            print("worker %d resuming from checkpoint epoch %d (%s)"
+                  % (kv.rank, begin_epoch, ck.path), flush=True)
 
     data = mx.sym.var("data")
     net = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=64, name="fc1"), act_type="relu")
@@ -57,12 +120,18 @@ def main():
     mod = mx.mod.Module(net, context=mx.tpu(0))
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
-    mod.init_params(mx.init.Xavier())
+    mod.init_params(mx.init.Xavier(),
+                    aux_params={k: nd.array(v)
+                                for k, v in (resume_aux or {}).items()},
+                    allow_missing=True)
     loss0 = dict(mod.score(eval_it, mx.metric.create("ce")))["cross-entropy"]
 
+    cb = mx.callback.elastic_checkpoint(manager, mod, kv) \
+        if manager is not None else None
     mod.fit(train, optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
-            kvstore=kv, num_epoch=args.num_epochs)
+            kvstore=kv, num_epoch=args.num_epochs,
+            begin_epoch=begin_epoch, epoch_end_callback=cb)
 
     eval_it.reset()
     loss1 = dict(mod.score(eval_it, mx.metric.create("ce")))["cross-entropy"]

@@ -31,6 +31,111 @@ def do_checkpoint(prefix, period=1):
     return _callback
 
 
+def elastic_checkpoint(manager, mod, kv, state_fn=None):
+    """Epoch-end callback running the COORDINATED checkpoint
+    choreography of the elastic recovery stack (ISSUE 3): every
+    ``manager.period`` epochs, all workers synchronize through three
+    kvstore barriers —
+
+    1. rank 0 creates the staging dir, then barrier A (so every worker
+       sees it);
+    2. every worker persists its own progress (epoch, batch cursor,
+       RNG state) into the staging dir, then barrier B;
+    3. between B and C every non-zero rank is parked inside barrier C,
+       so NO push lands while rank 0 snapshots the server-side weights
+       (the ``arg``/``aux`` params the epoch-end sync just pulled) and
+       optimizer state (through the ``save_optimizer_states`` wire
+       plumbing) and commits atomically; barrier C releases everyone.
+
+    A respawned worker reads ``manager.latest()`` at startup and passes
+    ``begin_epoch=checkpoint.epoch`` to ``fit`` — it rejoins the
+    barrier group at the checkpointed epoch instead of aborting the
+    round (examples/distributed/dist_sync.py shows the wiring).
+
+    ``state_fn() -> dict`` customizes the per-worker progress payload;
+    the default records the numpy global RNG state (bit-exactly
+    restorable via ``numpy.random.set_state``).
+    """
+    rank = kv.rank
+
+    def _default_state():
+        import numpy as np
+
+        return {"numpy_rng": np.random.get_state()}
+
+    state_fn = state_fn or _default_state
+
+    # capability probe ONCE, outside the live choreography: catching
+    # TypeError around the call itself would also swallow unrelated
+    # TypeErrors and silently collapse the three named phases onto one
+    # shared unnamed round — the exact mispairing the names prevent
+    import inspect
+
+    try:
+        _named_barriers = "name" in inspect.signature(kv.barrier).parameters
+    except (TypeError, ValueError):
+        _named_barriers = False
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        epoch = iter_no + 1
+        if not manager.due(epoch):
+            return
+
+        def _sync(phase):
+            # named rounds: a worker respawned between phases replays
+            # from the last committed epoch, and its phase-A arrival
+            # must never pair with a survivor parked in phase B/C —
+            # distinct names make that a bounded timeout, not a silent
+            # mispairing (ServerKVStore.barrier)
+            if _named_barriers:
+                kv.barrier("ckpt-%d-%s" % (epoch, phase))
+            else:
+                kv.barrier()
+
+        if rank == 0:
+            manager.begin(epoch)
+        _sync("stage")                          # A: staging dir exists
+        state = dict(state_fn())
+        state.setdefault("epoch", epoch)
+        state.setdefault("nbatch", 0)           # epoch boundary
+        manager.write_worker_state(epoch, rank, state)
+        _sync("progress")                       # B: all progress staged
+        if rank == 0:
+            if getattr(kv, "server_side", False):
+                # pull INSIDE the quiesced window (every other worker
+                # is parked in barrier C): fit's get_params() snapshot
+                # predates barrier A, so a lagging worker's tail-of-
+                # epoch pushes would be in optimizer.states but not in
+                # weights.pkl — an inconsistent checkpoint
+                import numpy as np
+
+                weights = {}
+                for k, v in (arg or {}).items():
+                    buf = np.empty(v.shape, dtype=v.dtype)
+                    kv.pull(k, out=buf)
+                    weights["arg:%s" % k] = buf
+                kv.save_optimizer_states(
+                    manager.staged_optimizer_states_path(epoch))
+                config = kv.get_optimizer_config()
+            else:
+                weights = {"arg:%s" % k: v.asnumpy()
+                           for k, v in (arg or {}).items()}
+                mod.save_optimizer_states(
+                    manager.staged_optimizer_states_path(epoch))
+                config = None
+            # aux state is worker-local (never server-held): rank 0's
+            # copy is the one the respawn restores
+            weights.update({"aux:%s" % k: v.asnumpy()
+                            for k, v in (aux or {}).items()})
+            manager.commit(epoch, weights=weights,
+                           optimizer_config=config,
+                           num_workers=kv.num_workers)
+        _sync("commit")                         # C: commit visible; the
+        # quiesced window ends — pushes may flow again
+
+    return _callback
+
+
 def log_train_metric(period, auto_reset=False):
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:

@@ -1,0 +1,308 @@
+"""Deterministic fault injection for the elastic recovery stack.
+
+The reference MXNet's fault-tolerance tests SIGKILL real processes on a
+sleep timer — every recovery path is exercised by a race. This module
+replaces that with a *reproducible* harness: ``MXNET_FAULT_SPEC``
+describes exactly which process fails, where, and how, and the hooks in
+``model.py`` (worker steps), ``kvstore_server.py`` (RPC client/server,
+server push application) and ``tracker.py`` (heartbeats) fire the fault
+at the same point on every run.
+
+Grammar (semicolon-separated rules)::
+
+    spec   := rule (';' rule)*
+    rule   := target '@' params
+    target := ('worker'|'server') ':' rank ':' 'crash'
+            | 'rpc' ':' 'drop'
+            | 'heartbeat' ':' 'stall'
+    params := key '=' value (',' key '=' value)*
+
+Rules:
+
+``worker:R:crash@step=N``  /  ``server:R:crash@step=N``
+    The matching process hard-exits (``os._exit(137)`` — the SIGKILL
+    exit code: no atexit hooks, no ``done`` report, exactly like a real
+    preemption) at its N-th step. A worker step is one optimizer-update
+    round (``model._update_params*``); a server step is one applied
+    ``push``. Crash rules default to ``restart=0`` — they fire only in
+    the first incarnation, so a respawned process does not immediately
+    re-crash; override with ``restart=K`` or ``restart=any``.
+
+``rpc:drop@op=OP[,p=P,seed=S][,n=N][,phase=send|reply][,side=client|server]``
+    Connection drop on a matching kvstore RPC. ``phase=send`` (default)
+    drops before the request leaves the client — the server never sees
+    it; ``phase=reply`` drops after the request is sent but before the
+    reply is read — the op IS applied server-side, so the client's
+    retry exercises the sequence-number dedupe. ``side=server`` drops
+    the connection server-side before the op is applied. Either ``p``
+    (probability, drawn from ``random.Random(seed)`` — same seed, same
+    decisions) or ``n`` (fire on the first N matches, deterministic).
+    Omitting both means *every* match fires.
+
+``heartbeat:stall@after=N``
+    The tracker client stops sending heartbeats after the N-th — the
+    wedged-process simulation (sockets stay open, beats stop), which is
+    exactly what the scheduler's heartbeat timeout exists to catch.
+
+A malformed spec raises :class:`FaultSpecError` at parse time — a chaos
+harness that silently no-ops would certify recovery paths that were
+never exercised.
+"""
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+_EXIT_CODE = 137  # SIGKILL'd processes report 128+9; crash mimics that
+
+_TARGETS = ("worker", "server", "rpc", "heartbeat")
+_ACTIONS = {"worker": ("crash",), "server": ("crash",),
+            "rpc": ("drop",), "heartbeat": ("stall",)}
+
+
+class FaultSpecError(ValueError):
+    """MXNET_FAULT_SPEC could not be parsed (or is inconsistent)."""
+
+
+def _parse_int(rule_text, key, value):
+    try:
+        return int(value)
+    except ValueError:
+        raise FaultSpecError(
+            "fault rule %r: %s=%r is not an integer" % (rule_text, key, value))
+
+
+class _Rule:
+    __slots__ = ("text", "target", "rank", "action", "params", "rng",
+                 "fired", "matched")
+
+    def __init__(self, text):
+        self.text = text
+        self.fired = 0
+        self.matched = 0
+        head, sep, tail = text.partition("@")
+        if not sep or not tail:
+            raise FaultSpecError(
+                "fault rule %r: expected '<target>@<k=v,...>'" % text)
+        parts = head.split(":")
+        if parts[0] not in _TARGETS:
+            raise FaultSpecError(
+                "fault rule %r: unknown target %r (expected one of %s)"
+                % (text, parts[0], "/".join(_TARGETS)))
+        self.target = parts[0]
+        if self.target in ("worker", "server"):
+            if len(parts) != 3:
+                raise FaultSpecError(
+                    "fault rule %r: expected '%s:<rank>:<action>@...'"
+                    % (text, self.target))
+            self.rank = _parse_int(text, "rank", parts[1])
+            self.action = parts[2]
+        else:
+            if len(parts) != 2:
+                raise FaultSpecError(
+                    "fault rule %r: expected '%s:<action>@...'"
+                    % (text, self.target))
+            self.rank = None
+            self.action = parts[1]
+        if self.action not in _ACTIONS[self.target]:
+            raise FaultSpecError(
+                "fault rule %r: target %r supports actions %s, got %r"
+                % (text, self.target, "/".join(_ACTIONS[self.target]),
+                   self.action))
+        self.params = {}
+        for kv in tail.split(","):
+            k, sep, v = kv.partition("=")
+            if not sep or not k:
+                raise FaultSpecError(
+                    "fault rule %r: bad parameter %r (expected k=v)"
+                    % (text, kv))
+            self.params[k.strip()] = v.strip()
+        self._validate()
+        p = self.params.get("p")
+        self.rng = random.Random(_parse_int(text, "seed",
+                                            self.params.get("seed", "0"))) \
+            if p is not None else None
+
+    def _validate(self):
+        p = self.params
+        if self.action == "crash" and "step" not in p:
+            raise FaultSpecError(
+                "fault rule %r: crash requires step=N" % self.text)
+        if self.action == "stall" and "after" not in p:
+            raise FaultSpecError(
+                "fault rule %r: stall requires after=N" % self.text)
+        for key in ("step", "after", "n", "seed"):
+            if key in p:
+                _parse_int(self.text, key, p[key])
+        if "p" in p:
+            try:
+                prob = float(p["p"])
+            except ValueError:
+                raise FaultSpecError(
+                    "fault rule %r: p=%r is not a float"
+                    % (self.text, p["p"]))
+            if not 0.0 <= prob <= 1.0:
+                raise FaultSpecError(
+                    "fault rule %r: p=%s out of [0, 1]" % (self.text, prob))
+        if p.get("phase", "send") not in ("send", "reply"):
+            raise FaultSpecError(
+                "fault rule %r: phase must be send|reply" % self.text)
+        if p.get("side", "client") not in ("client", "server"):
+            raise FaultSpecError(
+                "fault rule %r: side must be client|server" % self.text)
+        if p.get("side") == "server" and "phase" in p:
+            # the server hook fires before the op is applied — there is
+            # no reply phase there; silently ignoring the param would
+            # certify a recovery path that was never exercised
+            raise FaultSpecError(
+                "fault rule %r: phase only applies to side=client "
+                "(the server-side drop always happens before the op "
+                "is applied)" % self.text)
+        restart = p.get("restart")
+        if restart is not None and restart != "any":
+            _parse_int(self.text, "restart", restart)
+
+    # -- matching ------------------------------------------------------------
+    def restart_matches(self, restart, default="0"):
+        want = self.params.get("restart", default)
+        if want == "any":
+            return True
+        return int(want) == restart
+
+    def should_fire(self):
+        """Count/probability gate shared by rpc/heartbeat rules; call
+        only after the structural match succeeded."""
+        self.matched += 1
+        if "n" in self.params:
+            return self.matched <= int(self.params["n"])
+        if self.rng is not None:
+            return self.rng.random() < float(self.params["p"])
+        return True
+
+
+def parse_spec(text):
+    """MXNET_FAULT_SPEC text -> [_Rule]. Raises FaultSpecError."""
+    rules = []
+    for chunk in (text or "").split(";"):
+        chunk = chunk.strip()
+        if chunk:
+            rules.append(_Rule(chunk))
+    return rules
+
+
+class ChaosEngine:
+    """One process's view of the fault spec: knows its own role, rank
+    and incarnation (restart count), counts its steps, and fires the
+    matching rules at the configured points."""
+
+    def __init__(self, spec, role=None, rank=None, restart=None):
+        self.rules = parse_spec(spec)
+        self.role = role if role is not None else \
+            os.environ.get("DMLC_ROLE", "worker").lower()
+        if rank is None:
+            if self.role == "server":
+                rank = os.environ.get("DMLC_SERVER_ID", "0")
+            else:
+                rank = (os.environ.get("DMLC_WORKER_ID")
+                        or os.environ.get("DMLC_RANK")
+                        or os.environ.get("MXNET_TPU_WORKER_ID") or "0")
+        self.rank = int(rank or 0)
+        if restart is None:
+            restart = os.environ.get("DMLC_RESTART_COUNT", "0")
+        self.restart = int(restart or 0)
+        self._step = 0
+        self._beats = 0
+        self._exit = os._exit  # injectable for tests
+
+    def _crash(self, rule):
+        print("[chaos] injecting crash: rule %r fired at %s %d step %d "
+              "(restart %d)" % (rule.text, self.role, self.rank,
+                                self._step, self.restart),
+              file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        self._exit(_EXIT_CODE)
+
+    def step(self):
+        """One unit of progress (worker: optimizer round; server:
+        applied push). Fires crash rules scheduled for this step."""
+        self._step += 1
+        for rule in self.rules:
+            if (rule.action == "crash" and rule.target == self.role
+                    and rule.rank == self.rank
+                    and rule.restart_matches(self.restart)
+                    and self._step == int(rule.params["step"])
+                    and not rule.fired):
+                rule.fired += 1
+                self._crash(rule)
+
+    def rpc(self, op, phase="send", side="client"):
+        """True when a matching rpc:drop rule fires for this op."""
+        for rule in self.rules:
+            if rule.target != "rpc" or rule.action != "drop":
+                continue
+            if not rule.restart_matches(self.restart, default="any"):
+                continue
+            want_op = rule.params.get("op")
+            if want_op is not None and want_op != op:
+                continue
+            if rule.params.get("side", "client") != side:
+                continue
+            if side == "client" and rule.params.get("phase", "send") != phase:
+                continue
+            if rule.should_fire():
+                print("[chaos] dropping rpc %r (%s/%s) per rule %r"
+                      % (op, side, phase, rule.text),
+                      file=sys.stderr, flush=True)
+                return True
+        return False
+
+    def heartbeat(self):
+        """True when the heartbeat should be suppressed (stall rule)."""
+        self._beats += 1
+        for rule in self.rules:
+            if (rule.target == "heartbeat" and rule.action == "stall"
+                    and rule.restart_matches(self.restart, default="any")
+                    and self._beats > int(rule.params["after"])):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# process-wide engine (env-driven), with cheap no-op fast path
+# ---------------------------------------------------------------------------
+_UNSET = object()
+_ENGINE = _UNSET
+
+
+def engine():
+    """The process's ChaosEngine, parsed once from MXNET_FAULT_SPEC;
+    None when the env var is unset/empty (the common case — every hook
+    is then a single attribute check)."""
+    global _ENGINE
+    if _ENGINE is _UNSET:
+        spec = os.environ.get("MXNET_FAULT_SPEC", "").strip()
+        _ENGINE = ChaosEngine(spec) if spec else None
+    return _ENGINE
+
+
+def reset_engine():
+    """Forget the cached engine (tests that monkeypatch the env)."""
+    global _ENGINE
+    _ENGINE = _UNSET
+
+
+def tick_step():
+    e = engine()
+    if e is not None:
+        e.step()
+
+
+def rpc_fault(op, phase="send", side="client"):
+    e = engine()
+    return e is not None and e.rpc(op, phase=phase, side=side)
+
+
+def heartbeat_fault():
+    e = engine()
+    return e is not None and e.heartbeat()

@@ -1,0 +1,344 @@
+"""Coordinated checkpoint/restore for elastic distributed training.
+
+Reference counterpart: the parameter-server layer of the reference
+MXNet (ps-lite, SURVEY §2.9) was *designed* for node deaths, but the
+reference never shipped a coordinated snapshot — restarting a job meant
+replaying from the last manual ``save_checkpoint``. This module is the
+recovery half of the tracker subsystem (PR-2): every N barrier epochs
+the job writes one atomic checkpoint directory holding
+
+- ``weights.pkl``      — the sharded server-side weights (``arg:``/
+  ``aux:`` prefixed names, the two-artifact checkpoint convention);
+- ``optimizer.states`` — server-side optimizer state, produced through
+  the same ``save_optimizer_states`` wire plumbing workers already use;
+- ``optimizer.pkl``    — the plain-data optimizer config
+  ``(name, kwargs, extras)`` so a respawned *server* can rebuild its
+  updater before the first retried push arrives;
+- ``worker-<rank>.pkl``— per-worker progress: epoch, batch cursor, RNG
+  state — whatever the training loop needs to resume exactly;
+- ``meta.json``        — epoch, worker count, format version.
+
+Atomicity: everything is staged in a hidden ``.tmp-ckpt-*`` directory,
+every file (and the directory) is fsynced, and one ``os.replace``-style
+rename publishes the checkpoint; the ``LATEST`` pointer file is updated
+with the same write-tmp/fsync/rename dance. A crash at ANY point leaves
+either the previous checkpoint or the new one — never a torn directory
+that ``latest()`` would half-parse. Retention keeps the newest K
+complete checkpoints.
+
+Checkpoint files are LOCAL trusted artifacts (same trust level as any
+``load_checkpoint`` params file); nothing here is ever fed bytes that
+crossed the network — the wire stays on the tagged plain-data protocol.
+"""
+from __future__ import annotations
+
+import errno
+import json
+import os
+import pickle
+import shutil
+
+from .base import MXNetError
+
+FORMAT_VERSION = 1
+_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-" + _PREFIX
+_LATEST = "LATEST"
+
+
+# ---------------------------------------------------------------------------
+# fsync helpers — a checkpoint that only reached the page cache is not
+# a checkpoint (the crash we are defending against loses it)
+# ---------------------------------------------------------------------------
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError as e:  # some filesystems refuse O_RDONLY on dirs
+        if e.errno in (errno.EACCES, errno.EISDIR):
+            return
+        raise
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # fsync on a directory fd is best-effort off POSIX
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(fname, data):
+    """Write ``data`` to ``fname`` via tmp + fsync + rename: a crash
+    mid-write leaves the OLD file intact, never a torn one. This is the
+    shared primitive behind every optimizer-state/checkpoint save
+    (kvstore.py, kvstore_server.py, module.py)."""
+    fname = os.fspath(fname)
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.replace(tmp, fname)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(os.path.abspath(fname)))
+
+
+def unwrap_states_map(data):
+    """Accept both optimizer-state dump shapes — a bare
+    ``{index: state}`` map or the reference's ``(states_map, opt)``
+    tuple — and return the map. THE one definition for every reader
+    (``Updater.set_states``, ``ServerKVStore.load_optimizer_states``,
+    ``KVStoreServer.restore_from_checkpoint``): a format variant added
+    in one place must not half-parse in the others."""
+    if isinstance(data, tuple) and len(data) == 2 \
+            and isinstance(data[1], dict):
+        return data[0]
+    return data
+
+
+# ---------------------------------------------------------------------------
+# read handle
+# ---------------------------------------------------------------------------
+class Checkpoint:
+    """Read handle on one committed checkpoint directory."""
+
+    def __init__(self, path):
+        self.path = os.fspath(path)
+        meta_path = os.path.join(self.path, "meta.json")
+        with open(meta_path, "r") as f:
+            self.meta = json.load(f)
+        self.epoch = int(self.meta["epoch"])
+
+    def weights(self):
+        """{prefixed_name: numpy array} or {} when no weights saved."""
+        p = os.path.join(self.path, "weights.pkl")
+        if not os.path.exists(p):
+            return {}
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def split_weights(self):
+        """(arg_params, aux_params) as plain {name: numpy} dicts — the
+        two-artifact checkpoint split. A resuming WORKER needs this:
+        arg weights come back through the server pull anyway, but aux
+        state (e.g. BatchNorm running stats) never lives on the server
+        and must be restored from the checkpoint or the respawn runs
+        with re-initialized statistics."""
+        arg, aux = {}, {}
+        for name, value in self.weights().items():
+            kind, _, bare = name.partition(":")
+            (arg if kind == "arg" else aux)[bare] = value
+        return arg, aux
+
+    def optimizer_states_path(self):
+        p = os.path.join(self.path, "optimizer.states")
+        return p if os.path.exists(p) else None
+
+    def optimizer_states(self):
+        p = self.optimizer_states_path()
+        if p is None:
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def optimizer_config(self):
+        """(name, kwargs, extras) plain-data tuple, or None."""
+        p = os.path.join(self.path, "optimizer.pkl")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def worker_state(self, rank):
+        p = os.path.join(self.path, "worker-%d.pkl" % int(rank))
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+class CheckpointManager:
+    """Atomic periodic checkpoints with retention.
+
+    Two usage modes:
+
+    - **single-call** (unit tests, single process): :meth:`save` stages
+      and commits in one shot;
+    - **coordinated** (the elastic training callback,
+      ``callback.elastic_checkpoint``): rank 0 calls :meth:`begin`,
+      every worker writes its own progress with
+      :meth:`write_worker_state`, rank 0 stages weights/optimizer state
+      and calls :meth:`commit` — with kvstore barriers between the
+      phases so the snapshot is quiesced (no push lands between the
+      weight pull and the commit).
+    """
+
+    def __init__(self, directory, period=1, retain=2):
+        self.directory = os.fspath(directory)
+        period = int(period)
+        retain = int(retain)
+        if period < 1:
+            raise MXNetError("CheckpointManager: period must be >= 1, "
+                             "got %d" % period)
+        if retain < 1:
+            raise MXNetError("CheckpointManager: retain must be >= 1, "
+                             "got %d" % retain)
+        self.period = period
+        self.retain = retain
+        os.makedirs(self.directory, exist_ok=True)
+
+    @classmethod
+    def from_env(cls):
+        """CheckpointManager from MXNET_CHECKPOINT_DIR (+ optional
+        MXNET_CHECKPOINT_PERIOD / MXNET_CHECKPOINT_RETAIN), or None
+        when no checkpoint directory is configured."""
+        directory = os.environ.get("MXNET_CHECKPOINT_DIR")
+        if not directory:
+            return None
+        return cls(directory,
+                   period=os.environ.get("MXNET_CHECKPOINT_PERIOD", "1"),
+                   retain=os.environ.get("MXNET_CHECKPOINT_RETAIN", "2"))
+
+    # -- naming --------------------------------------------------------------
+    def due(self, epoch):
+        return int(epoch) % self.period == 0
+
+    def _name(self, epoch):
+        return "%s%08d" % (_PREFIX, int(epoch))
+
+    def path_for(self, epoch):
+        return os.path.join(self.directory, self._name(epoch))
+
+    def tmp_path_for(self, epoch):
+        return os.path.join(self.directory,
+                            "%s%08d" % (_TMP_PREFIX, int(epoch)))
+
+    def staged_optimizer_states_path(self, epoch):
+        """Where rank 0 stages optimizer state between begin/commit
+        (``kv.save_optimizer_states`` writes here directly, reusing the
+        existing wire plumbing)."""
+        return os.path.join(self.tmp_path_for(epoch), "optimizer.states")
+
+    # -- staged write --------------------------------------------------------
+    def begin(self, epoch):
+        """Create a fresh staging directory for this epoch (rank 0).
+        Any leftover staging dir from a crashed earlier attempt is
+        discarded."""
+        tmp = self.tmp_path_for(epoch)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        return tmp
+
+    def write_worker_state(self, epoch, rank, state):
+        """Persist one worker's progress into the staging dir. Called
+        by EVERY worker (after rank 0's begin) — each writes only its
+        own file, so no cross-worker file races exist."""
+        tmp = self.tmp_path_for(epoch)
+        if not os.path.isdir(tmp):
+            raise MXNetError(
+                "checkpoint staging dir %s missing: begin(%d) must run "
+                "(rank 0) before worker states are written" % (tmp, epoch))
+        atomic_write_bytes(os.path.join(tmp, "worker-%d.pkl" % int(rank)),
+                           pickle.dumps(state, protocol=4))
+
+    def commit(self, epoch, weights=None, optimizer_config=None,
+               num_workers=None):
+        """Finish the staged checkpoint: write weights/config/meta,
+        fsync everything, publish with one rename, update LATEST,
+        apply retention. Returns the committed path."""
+        tmp = self.tmp_path_for(epoch)
+        if not os.path.isdir(tmp):
+            raise MXNetError("checkpoint commit(%d): begin() was never "
+                             "called (no staging dir %s)" % (epoch, tmp))
+        if weights is not None:
+            atomic_write_bytes(os.path.join(tmp, "weights.pkl"),
+                               pickle.dumps(dict(weights), protocol=4))
+        if optimizer_config is not None:
+            atomic_write_bytes(os.path.join(tmp, "optimizer.pkl"),
+                               pickle.dumps(optimizer_config, protocol=4))
+        meta = {"format": FORMAT_VERSION, "epoch": int(epoch)}
+        if num_workers is not None:
+            meta["num_workers"] = int(num_workers)
+        atomic_write_bytes(os.path.join(tmp, "meta.json"),
+                           json.dumps(meta, sort_keys=True).encode())
+        for name in os.listdir(tmp):
+            _fsync_file(os.path.join(tmp, name))
+        _fsync_dir(tmp)
+        final = self.path_for(epoch)
+        if os.path.isdir(final):  # re-checkpoint of the same epoch
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        _fsync_dir(self.directory)
+        atomic_write_bytes(os.path.join(self.directory, _LATEST),
+                           self._name(epoch).encode())
+        self._apply_retention()
+        return final
+
+    def save(self, epoch, weights=None, optimizer_states=None,
+             optimizer_config=None, worker_states=None, num_workers=None):
+        """Single-call stage+commit (no coordination needed)."""
+        self.begin(epoch)
+        for rank, state in (worker_states or {}).items():
+            self.write_worker_state(epoch, rank, state)
+        if optimizer_states is not None:
+            atomic_write_bytes(self.staged_optimizer_states_path(epoch),
+                               optimizer_states)
+        return self.commit(epoch, weights=weights,
+                           optimizer_config=optimizer_config,
+                           num_workers=num_workers)
+
+    # -- read side -----------------------------------------------------------
+    def _complete(self):
+        """Committed checkpoint names, oldest first."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.startswith(_PREFIX):
+                continue
+            if os.path.exists(os.path.join(self.directory, name,
+                                           "meta.json")):
+                out.append(name)
+        return out
+
+    def latest(self):
+        """Newest complete Checkpoint, or None. Resolved by scanning
+        for committed directories rather than trusting the LATEST
+        pointer — a crash between the commit rename and the pointer
+        update must not hide the committed checkpoint (the pointer is
+        written for humans and external tooling)."""
+        candidates = self._complete()
+        if not candidates:
+            return None
+        return Checkpoint(os.path.join(self.directory, candidates[-1]))
+
+    def _apply_retention(self):
+        names = self._complete()
+        for name in names[:-self.retain] if len(names) > self.retain \
+                else []:
+            shutil.rmtree(os.path.join(self.directory, name),
+                          ignore_errors=True)
+        # stale staging dirs from crashed writers are garbage once a
+        # newer commit landed
+        for name in os.listdir(self.directory):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)

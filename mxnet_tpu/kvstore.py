@@ -256,8 +256,11 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("kvstore: no updater to save")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        from .checkpoint import atomic_write_bytes
+
+        # tmp-fsync-rename: a crash mid-write must never leave a torn
+        # state file that load_optimizer_states half-parses (ISSUE 3)
+        atomic_write_bytes(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

@@ -39,13 +39,16 @@ import socket
 import sys
 import threading
 import time
+import uuid
 import warnings
 import zlib
 
 import numpy as np
 
+from . import chaos
 from . import kvstore
 from .base import MXNetError
+from .checkpoint import atomic_write_bytes
 
 
 # ---------------------------------------------------------------------------
@@ -54,7 +57,25 @@ from .base import MXNetError
 # cycle-free): one hardening surface, not two drifting copies
 # ---------------------------------------------------------------------------
 from .tracker import (_SafeUnpickler, _pack, _recv_exact,  # noqa: F401
-                      _recv_msg, _send_msg, _unpack)
+                      _recv_msg, _send_msg, _unpack,
+                      env_nonneg_int, env_positive_float)
+
+
+def shard_key(key, num_shards):
+    """key -> shard index; stable across processes AND incarnations
+    (builtin hash is salted per-interpreter, crc32 is not). Shared by
+    the client's routing and a respawned server's checkpoint restore —
+    one definition, or the two would drift and a restored server would
+    load the wrong keys."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(str(key).encode()) % num_shards
+
+
+class _RPCTransportError(Exception):
+    """Transport-level RPC failure (reset, timeout, injected drop) —
+    retriable, unlike an ('err', ...) reply which means the server saw
+    the request and rejected it."""
 
 
 def _arr_to_wire(a):
@@ -114,19 +135,36 @@ class KVStoreServer:
     """
 
     def __init__(self, host="127.0.0.1", port=0, num_workers=1,
-                 barrier_timeout=None):
+                 barrier_timeout=None, elastic=None):
         self._store = {}
         self._updater = None
         self._opt_config = None
         self._lock = threading.Lock()
         self._num_workers = num_workers
         self._barrier_cond = threading.Condition()
-        self._barrier_count = 0
-        self._barrier_gen = 0
-        self._barrier_errors = {}   # gen -> abort message
+        # NAMED barrier rounds: state is per name, so two logically
+        # different synchronization points (e.g. the checkpoint
+        # choreography's stage/progress/commit phases) can never pair
+        # with each other — without names, a worker respawned mid-
+        # choreography would re-arrive at phase A and silently release
+        # a survivor waiting in phase B
+        self._barriers = {}         # name -> {"count": int, "gen": int}
+        self._barrier_errors = {}   # (name, gen) -> abort message
+        # push dedupe for idempotent client retries: client_id ->
+        # highest applied per-shard sequence number (one int per live
+        # worker; FIFO-capped so ancient clients cannot grow it)
+        self._seen = {}
+        self._seen_lock = threading.Lock()
+        self._pushes_applied = 0
+        if elastic is None:
+            elastic = env_nonneg_int("MXNET_MAX_RESTARTS", 0) > 0
+        #: elastic mode: a worker dying mid-barrier retracts its own
+        #: arrival (its respawn re-arrives) instead of aborting the
+        #: round for every survivor
+        self._elastic = bool(elastic)
         if barrier_timeout is None:
-            barrier_timeout = float(os.environ.get(
-                "MXNET_KVSTORE_BARRIER_TIMEOUT", "120"))
+            barrier_timeout = env_positive_float(
+                "MXNET_KVSTORE_BARRIER_TIMEOUT", 120)
         self._barrier_timeout = float(barrier_timeout)
         self._conns = set()
         self._stop = threading.Event()
@@ -149,6 +187,65 @@ class KVStoreServer:
                 w = array(self._store[key])
                 self._updater(key, array(grad), w)
                 self._store[key] = w.asnumpy()
+            self._pushes_applied += 1
+        # a server "step" for fault injection = one applied push
+        # (server:R:crash@step=N); outside the lock so the injected
+        # hard-exit never dies holding it
+        chaos.tick_step()
+
+    #: per-client applied-seqno window: retries are immediate, so a
+    #: never-applied seqno can only trail the newest applied one by the
+    #: number of concurrently in-flight pushes — 128 is orders beyond it
+    _SEEN_WINDOW = 128
+
+    def _claim_push(self, meta):
+        """Atomically claim this (client, seqno) push; False means it
+        was already claimed — a retry after a lost reply, acked without
+        re-applying. CLAIM-then-apply, not apply-then-record: a retry
+        racing the original's still-queued apply must see the claim, or
+        the same gradient lands twice. A SET of recently claimed seqnos
+        (not a high-water mark): with concurrent pushers on one shard,
+        a failed send's retry can legitimately arrive AFTER a higher
+        seqno landed, and a high-water check would silently drop that
+        never-applied gradient.
+
+        Chosen tradeoff: at-most-once. A retry that races the
+        original's still-queued apply is acked while the apply is in
+        flight — the gradient still lands (moments later), which is
+        within dist_async's ordering contract; the checkpoint snapshot
+        may miss a push acked microseconds earlier, the same skew any
+        asynchronous snapshot has. The alternative (record after
+        apply) double-applies gradients under the same race, which
+        corrupts training rather than merely reordering it."""
+        if not meta:
+            return True
+        cid, seq = meta["cid"], meta["seq"]
+        with self._seen_lock:
+            entry = self._seen.get(cid)
+            if entry is None:
+                from collections import deque
+
+                entry = self._seen[cid] = (set(), deque())
+            claimed, order = entry
+            if seq in claimed:
+                return False
+            claimed.add(seq)
+            order.append(seq)
+            while len(order) > self._SEEN_WINDOW:
+                claimed.discard(order.popleft())
+            while len(self._seen) > 4096:  # bound: dead clients age out
+                self._seen.pop(next(iter(self._seen)))
+            return True
+
+    def _release_push(self, meta):
+        """Undo a claim whose apply FAILED (err reply, not applied): a
+        later retry of the same seqno must not be acked as done."""
+        if not meta:
+            return
+        with self._seen_lock:
+            entry = self._seen.get(meta["cid"])
+            if entry is not None:
+                entry[0].discard(meta["seq"])
 
     def _set_optimizer(self, name, meta):
         from . import optimizer
@@ -205,17 +302,31 @@ class KVStoreServer:
                     % (cls_name,))
             opt.lr_scheduler = klass(**dict(skw))
 
-    def _abort_barrier_locked(self, msg):
+    def _barrier_state(self, name):
+        from .tracker import prune_barrier_names
+
+        # the checkpoint choreography mints 3 fresh names per epoch:
+        # bound the map like _seen/_barrier_errors (idle-aware shared
+        # pruner — a just-aborted round's waiters must still find
+        # their abort record)
+        b = self._barriers.setdefault(name, {"count": 0, "gen": 0})
+        b["ts"] = time.monotonic()
+        prune_barrier_names(self._barriers, self._barrier_errors, name,
+                            quiescent=lambda s: s["count"] == 0)
+        return b
+
+    def _abort_barrier_locked(self, name, msg):
         """Fail the in-flight barrier round: every waiter raises instead
         of spinning (round-6 fix for the permanent hang when a worker
         holding a pending arrival dies)."""
-        if self._barrier_count == 0:
+        b = self._barrier_state(name)
+        if b["count"] == 0:
             return
-        self._barrier_errors[self._barrier_gen] = msg
+        self._barrier_errors[(name, b["gen"])] = msg
         while len(self._barrier_errors) > 8:
             self._barrier_errors.pop(next(iter(self._barrier_errors)))
-        self._barrier_gen += 1
-        self._barrier_count = 0
+        b["gen"] += 1
+        b["count"] = 0
         self._barrier_cond.notify_all()
 
     @staticmethod
@@ -228,41 +339,57 @@ class KVStoreServer:
         except OSError:
             return True
 
-    def _barrier(self, conn=None):
+    def _barrier(self, conn=None, name=""):
         """Dead-worker handling: each waiter's handler thread probes its
         OWN socket (``_conn_closed``) every wait tick — a waiter whose
-        worker died aborts the round for every survivor; a worker that
-        never arrives is bounded by the overall timeout. Both reset the
-        count, so later barriers start clean (the seed leaked the dead
-        worker's +1 and every subsequent barrier deadlocked)."""
+        worker died aborts the round for every survivor (or, in elastic
+        mode, retracts its own arrival so the respawn re-arrives); a
+        worker that never arrives is bounded by the overall timeout.
+        Both reset the count, so later barriers start clean (the seed
+        leaked the dead worker's +1 and every subsequent barrier
+        deadlocked)."""
         with self._barrier_cond:
-            gen = self._barrier_gen
-            self._barrier_count += 1
-            if self._barrier_count >= self._num_workers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
+            b = self._barrier_state(name)
+            gen = b["gen"]
+            b["count"] += 1
+            if b["count"] >= self._num_workers:
+                b["count"] = 0
+                b["gen"] += 1
                 self._barrier_cond.notify_all()
                 return
             deadline = time.monotonic() + self._barrier_timeout
-            while self._barrier_gen == gen and not self._stop.is_set():
+            while b["gen"] == gen and not self._stop.is_set():
                 if time.monotonic() >= deadline:
-                    msg = ("barrier timed out after %.0fs (%d of %d "
+                    msg = ("barrier %stimed out after %.0fs (%d of %d "
                            "workers arrived)"
-                           % (self._barrier_timeout, self._barrier_count,
+                           % ("%r " % name if name else "",
+                              self._barrier_timeout, b["count"],
                               self._num_workers))
-                    self._abort_barrier_locked(msg)
+                    self._abort_barrier_locked(name, msg)
                     raise MXNetError(msg)
                 if conn is not None and self._conn_closed(conn):
+                    if self._elastic:
+                        # this waiter's own worker died, but its rank
+                        # will be respawned: retract the arrival so the
+                        # respawn re-arrives, and leave the survivors
+                        # waiting (bounded by the overall timeout) —
+                        # "rejoin the barrier group instead of aborting
+                        # the round" (ISSUE 3)
+                        b["count"] = max(0, b["count"] - 1)
+                        self._barrier_cond.notify_all()
+                        raise ConnectionError(
+                            "peer closed during barrier "
+                            "(elastic: arrival retracted)")
                     # this waiter's own worker died mid-barrier
                     self._abort_barrier_locked(
-                        "barrier aborted: a waiting worker "
+                        name, "barrier aborted: a waiting worker "
                         "disconnected")
                     raise ConnectionError("peer closed during barrier")
                 self._barrier_cond.wait(timeout=0.2)
-            err = self._barrier_errors.get(gen)
+            err = self._barrier_errors.get((name, gen))
             if err is not None:
                 raise MXNetError(err)
-            if self._stop.is_set() and self._barrier_gen == gen:
+            if self._stop.is_set() and b["gen"] == gen:
                 raise MXNetError("barrier aborted: server stopped")
 
     def _dispatch(self, op, key, meta, wire, conn=None):
@@ -273,7 +400,13 @@ class KVStoreServer:
                 self._store.setdefault(key, _arr_from_wire(wire))
             return None
         if op == "push":
-            self._apply_push(key, _arr_from_wire(wire))
+            if not self._claim_push(meta):
+                return None  # retried push: already claimed, ack only
+            try:
+                self._apply_push(key, _arr_from_wire(wire))
+            except Exception:
+                self._release_push(meta)
+                raise
             return None
         if op == "pull":
             with self._lock:
@@ -283,10 +416,15 @@ class KVStoreServer:
         if op == "set_optimizer":
             self._set_optimizer(key, meta)
             return None
+        if op == "opt_config":
+            # plain-data (name, kwargs, extras) so the checkpoint can
+            # record it and a respawned server can rebuild its updater
+            with self._lock:
+                return self._opt_config
         if op == "num_workers":
             return self._num_workers
         if op == "barrier":
-            self._barrier(conn)
+            self._barrier(conn, name=str(key or ""))
             return None
         if op == "save_opt":
             with self._lock:
@@ -313,6 +451,10 @@ class KVStoreServer:
         try:
             while not self._stop.is_set():
                 op, key, meta, wire = _recv_msg(conn)
+                if chaos.rpc_fault(op, side="server"):
+                    # injected server-side drop: the op is NOT applied
+                    # and the connection resets under the client
+                    raise ConnectionError("chaos: server dropped %r" % op)
                 if op == "stop":
                     _send_msg(conn, ("ok", None))
                     self.shutdown()
@@ -355,6 +497,43 @@ class KVStoreServer:
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         return t
+
+    def restore_from_checkpoint(self, ckpt, shard_rank=0, num_shards=1):
+        """Preload this server's key shard from a committed checkpoint
+        (the respawn path: a restarted server must hold its weights and
+        optimizer state BEFORE the first retried push arrives, or the
+        surviving workers' pushes hit 'push before init' / run without
+        the momentum the checkpoint recorded). Returns the number of
+        restored keys."""
+        restored = 0
+        weights = ckpt.weights()
+        with self._lock:
+            for name, arr in weights.items():
+                if not name.startswith("arg:"):
+                    continue  # aux state never lives on the server
+                key = name[len("arg:"):]
+                if shard_key(key, num_shards) != shard_rank:
+                    continue
+                self._store[key] = np.ascontiguousarray(arr).copy()
+                restored += 1
+        config = ckpt.optimizer_config()
+        if config is not None:
+            name, kwargs, extras = config
+            self._set_optimizer(name, {"kwargs": kwargs, "extras": extras})
+        states_path = ckpt.optimizer_states_path()
+        if states_path is not None and self._updater is not None:
+            # the checkpoint file is a LOCAL trusted artifact (written
+            # by rank 0 through save_optimizer_states); only this
+            # server's shard of the merged map is installed
+            from .checkpoint import unwrap_states_map
+
+            with open(states_path, "rb") as f:
+                states_map = unwrap_states_map(pickle.loads(f.read()))
+            mine = {k: v for k, v in states_map.items()
+                    if shard_key(k, num_shards) == shard_rank}
+            with self._lock:
+                self._updater.set_states_from_map(mine)
+        return restored
 
     def shutdown(self):
         self._stop.set()
@@ -405,6 +584,17 @@ class ServerKVStore(kvstore.KVStore):
     server_side = True  # Module: route updates through the server, not
     # the fused SPMD step (the server IS the update engine here)
 
+    #: ops safe to retry over a fresh connection after a transport
+    #: failure: pure reads, idempotent writes (init is first-writer-
+    #: wins, set_optimizer is equality-checked, load_opt overwrites),
+    #: and push — which carries a (client, seqno) pair the server
+    #: dedupes on, so an applied-but-reply-lost push is acked, not
+    #: double-applied. barrier/stop are deliberately NOT retried: a
+    #: re-sent barrier arrival could double-count this worker.
+    _RETRY_SAFE = frozenset((
+        "init", "push", "pull", "num_workers", "save_opt", "load_opt",
+        "set_optimizer", "opt_config"))
+
     def __init__(self, uri, kv_type="dist_async", tracker_client=None):
         super().__init__(kv_type)
         from . import tracker as _trk
@@ -421,6 +611,19 @@ class ServerKVStore(kvstore.KVStore):
         self._wlocks = [threading.Lock() for _ in uris]
         self._tracker = tracker_client
         self._num_workers_cache = None
+        # retry identity: a fresh uuid per client instance — dedupe
+        # state must NOT survive a worker respawn (the respawn replays
+        # from its checkpoint, its pushes are new work, not retries)
+        self._client_id = uuid.uuid4().hex
+        # per-shard sequence counters, advanced by _rpc_once under the
+        # shard's send lock: each server must observe ITS stream of
+        # this client's pushes in strictly increasing send order
+        self._push_seq = [0] * len(uris)
+        self._rpc_retries = env_nonneg_int("MXNET_KVSTORE_RPC_RETRIES", 2)
+        self._reconnect_deadline = env_positive_float(
+            "MXNET_KVSTORE_RECONNECT_DEADLINE", 5)
+        self._rediscover_timeout = env_positive_float(
+            "MXNET_KVSTORE_REDISCOVER_TIMEOUT", 30)
 
     @property
     def num_workers(self):
@@ -454,35 +657,112 @@ class ServerKVStore(kvstore.KVStore):
         return self._tracker.num_dead_node()
 
     def _shard(self, key):
-        """key -> server index; stable across processes (builtin hash
-        is salted per-interpreter, crc32 is not)."""
-        if len(self._socks) == 1:
-            return 0
-        return zlib.crc32(str(key).encode()) % len(self._socks)
+        return shard_key(key, len(self._socks))
 
-    def _rpc_idx(self, idx, op, key=None, meta=None, wire=None,
-                 timeout=60.0):
-        sock = self._socks[idx]
+    def _rpc_once(self, idx, op, key, meta, wire, timeout):
+        """One request/reply over the shard's current connection. A
+        transport failure (reset, timeout, injected chaos drop) closes
+        the connection — a late reply would otherwise be consumed as
+        the NEXT op's reply — and raises _RPCTransportError; an
+        ('err', ...) reply raises MXNetError (the server rejected the
+        request: never retried)."""
+        sock = None
         try:
             with self._wlocks[idx]:
+                if op == "push" and meta is not None and "seq" not in meta:
+                    # seqno allocated UNDER the shard's send lock, on
+                    # the first attempt only (retries reuse it): if it
+                    # were drawn outside, two threads could send their
+                    # pushes in the opposite order and the server's
+                    # dedupe would silently drop the lower seqno
+                    meta["seq"] = self._push_seq[idx]
+                    self._push_seq[idx] += 1
+                sock = self._socks[idx]
+                if chaos.rpc_fault(op, phase="send"):
+                    raise ConnectionResetError(
+                        "chaos: dropped %r before send" % op)
                 sock.settimeout(timeout)
                 _send_msg(sock, (op, key, meta, wire))
+                if chaos.rpc_fault(op, phase="reply"):
+                    raise ConnectionResetError(
+                        "chaos: dropped %r reply" % op)
                 status, payload = _recv_msg(sock)
         except (socket.timeout, OSError, ConnectionError) as e:
-            # a timed-out request's reply would otherwise land unread
-            # and be consumed as the NEXT op's reply — invalidate the
-            # connection so later ops fail fast instead of desyncing
-            try:
-                sock.close()
-            except OSError:
-                pass
-            raise MXNetError(
-                "kvstore_server rpc %r to %s failed (%s: %s); "
-                "connection closed" % (op, self._uris[idx],
-                                       type(e).__name__, e))
+            # close the CAPTURED socket, never the slot: a concurrent
+            # thread's _reconnect may already have installed a fresh
+            # one in self._socks[idx]
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise _RPCTransportError("%s: %s" % (type(e).__name__, e))
         if status != "ok":
             raise MXNetError("kvstore_server: %s" % (payload,))
         return payload
+
+    def _reconnect(self, idx):
+        """Fresh connection to shard ``idx``. When the old address is
+        gone and a tracker is attached, re-discover the server list —
+        a respawned server registered its NEW port with the scheduler
+        (the takeover path in tracker.py), and get_server_uris blocks
+        until every shard is alive again."""
+        from . import tracker as _trk
+
+        with self._wlocks[idx]:
+            try:
+                self._socks[idx].close()
+            except OSError:
+                pass
+            try:
+                self._socks[idx] = _trk.connect_with_backoff(
+                    self._uris[idx], deadline=self._reconnect_deadline)
+                return
+            except _trk.TrackerError as e:
+                if self._tracker is None:
+                    raise _RPCTransportError(str(e))
+            try:
+                uris = self._tracker.get_server_uris(
+                    timeout=self._rediscover_timeout)
+            except _trk.TrackerError as e:
+                raise _RPCTransportError("rediscovery failed: %s" % e)
+            if len(uris) != len(self._uris):
+                raise _RPCTransportError(
+                    "rediscovery returned %d servers, expected %d"
+                    % (len(uris), len(self._uris)))
+            self._uris = list(uris)
+            try:
+                self._socks[idx] = _trk.connect_with_backoff(
+                    self._uris[idx], deadline=self._reconnect_deadline)
+            except _trk.TrackerError as e:
+                raise _RPCTransportError(str(e))
+
+    def _rpc_idx(self, idx, op, key=None, meta=None, wire=None,
+                 timeout=60.0):
+        """RPC with bounded retry (ISSUE 3 satellite): a transient
+        connection reset during a retry-safe op reconnects — through
+        tracker re-discovery when the shard was respawned on a new
+        port — and re-sends the SAME request (same push seqno, so the
+        server dedupes an already-applied one) instead of raising
+        through Module.fit."""
+        retries = self._rpc_retries if op in self._RETRY_SAFE else 0
+        last = None
+        for attempt in range(retries + 1):
+            if attempt:
+                try:
+                    self._reconnect(idx)
+                except _RPCTransportError as e:
+                    last = e
+                    continue
+            try:
+                return self._rpc_once(idx, op, key, meta, wire, timeout)
+            except _RPCTransportError as e:
+                last = e
+        raise MXNetError(
+            "kvstore_server rpc %r to shard %d (%s) failed after %d "
+            "attempt(s): %s%s" % (
+                op, idx, self._uris[idx], retries + 1, last,
+                "" if retries else "; connection closed"))
 
     def _rpc(self, op, key=None, meta=None, wire=None):
         """Keyed data ops route to the key's shard; everything else
@@ -516,7 +796,14 @@ class ServerKVStore(kvstore.KVStore):
 
     def push(self, key, value, priority=0):
         for k, v in _iter_kv(key, value):
-            self._rpc("push", k, None, _arr_to_wire(self._merged(v)))
+            # the (cid, seq) pair makes the push idempotent under
+            # retry: a reply lost in transit is re-sent with the SAME
+            # seqno and the server acks without re-applying. The seq
+            # itself is filled in by _rpc_once under the shard's send
+            # lock so concurrent pushes cannot arrive out of order.
+            self._rpc_idx(self._shard(k), "push", k,
+                          {"cid": self._client_id},
+                          _arr_to_wire(self._merged(v)))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .base import MXNetError
@@ -651,8 +938,16 @@ class ServerKVStore(kvstore.KVStore):
         states_map = {}
         for wire in self._rpc_all("save_opt"):
             states_map.update({k: _state_from_wire(w) for k, w in wire})
-        with open(fname, "wb") as f:
-            f.write(pickle.dumps(states_map, protocol=4))
+        # tmp-fsync-rename (ISSUE 3 satellite): a crash mid-write must
+        # never leave a torn file that load_optimizer_states half-parses
+        atomic_write_bytes(fname, pickle.dumps(states_map, protocol=4))
+
+    def get_optimizer_config(self):
+        """The server-side optimizer's plain-data config
+        ``(name, kwargs, extras)`` (or None before set_optimizer) —
+        recorded in checkpoints so a respawned server can rebuild its
+        updater before the first retried push arrives."""
+        return self._rpc_idx(0, "opt_config")
 
     def load_optimizer_states(self, fname):
         """Local file -> server-side optimizer state. The local
@@ -660,11 +955,10 @@ class ServerKVStore(kvstore.KVStore):
         as any locally-loaded checkpoint file — what crosses the wire
         is the tagged plain-data encoding, which the server decodes
         without ever unpickling peer bytes."""
+        from .checkpoint import unwrap_states_map
+
         with open(fname, "rb") as f:
-            states_map = pickle.loads(f.read())
-        if isinstance(states_map, tuple) and len(states_map) == 2 \
-                and isinstance(states_map[1], dict):
-            states_map = states_map[0]  # (states, optimizer) dumps
+            states_map = unwrap_states_map(pickle.loads(f.read()))
         by_server = [[] for _ in self._socks]
         for k, v in states_map.items():
             by_server[self._shard(k)].append((k, _state_to_wire(v)))
@@ -716,14 +1010,17 @@ class ServerKVStore(kvstore.KVStore):
                     dense[ids] = w[ids]
                     t[:] = dense
 
-    def barrier(self):
+    def barrier(self, name=""):
         """Barrier across workers, held at every server in rank order
         (same visit order on every worker, so sharded barriers cannot
         interleave into a deadlock). The server aborts the round with
         an error — raised here — when a peer dies or its overall
-        timeout (MXNET_KVSTORE_BARRIER_TIMEOUT) expires."""
-        bt = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT", "120"))
-        self._rpc_all("barrier", timeout=bt + 30.0)
+        timeout (MXNET_KVSTORE_BARRIER_TIMEOUT) expires. ``name``
+        scopes the round: arrivals at different names never pair (the
+        checkpoint choreography names its three phases so a respawned
+        worker replaying phase A cannot release a survivor's phase B)."""
+        bt = env_positive_float("MXNET_KVSTORE_BARRIER_TIMEOUT", 120)
+        self._rpc_all("barrier", key=name or None, timeout=bt + 30.0)
 
     def stop_server(self):
         self._rpc_all("stop")
@@ -783,6 +1080,39 @@ def _init_kvstore_server_module():
         nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
                                 os.environ.get("DMLC_NUM_WORKER", "1")))
         server = KVStoreServer(host=host, port=port, num_workers=nw)
+        # elastic respawn + full-job restart (ISSUE 3): a server boots
+        # from the latest checkpoint whenever one exists — BEFORE
+        # registering with the scheduler, so workers re-discover the
+        # URI only once the store already holds the restored weights +
+        # optimizer state. Keyed on the DIRECTORY, not the restart
+        # count: on a whole-job relaunch (DMLC_RESTART_COUNT resets to
+        # 0) the workers resume at epoch N from the same directory, and
+        # a server that started empty would let their init() install
+        # fresh random weights under the resumed epoch counter.
+        restart = trk.env_nonneg_int("DMLC_RESTART_COUNT", 0)
+        ckpt_dir = os.environ.get("MXNET_CHECKPOINT_DIR")
+        restored_from = None
+        if ckpt_dir:
+            from .checkpoint import CheckpointManager
+
+            ck = CheckpointManager(ckpt_dir).latest()
+            if ck is not None:
+                # validated reads: a typo'd shard identity would
+                # silently restore the WRONG shard (empty store ->
+                # 'push before init' on every surviving worker)
+                shard_rank = trk.env_nonneg_int("DMLC_SERVER_ID", 0)
+                num_shards = max(
+                    trk.env_nonneg_int("DMLC_NUM_SERVER", 1), 1)
+                nkeys = server.restore_from_checkpoint(
+                    ck, shard_rank=shard_rank, num_shards=num_shards)
+                restored_from = ck.path
+                print("[lifecycle] event=restored-from role=server "
+                      "rank=%d ckpt=%s keys=%d epoch=%d"
+                      % (shard_rank, ck.path, nkeys, ck.epoch), flush=True)
+            elif restart > 0:
+                print("kvstore_server: restart %d but no checkpoint in "
+                      "%s; starting empty" % (restart, ckpt_dir),
+                      flush=True)
         client = None
         if spec is not None:
             advertise = os.environ.get("MXNET_PS_ADVERTISE_HOST")
@@ -803,11 +1133,22 @@ def _init_kvstore_server_module():
             # publish this server's URI to the scheduler; workers
             # discover it at kvstore.create('dist_async') rendezvous.
             # The scheduler's shutdown fan-out sends the 'stop' op
-            # here once every worker reports done.
-            client = trk.TrackerClient(spec[0], "server", addr=addr)
+            # here once every worker reports done. A respawn registers
+            # with its old rank (DMLC_SERVER_ID) + restart count, so
+            # the scheduler swaps the dead node's URI for this one.
+            server_rank = os.environ.get("DMLC_SERVER_ID")
+            client = trk.TrackerClient(
+                spec[0], "server", addr=addr,
+                rank=int(server_rank) if server_rank is not None else None,
+                restart_count=restart)
+            if restored_from is not None:
+                client.log_event("restored-from", role="server",
+                                 rank=server_rank or "0",
+                                 ckpt=restored_from)
         print("kvstore_server listening on %s" % server.addr, flush=True)
         server.serve_forever()
         if client is not None:
+            client.done()  # graceful stop: log 'done', not 'dead'
             client.close()
         sys.exit(0)
     # serverless tier: nothing to run (see module docstring)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 from collections import namedtuple
 
+from . import chaos
 from . import kvstore as kvs
 from . import symbol as sym_mod
 from .base import MXNetError
@@ -58,6 +59,9 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
     priority -idx so comm overlaps backprop; here the push-all phase lets
     a dist kvstore batch every key into one collective before the first
     pull flushes it, and XLA's async dispatch gives the overlap)."""
+    # a worker "step" for deterministic fault injection = one optimizer
+    # round (MXNET_FAULT_SPEC worker:R:crash@step=N, mxnet_tpu/chaos.py)
+    chaos.tick_step()
     live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
@@ -71,6 +75,7 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
+    chaos.tick_step()  # same step definition as the kvstore path above
     live = []
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair

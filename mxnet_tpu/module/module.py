@@ -400,6 +400,10 @@ class Module(BaseModule):
                 self._fused.set_params(self._arg_params, self._aux_params)
                 self._fused_stale = False
             self._fused.forward_backward_update(data_batch)
+            from .. import chaos
+
+            chaos.tick_step()  # fused step = one worker chaos step (the
+            # per-executor paths tick inside model._update_params*)
             self._params_dirty = True
             self._last_fused = True
             return
@@ -467,15 +471,17 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        from ..checkpoint import atomic_write_bytes
+
+        # every branch writes tmp-fsync-rename: a crash mid-save must
+        # never leave a torn .states file (ISSUE 3 satellite)
         if self._fused is not None:
-            with open(fname, "wb") as fout:
-                fout.write(self._fused.get_states())
+            atomic_write_bytes(fname, self._fused.get_states())
             return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

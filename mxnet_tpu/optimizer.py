@@ -515,12 +515,9 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        data = pickle.loads(states)
-        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], dict):
-            states_map, _opt_state = data
-        else:
-            states_map = data
-        self.set_states_from_map(states_map)
+        from .checkpoint import unwrap_states_map
+
+        self.set_states_from_map(unwrap_states_map(pickle.loads(states)))
 
     def set_states_from_map(self, states_map):
         """Install states from a plain {index: numpy/scalar pytree} map.

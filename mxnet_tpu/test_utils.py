@@ -267,3 +267,23 @@ def list_gpus():
 
 def download(url, fname=None, dirname=None, overwrite=False):
     raise MXNetError("download: no network egress in this environment")
+
+
+def clean_dist_env(repo_root=None):
+    """A copy of os.environ with every distributed-topology /
+    elastic-recovery knob stripped and JAX pinned to CPU — the launch
+    environment for subprocess dist tests and tools/chaos_check.py
+    (ONE definition: a knob family added to a private copy would leave
+    the other callers inheriting the operator's stale env)."""
+    import os
+
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("DMLC_", "MXNET_TPU_", "MXNET_PS_", "MXNET_MAX_",
+                         "MXNET_CHECKPOINT_", "MXNET_FAULT_")):
+            del env[k]
+    env["JAX_PLATFORMS"] = "cpu"
+    if repo_root:
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+    return env

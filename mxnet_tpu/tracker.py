@@ -21,8 +21,19 @@ topology:
   workers retry their servers) with exponential backoff up to a
   deadline, so process start order does not matter;
 - **graceful shutdown fan-out** — when every worker reports ``done``
-  (or is dead), the scheduler sends ``stop`` to each registered server
-  and exits, so ``tools/launch.py`` jobs terminate cleanly.
+  (or is dead beyond recovery), the scheduler sends ``stop`` to each
+  registered server and exits, so ``tools/launch.py`` jobs terminate
+  cleanly;
+- **elastic respawn (ISSUE 3)** — with ``MXNET_MAX_RESTARTS`` > 0 a
+  dead node's rank is *recoverable*: ``tools/launch.py`` respawns the
+  process with ``DMLC_RESTART_COUNT`` incremented, the replacement
+  re-registers and takes over the dead rank (and, for servers, its
+  published URI), pending barriers wait for the respawn instead of
+  aborting, and the shutdown fan-out is deferred while a respawn is
+  still possible. Every transition is logged as a structured
+  ``[lifecycle]`` line on the scheduler's stdout — registered / dead /
+  respawned / done / restored-from — so a post-mortem can reconstruct
+  the job timeline from the launcher output alone.
 
 This module is deliberately **stdlib-only** (no jax/numpy): the
 scheduler process imports in milliseconds and the module is importable
@@ -53,6 +64,69 @@ DEFAULT_BARRIER_TIMEOUT = 120.0    # overall tracker-barrier bound
 
 class TrackerError(RuntimeError):
     """Tracker-layer failure (connect exhausted, barrier broken, ...)."""
+
+
+# ---------------------------------------------------------------------------
+# validated env knobs (ISSUE 3 satellite): a typo'd MXNET_TRACKER_*
+# value must fail loudly at read time, not silently fall back to a
+# default that masks the misconfiguration for the rest of the job
+# ---------------------------------------------------------------------------
+def env_positive_float(name, default):
+    """float(os.environ[name]) requiring a finite value > 0; raises
+    TrackerError on nonsense (non-numeric, 0, negative, inf/nan)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return float(default)
+    try:
+        value = float(raw)
+    except ValueError:
+        raise TrackerError(
+            "%s=%r is not a number (expected a positive duration in "
+            "seconds)" % (name, raw))
+    if not 0 < value < float("inf"):  # also rejects NaN
+        raise TrackerError(
+            "%s=%r must be a finite value > 0" % (name, raw))
+    return value
+
+
+def prune_barrier_names(barriers, errors, current, quiescent,
+                        limit=64, min_idle=5.0):
+    """Bound per-name barrier state (shared by Tracker and
+    KVStoreServer — one definition, or the two would drift): evict
+    quiescent names oldest-first once ``limit`` is exceeded, together
+    with their abort records. Only entries idle for ``min_idle``
+    seconds are touched: a just-aborted round's sleeping waiters (wait
+    tick 0.2 s) must still find their abort record when they wake —
+    evicting it would turn an aborted barrier into a silent success.
+    Callers must hold their state lock and stamp ``s["ts"]`` on every
+    touch."""
+    if len(barriers) <= limit:
+        return
+    now = time.monotonic()
+    stale = [n for n, s in barriers.items()
+             if n != current and quiescent(s)
+             and now - s.get("ts", now) >= min_idle]
+    for name in stale[:len(barriers) - limit]:
+        barriers.pop(name)
+        for key in [k for k in errors if k[0] == name]:
+            errors.pop(key)
+
+
+def env_nonneg_int(name, default):
+    """int(os.environ[name]) requiring >= 0; raises TrackerError on
+    nonsense."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return int(default)
+    try:
+        value = int(raw)
+    except ValueError:
+        raise TrackerError(
+            "%s=%r is not an integer (expected a count >= 0)"
+            % (name, raw))
+    if value < 0:
+        raise TrackerError("%s=%r must be >= 0" % (name, raw))
+    return value
 
 
 # ---------------------------------------------------------------------------
@@ -125,9 +199,9 @@ def connect_with_backoff(uri, deadline=30.0, base_delay=0.05, max_delay=2.0):
 # ---------------------------------------------------------------------------
 class _Node:
     __slots__ = ("node_id", "role", "rank", "addr", "last_beat", "alive",
-                 "done")
+                 "done", "replaced", "restart")
 
-    def __init__(self, node_id, role, rank, addr):
+    def __init__(self, node_id, role, rank, addr, restart=0):
         self.node_id = node_id
         self.role = role
         self.rank = rank
@@ -135,24 +209,37 @@ class _Node:
         self.last_beat = time.monotonic()
         self.alive = True
         self.done = False
+        self.replaced = False   # a respawn took over this node's rank
+        self.restart = restart  # incarnation number (DMLC_RESTART_COUNT)
 
 
 class Tracker:
     """The scheduler process: registration, rank assignment, server-URI
-    publication, heartbeats, barriers with dead-peer recovery, shutdown
-    fan-out."""
+    publication, heartbeats, barriers with dead-peer recovery, elastic
+    respawn bookkeeping, shutdown fan-out."""
+
+    #: how long a respawning registration waits for the previous
+    #: incarnation to be marked dead (its sockets close at process
+    #: death, so conn-drop detection is near-immediate; this bound only
+    #: matters for wedged-but-alive predecessors)
+    TAKEOVER_WAIT = 10.0
 
     def __init__(self, host="127.0.0.1", port=0, num_workers=1,
                  num_servers=0, heartbeat_timeout=DEFAULT_HEARTBEAT_TIMEOUT,
-                 barrier_timeout=DEFAULT_BARRIER_TIMEOUT):
+                 barrier_timeout=DEFAULT_BARRIER_TIMEOUT,
+                 max_restarts=None):
         self._num_workers = int(num_workers)
         self._num_servers = int(num_servers)
         self._heartbeat_timeout = float(heartbeat_timeout)
         self._barrier_timeout = float(barrier_timeout)
+        if max_restarts is None:
+            max_restarts = env_nonneg_int("MXNET_MAX_RESTARTS", 0)
+        self._max_restarts = int(max_restarts)
+        self._restarts = {}         # (role, rank) -> takeovers so far
+        self._t0 = time.monotonic()
         self._cv = threading.Condition()
         self._nodes = {}            # node_id -> _Node
         self._next_id = 0
-        self._next_rank = {"worker": 0, "server": 0}
         self._barriers = {}         # name -> {"gen": int, "arrived": set}
         self._barrier_errors = {}   # (name, gen) -> message
         self._stop = threading.Event()
@@ -164,19 +251,40 @@ class Tracker:
         self._sock.listen(32)
         self.addr = "%s:%d" % self._sock.getsockname()[:2]
 
+    # -- lifecycle log -------------------------------------------------------
+    def _lifecycle(self, event, **fields):
+        """One structured timeline line on the scheduler's stdout (the
+        launcher inherits it), e.g.
+        ``[lifecycle] t=+12.3s event=dead role=worker rank=1 ...``."""
+        parts = ["[lifecycle]", "t=+%.1fs" % (time.monotonic() - self._t0),
+                 "event=%s" % event]
+        parts += ["%s=%s" % (k, v) for k, v in fields.items()]
+        print(" ".join(parts), flush=True)
+
     # -- state helpers (lock held) -------------------------------------------
     def _num_dead_locked(self):
         return sum(1 for n in self._nodes.values()
-                   if not n.alive and not n.done)
+                   if not n.alive and not n.done and not n.replaced)
 
     def _servers_locked(self):
         return sorted((n for n in self._nodes.values()
-                       if n.role == "server"), key=lambda n: n.rank)
+                       if n.role == "server" and not n.replaced),
+                      key=lambda n: n.rank)
+
+    def _respawnable_locked(self, node):
+        """Can this dead node still be replaced by a respawn? True only
+        in elastic mode while the (role, rank) restart budget lasts."""
+        if node.done or node.replaced or self._max_restarts <= 0:
+            return False
+        used = self._restarts.get((node.role, node.rank), 0)
+        return used < self._max_restarts
 
     def _abort_barrier_locked(self, name, msg):
         b = self._barriers.get(name)
         if b is None or not b["arrived"]:
             return
+        b["ts"] = time.monotonic()  # abort = activity: waiters still
+        # need this round's error record (see prune_barrier_names)
         self._barrier_errors[(name, b["gen"])] = msg
         # prune: keep only the newest few abort records
         while len(self._barrier_errors) > 32:
@@ -187,31 +295,52 @@ class Tracker:
 
     def _mark_dead_locked(self, node_id, why):
         node = self._nodes.get(node_id)
-        if node is None or node.done or not node.alive:
+        if node is None or node.done or not node.alive or node.replaced:
             return
         node.alive = False
-        for name in list(self._barriers):
-            self._abort_barrier_locked(
-                name, "barrier %r broken: %s %d (rank %d) died (%s)"
-                % (name, node.role, node_id, node.rank, why))
+        respawnable = self._respawnable_locked(node)
+        self._lifecycle("dead", role=node.role, rank=node.rank,
+                        node=node_id, cause="\"%s\"" % why,
+                        respawn="pending" if respawnable else "none")
+        if respawnable:
+            # elastic mode: the round survives — the dead node's pending
+            # arrivals are retracted so its respawn must re-arrive, and
+            # every waiter keeps waiting (bounded by its own timeout)
+            for b in self._barriers.values():
+                b["arrived"].discard(node_id)
+        else:
+            for name in list(self._barriers):
+                self._abort_barrier_locked(
+                    name, "barrier %r broken: %s %d (rank %d) died (%s)"
+                    % (name, node.role, node_id, node.rank, why))
         self._cv.notify_all()
         self._maybe_finish_locked()
 
     def _maybe_finish_locked(self):
-        """All expected workers done-or-dead => shutdown fan-out."""
-        workers = [n for n in self._nodes.values() if n.role == "worker"]
+        """All expected workers done (or dead beyond recovery) =>
+        shutdown fan-out. A dead worker whose rank can still be
+        respawned holds the job open — tearing the servers down while
+        the launcher is mid-respawn would turn a recoverable crash into
+        a job failure."""
+        workers = [n for n in self._nodes.values()
+                   if n.role == "worker" and not n.replaced]
         if len(workers) < self._num_workers or self._fanned_out:
             return
-        if all(n.done or not n.alive for n in workers):
+        if all(n.done or (not n.alive and not self._respawnable_locked(n))
+               for n in workers):
             self._fanned_out = True
-            servers = [n.addr for n in self._servers_locked() if n.addr]
+            servers = [(n.node_id, n.addr)
+                       for n in self._servers_locked() if n.addr]
             threading.Thread(target=self._fan_out_stop, args=(servers,),
                              daemon=True).start()
 
-    def _fan_out_stop(self, server_addrs):
+    def _fan_out_stop(self, servers):
         """Send the kvstore_server protocol 'stop' to every server, then
-        stop the tracker itself (graceful job teardown)."""
-        for addr in server_addrs:
+        stop the tracker itself (graceful job teardown). A stop-acked
+        server is marked done here — its own 'done' report would race
+        the tracker shutdown and the timeline would log a spurious
+        'dead' for a gracefully stopped server."""
+        for node_id, addr in servers:
             try:
                 s = connect_with_backoff(addr, deadline=5.0)
                 try:
@@ -222,51 +351,163 @@ class Tracker:
                 finally:
                     s.close()
             except (TrackerError, OSError, ConnectionError):
-                pass  # server already gone
+                continue  # server already gone
+            self._op_done({"node_id": node_id})
         self.shutdown()
 
     # -- op handlers ---------------------------------------------------------
+    def _role_nodes_locked(self, role):
+        return [n for n in self._nodes.values()
+                if n.role == role and not n.replaced]
+
+    def _takeover_locked(self, old, restart, addr):
+        """Replace a dead node with its respawned incarnation: same
+        rank, fresh node_id (and, for servers, a fresh published
+        addr)."""
+        old.replaced = True
+        key = (old.role, old.rank)
+        self._restarts[key] = self._restarts.get(key, 0) + 1
+        nid = self._next_id
+        self._next_id += 1
+        node = _Node(nid, old.role, old.rank, addr, restart=restart)
+        self._nodes[nid] = node
+        self._lifecycle("respawned", role=node.role, rank=node.rank,
+                        node=nid, restart=restart,
+                        replaces=old.node_id,
+                        restarts_used="%d/%d" % (self._restarts[key],
+                                                 self._max_restarts))
+        return node
+
     def _op_register(self, conn_nodes, p):
         role = p.get("role")
         if role not in ("worker", "server"):
             raise ValueError("register: bad role %r" % (role,))
+        want = p.get("rank")
+        restart = int(p.get("restart") or 0)
+        addr = p.get("addr")
+        limit = (self._num_workers if role == "worker"
+                 else self._num_servers)
         with self._cv:
-            limit = (self._num_workers if role == "worker"
-                     else self._num_servers)
-            rank = self._next_rank[role]
-            if rank >= limit:
-                raise ValueError(
-                    "register: all %d %s ranks already assigned"
-                    % (limit, role))
-            self._next_rank[role] += 1
-            nid = self._next_id
-            self._next_id += 1
-            self._nodes[nid] = _Node(nid, role, rank, p.get("addr"))
-            conn_nodes.add(nid)
+            node = None
+            if want is not None:
+                want = int(want)
+                if want < 0 or want >= limit:
+                    raise ValueError(
+                        "register: rank %d out of range for %d %ss"
+                        % (want, limit, role))
+                existing = next((n for n in self._role_nodes_locked(role)
+                                 if n.rank == want), None)
+                if existing is not None and existing.alive \
+                        and not existing.done and restart > 0:
+                    # respawn raced ahead of dead-detection of its
+                    # predecessor: wait for the conn-drop/heartbeat scan
+                    deadline = time.monotonic() + self.TAKEOVER_WAIT
+                    while existing.alive and time.monotonic() < deadline \
+                            and not self._stop.is_set():
+                        self._cv.wait(timeout=0.1)
+                if existing is not None:
+                    # a DONE node stays alive=True forever (it is never
+                    # marked dead), but its work is over: a respawn for
+                    # its rank — e.g. the process exited nonzero AFTER
+                    # its atexit done() — takes over instead of burning
+                    # the restart budget on 'already alive' errors. A
+                    # DEAD node's takeover is gated on the same elastic
+                    # budget as every other respawn decision: in
+                    # non-elastic mode (or past the budget) the job is
+                    # already tearing itself down around this rank, and
+                    # accepting the registration would report a healthy
+                    # topology over a dying job.
+                    can_take = restart > 0 and (
+                        existing.done
+                        or (not existing.alive
+                            and self._respawnable_locked(existing)))
+                    if can_take:
+                        node = self._takeover_locked(existing, restart,
+                                                     addr)
+                    elif existing.alive and not existing.done:
+                        raise ValueError(
+                            "register: %s rank %d is already registered "
+                            "and alive (node %d)"
+                            % (role, want, existing.node_id))
+                    else:
+                        used = self._restarts.get((role, want), 0)
+                        raise ValueError(
+                            "register: %s rank %d cannot be taken over "
+                            "(restart=%d, respawn budget %d/%d)"
+                            % (role, want, restart, used,
+                               self._max_restarts))
+                else:
+                    node = self._new_node_locked(role, want, addr, restart)
+            elif restart > 0:
+                # respawn that does not know its env rank: take over
+                # the lowest dead-but-respawnable rank of this role
+                # (budget-checked — the tracker may already have
+                # aborted barriers for an over-budget rank, and a
+                # takeover past MXNET_MAX_RESTARTS would register into
+                # a job that is tearing itself down)
+                deadline = time.monotonic() + self.TAKEOVER_WAIT
+                while not self._stop.is_set():
+                    dead = sorted((n for n in self._role_nodes_locked(role)
+                                   if not n.alive
+                                   and self._respawnable_locked(n)),
+                                  key=lambda n: n.rank)
+                    if dead:
+                        node = self._takeover_locked(dead[0], restart, addr)
+                        break
+                    if time.monotonic() >= deadline:
+                        raise ValueError(
+                            "register: restart=%d but no dead %s rank to "
+                            "take over" % (restart, role))
+                    self._cv.wait(timeout=0.1)
+            if node is None:
+                taken = {n.rank for n in self._role_nodes_locked(role)}
+                rank = next((r for r in range(limit) if r not in taken),
+                            None)
+                if rank is None:
+                    raise ValueError(
+                        "register: all %d %s ranks already assigned"
+                        % (limit, role))
+                node = self._new_node_locked(role, rank, addr, restart)
+            conn_nodes.add(node.node_id)
             self._cv.notify_all()
-        return {"node_id": nid, "rank": rank,
+        return {"node_id": node.node_id, "rank": node.rank,
                 "num_workers": self._num_workers,
                 "num_servers": self._num_servers}
 
+    def _new_node_locked(self, role, rank, addr, restart):
+        nid = self._next_id
+        self._next_id += 1
+        node = _Node(nid, role, rank, addr, restart=restart)
+        self._nodes[nid] = node
+        self._lifecycle("registered", role=role, rank=rank, node=nid,
+                        addr=addr or "-", restart=restart)
+        return node
+
     def _op_get_servers(self, p):
-        """Block until every expected server registered; return their
-        URIs in rank order."""
+        """Block until every expected server is registered AND alive;
+        return their URIs in rank order. A dead server aborts the wait
+        — unless its rank can still be respawned (elastic mode), in
+        which case the caller keeps waiting and receives the
+        REPLACEMENT's URI once it re-registers (this is how a worker's
+        RPC-retry loop re-discovers a respawned server's new port)."""
         timeout = float(p.get("timeout", 60.0))
         deadline = time.monotonic() + timeout
         with self._cv:
             while not self._stop.is_set():
                 servers = self._servers_locked()
-                if len(servers) >= self._num_servers:
-                    return [n.addr for n in servers]
-                dead = [n for n in servers if not n.alive]
+                alive = [n for n in servers if n.alive]
+                if len(alive) >= self._num_servers:
+                    return [n.addr for n in alive]
+                dead = [n for n in servers
+                        if not n.alive and not self._respawnable_locked(n)]
                 if dead:
                     raise TrackerError(
                         "get_servers: server rank %d died during "
                         "rendezvous" % dead[0].rank)
                 if time.monotonic() >= deadline:
                     raise TrackerError(
-                        "get_servers: %d of %d servers registered within "
-                        "%.0fs" % (len(servers), self._num_servers, timeout))
+                        "get_servers: %d of %d servers alive within "
+                        "%.0fs" % (len(alive), self._num_servers, timeout))
                 self._cv.wait(timeout=0.2)
             raise TrackerError("get_servers: tracker stopped")
 
@@ -290,6 +531,9 @@ class Tracker:
         deadline = time.monotonic() + timeout
         with self._cv:
             b = self._barriers.setdefault(name, {"gen": 0, "arrived": set()})
+            b["ts"] = time.monotonic()
+            prune_barrier_names(self._barriers, self._barrier_errors, name,
+                                quiescent=lambda s: not s["arrived"])
             gen = b["gen"]
             b["arrived"].add(nid)
             if len(b["arrived"]) >= self._num_workers:
@@ -317,8 +561,10 @@ class Tracker:
         nid = p.get("node_id")
         with self._cv:
             node = self._nodes.get(nid)
-            if node is not None:
+            if node is not None and not node.done:
                 node.done = True
+                self._lifecycle("done", role=node.role, rank=node.rank,
+                                node=nid)
             self._maybe_finish_locked()
         return None
 
@@ -326,11 +572,24 @@ class Tracker:
         with self._cv:
             return self._num_dead_locked()
 
+    def _op_event(self, p):
+        """Client-reported lifecycle event (e.g. a respawned server's
+        ``restored-from=<ckpt>``): folded into the scheduler's timeline
+        log so one stream reconstructs the whole job."""
+        event = str(p.get("event", "client-event"))
+        fields = p.get("fields") or {}
+        if not isinstance(fields, dict):
+            raise ValueError("event: fields must be a dict")
+        clean = {str(k): str(v) for k, v in sorted(fields.items())}
+        self._lifecycle(event, **clean)
+        return None
+
     def _op_nodes(self):
         """Topology snapshot (debugging / tests)."""
         with self._cv:
             return [{"node_id": n.node_id, "role": n.role, "rank": n.rank,
-                     "addr": n.addr, "alive": n.alive, "done": n.done}
+                     "addr": n.addr, "alive": n.alive, "done": n.done,
+                     "replaced": n.replaced, "restart": n.restart}
                     for n in self._nodes.values()]
 
     def _dispatch(self, conn_nodes, op, p):
@@ -346,6 +605,8 @@ class Tracker:
             return self._op_done(p)
         if op == "num_dead":
             return self._op_num_dead()
+        if op == "event":
+            return self._op_event(p)
         if op == "nodes":
             return self._op_nodes()
         raise ValueError("unknown op %r" % (op,))
@@ -449,23 +710,34 @@ class TrackerClient:
 
     def __init__(self, uri, role, addr=None,
                  connect_deadline=30.0,
-                 heartbeat_interval=None):
+                 heartbeat_interval=None, rank=None, restart_count=0):
         self._uri = uri
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._done_sent = False
+        # validate BEFORE connecting: a bad env knob must not leave a
+        # half-registered node behind
+        if heartbeat_interval is None:
+            heartbeat_interval = env_positive_float(
+                "MXNET_TRACKER_HEARTBEAT_INTERVAL",
+                DEFAULT_HEARTBEAT_INTERVAL)
         self._sock = connect_with_backoff(uri, deadline=connect_deadline)
-        info = self._rpc("register", {"role": role, "addr": addr})
+        payload = {"role": role, "addr": addr}
+        if rank is not None:
+            payload["rank"] = int(rank)
+        if restart_count:
+            payload["restart"] = int(restart_count)
+        # a respawning registration may wait TAKEOVER_WAIT server-side
+        # for its dead predecessor; give the rpc room beyond that
+        info = self._rpc("register", payload,
+                         timeout=Tracker.TAKEOVER_WAIT + 20.0)
         self.node_id = info["node_id"]
         self.rank = info["rank"]
         self.num_workers = info["num_workers"]
         self.num_servers = info["num_servers"]
         self.role = role
+        self.restart_count = int(restart_count)
         # heartbeats: dedicated connection + thread
-        if heartbeat_interval is None:
-            heartbeat_interval = float(os.environ.get(
-                "MXNET_TRACKER_HEARTBEAT_INTERVAL",
-                str(DEFAULT_HEARTBEAT_INTERVAL)))
         self._hb_sock = connect_with_backoff(uri, deadline=connect_deadline)
         self._hb_thread = threading.Thread(
             target=self._beat, args=(float(heartbeat_interval),),
@@ -495,8 +767,12 @@ class TrackerClient:
         return reply
 
     def _beat(self, interval):
+        from . import chaos  # stdlib-only, cycle-free
+
         hb_lock = threading.Lock()
         while not self._closed.wait(interval):
+            if chaos.heartbeat_fault():
+                continue  # injected wedge: socket stays open, beat lost
             try:
                 self._rpc("heartbeat", {"node_id": self.node_id},
                           timeout=10.0, sock=self._hb_sock, lock=hb_lock)
@@ -511,10 +787,13 @@ class TrackerClient:
 
     def barrier(self, name="", timeout=None):
         """Tracker barrier across all workers. Raises TrackerError on a
-        dead peer or on the overall timeout — never spins forever."""
-        timeout = float(timeout if timeout is not None
-                        else os.environ.get("MXNET_TRACKER_BARRIER_TIMEOUT",
-                                            str(DEFAULT_BARRIER_TIMEOUT)))
+        dead peer or on the overall timeout — never spins forever. In
+        elastic mode a dead-but-respawnable peer keeps the round open
+        (its respawn re-arrives) instead of aborting it."""
+        if timeout is None:
+            timeout = env_positive_float("MXNET_TRACKER_BARRIER_TIMEOUT",
+                                         DEFAULT_BARRIER_TIMEOUT)
+        timeout = float(timeout)
         self._rpc("barrier",
                   {"node_id": self.node_id, "name": name, "timeout": timeout},
                   timeout=timeout + 15.0)
@@ -524,6 +803,18 @@ class TrackerClient:
 
     def nodes(self):
         return self._rpc("nodes")
+
+    def log_event(self, event, **fields):
+        """Report a lifecycle event into the scheduler's timeline log
+        (e.g. ``restored-from``). Best-effort: a dead tracker must not
+        fail the caller's recovery path."""
+        try:
+            self._rpc("event", {"event": str(event),
+                                "fields": {str(k): str(v)
+                                           for k, v in fields.items()}},
+                      timeout=10.0)
+        except (TrackerError, OSError, ConnectionError):
+            pass
 
     def done(self):
         """Report graceful completion (idempotent; swallows a dead
@@ -573,7 +864,12 @@ def worker_client():
     """This process's TrackerClient (role=worker), created on first use
     from the env contract; None when no scheduler topology is
     configured. Registers an atexit hook that reports ``done`` so the
-    scheduler can fan out shutdown to the servers."""
+    scheduler can fan out shutdown to the servers.
+
+    Under ``tools/launch.py`` the env names this worker's rank
+    (``DMLC_WORKER_ID``) and incarnation (``DMLC_RESTART_COUNT``); a
+    respawned worker therefore takes over exactly its predecessor's
+    rank — the rank whose progress the checkpoint recorded."""
     global _WORKER_CLIENT
     with _WORKER_CLIENT_LOCK:
         if _WORKER_CLIENT is not None:
@@ -582,7 +878,12 @@ def worker_client():
         if spec is None:
             return None
         uri, _nw, _ns = spec
-        client = TrackerClient(uri, "worker")
+        rank = os.environ.get("DMLC_WORKER_ID",
+                              os.environ.get("DMLC_RANK"))
+        restart = env_nonneg_int("DMLC_RESTART_COUNT", 0)
+        client = TrackerClient(uri, "worker",
+                               rank=int(rank) if rank is not None else None,
+                               restart_count=restart)
         import atexit
 
         atexit.register(lambda: (client.done(), client.close()))
@@ -608,16 +909,20 @@ def main():
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "0"))
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
     num_servers = int(os.environ.get("DMLC_NUM_SERVER", "0") or 0)
-    hb_timeout = float(os.environ.get("MXNET_TRACKER_HEARTBEAT_TIMEOUT",
-                                      str(DEFAULT_HEARTBEAT_TIMEOUT)))
+    hb_timeout = env_positive_float("MXNET_TRACKER_HEARTBEAT_TIMEOUT",
+                                    DEFAULT_HEARTBEAT_TIMEOUT)
+    max_restarts = env_nonneg_int("MXNET_MAX_RESTARTS", 0)
     # bind-anywhere: the advertised host may be this host's external
     # name; bind the wildcard so both loopback and external connects work
     bind_host = "" if host not in ("127.0.0.1", "localhost") else host
     tracker = Tracker(host=bind_host, port=port, num_workers=num_workers,
                       num_servers=num_servers,
-                      heartbeat_timeout=hb_timeout)
-    print("tracker listening on %s (workers=%d servers=%d)"
-          % (tracker.addr, num_workers, num_servers), flush=True)
+                      heartbeat_timeout=hb_timeout,
+                      max_restarts=max_restarts)
+    print("tracker listening on %s (workers=%d servers=%d "
+          "max_restarts=%d)"
+          % (tracker.addr, num_workers, num_servers, max_restarts),
+          flush=True)
     tracker.serve_forever()
     return 0
 

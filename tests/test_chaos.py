@@ -1,0 +1,154 @@
+"""Deterministic fault injection (mxnet_tpu/chaos.py, ISSUE 3).
+
+The harness itself must be trustworthy: the grammar parses exactly the
+documented forms (and REJECTS everything else loudly — a silently
+no-op'd spec would certify recovery paths that were never exercised),
+crash rules fire at the exact step in the exact incarnation, and
+probabilistic drops replay bit-identically under the same seed.
+"""
+import pytest
+
+from mxnet_tpu.chaos import (ChaosEngine, FaultSpecError, parse_spec,
+                             reset_engine)
+
+
+def test_parses_the_issue_spec_verbatim():
+    """The exact example from the ISSUE grammar."""
+    rules = parse_spec("worker:1:crash@step=40;rpc:drop@op=push,p=0.1,seed=7")
+    assert len(rules) == 2
+    crash, drop = rules
+    assert (crash.target, crash.rank, crash.action) == ("worker", 1, "crash")
+    assert crash.params["step"] == "40"
+    assert (drop.target, drop.action) == ("rpc", "drop")
+    assert drop.params == {"op": "push", "p": "0.1", "seed": "7"}
+
+
+@pytest.mark.parametrize("bad", [
+    "worker:crash@step=1",          # missing rank
+    "worker:1:crash",               # missing params
+    "worker:1:crash@",              # empty params
+    "worker:x:crash@step=1",        # non-integer rank
+    "worker:1:crash@restart=1",     # crash without step
+    "worker:1:drop@step=1",         # action/target mismatch
+    "gizmo:1:crash@step=1",         # unknown target
+    "rpc:drop@p=maybe",             # non-float p
+    "rpc:drop@p=7",                 # p out of [0,1]
+    "rpc:drop@op=push,phase=later", # bad phase
+    "rpc:drop@op=push,side=middle", # bad side
+    "rpc:drop@op=push,phase=reply,side=server",  # phase is client-only
+    "heartbeat:stall@p=0.5",        # stall without after
+    "rpc:drop@op",                  # k without =v
+])
+def test_malformed_specs_raise(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_crash_fires_at_exact_step_once():
+    eng = ChaosEngine("worker:1:crash@step=3", role="worker", rank=1,
+                      restart=0)
+    exits = []
+    eng._exit = exits.append
+    for _ in range(2):
+        eng.step()
+    assert exits == [], "fired early"
+    eng.step()
+    assert exits == [137], "must fire exactly at step 3 with code 137"
+    for _ in range(5):
+        eng.step()
+    assert exits == [137], "must fire once"
+
+
+def test_crash_targets_role_and_rank():
+    for role, rank in (("worker", 0), ("server", 1)):
+        eng = ChaosEngine("worker:1:crash@step=1", role=role, rank=rank)
+        eng._exit = lambda code: (_ for _ in ()).throw(AssertionError(
+            "crash fired for %s:%d" % (role, rank)))
+        for _ in range(3):
+            eng.step()
+    eng = ChaosEngine("server:1:crash@step=2", role="server", rank=1)
+    exits = []
+    eng._exit = exits.append
+    eng.step()
+    eng.step()
+    assert exits == [137]
+
+
+def test_crash_restart_gating():
+    """Default restart=0: the respawned incarnation must NOT re-crash
+    at the same step (or max-restarts would always be exhausted)."""
+    respawn = ChaosEngine("worker:1:crash@step=2", role="worker", rank=1,
+                          restart=1)
+    respawn._exit = lambda code: (_ for _ in ()).throw(
+        AssertionError("crash re-fired in restart incarnation"))
+    for _ in range(4):
+        respawn.step()
+    # explicit restart=any fires in every incarnation
+    eng = ChaosEngine("worker:1:crash@step=2,restart=any", role="worker",
+                      rank=1, restart=3)
+    exits = []
+    eng._exit = exits.append
+    eng.step()
+    eng.step()
+    assert exits == [137]
+
+
+def test_rpc_drop_count_based_is_exact():
+    eng = ChaosEngine("rpc:drop@op=push,n=2", role="worker", rank=0)
+    assert [eng.rpc("push") for _ in range(4)] == [True, True, False, False]
+    assert not eng.rpc("pull"), "op filter must hold"
+
+
+def test_rpc_drop_probabilistic_is_seed_deterministic():
+    spec = "rpc:drop@op=push,p=0.4,seed=7"
+    a = [ChaosEngine(spec, role="worker", rank=0).rpc("push")
+         for _ in range(1)]  # noqa: F841 — construction is cheap
+    e1 = ChaosEngine(spec, role="worker", rank=0)
+    e2 = ChaosEngine(spec, role="worker", rank=0)
+    seq1 = [e1.rpc("push") for _ in range(64)]
+    seq2 = [e2.rpc("push") for _ in range(64)]
+    assert seq1 == seq2, "same seed must replay the same decisions"
+    assert any(seq1) and not all(seq1), "p=0.4 over 64 draws"
+    e3 = ChaosEngine("rpc:drop@op=push,p=0.4,seed=8", role="worker", rank=0)
+    assert [e3.rpc("push") for _ in range(64)] != seq1
+
+
+def test_rpc_phase_and_side_filters():
+    eng = ChaosEngine("rpc:drop@op=push,phase=reply,n=9", role="worker",
+                      rank=0)
+    assert not eng.rpc("push", phase="send")
+    assert eng.rpc("push", phase="reply")
+    srv = ChaosEngine("rpc:drop@op=push,side=server,n=9", role="server",
+                      rank=0)
+    assert not srv.rpc("push", phase="send", side="client")
+    assert srv.rpc("push", side="server")
+
+
+def test_heartbeat_stall_after():
+    eng = ChaosEngine("heartbeat:stall@after=2", role="worker", rank=0)
+    assert [eng.heartbeat() for _ in range(5)] == \
+        [False, False, True, True, True]
+
+
+def test_env_engine_and_reset(monkeypatch):
+    import mxnet_tpu.chaos as chaos
+
+    monkeypatch.delenv("MXNET_FAULT_SPEC", raising=False)
+    reset_engine()
+    assert chaos.engine() is None
+    chaos.tick_step()  # no engine: must be a no-op, not an error
+    assert not chaos.rpc_fault("push")
+    assert not chaos.heartbeat_fault()
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "rpc:drop@op=push,n=1")
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    reset_engine()
+    assert chaos.engine() is not None
+    assert chaos.rpc_fault("push") and not chaos.rpc_fault("push")
+    reset_engine()
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "rpc:drop@p=nope")
+    with pytest.raises(FaultSpecError):
+        chaos.engine()
+    reset_engine()
+    monkeypatch.delenv("MXNET_FAULT_SPEC")
+    reset_engine()
+    assert chaos.engine() is None

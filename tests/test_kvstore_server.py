@@ -520,3 +520,341 @@ def test_sharded_servers_split_keys_and_merge_opt_state(tmp_path):
     finally:
         srv_a.shutdown()
         srv_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bounded RPC retry + seqno dedupe + elastic server respawn (ISSUE 3)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def chaos_env(monkeypatch):
+    """Set MXNET_FAULT_SPEC for the duration of a test and reset the
+    cached engine on both entry and exit."""
+    from mxnet_tpu import chaos
+
+    def _set(spec):
+        monkeypatch.setenv("MXNET_FAULT_SPEC", spec)
+        monkeypatch.setenv("DMLC_ROLE", "worker")
+        chaos.reset_engine()
+
+    yield _set
+    monkeypatch.delenv("MXNET_FAULT_SPEC", raising=False)
+    chaos.reset_engine()
+
+
+def test_push_retry_after_reply_loss_is_idempotent(server, chaos_env):
+    """THE dedupe case (ISSUE 3 satellite): the push is applied, the
+    reply is lost, the client retries over a fresh connection with the
+    SAME seqno — the server must ack without re-applying (no
+    double-applied gradient). Accumulate mode makes a double-apply
+    visible as 2.0 instead of 1.0."""
+    chaos_env("rpc:drop@op=push,phase=reply,n=1")
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((3,), np.float32))
+    kv.push("w", np.ones((3,), np.float32))  # retried internally
+    out = np.empty((3,), np.float32)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out, 1.0)
+    assert server._pushes_applied == 1, "retried push was re-applied"
+    kv.close()
+
+
+def test_push_retry_after_send_drop_applies_once(server, chaos_env):
+    """Connection reset BEFORE the request leaves: the server never saw
+    it, the retry must deliver it exactly once."""
+    chaos_env("rpc:drop@op=push,n=1")
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.zeros((2,), np.float32))
+    kv.push("w", np.full((2,), 5.0, np.float32))
+    out = np.empty((2,), np.float32)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out, 5.0)
+    kv.close()
+
+
+def test_pull_retries_transparently(server, chaos_env):
+    chaos_env("rpc:drop@op=pull,n=1")
+    kv = ServerKVStore(server.addr)
+    kv.init("w", np.full((2,), 3.0, np.float32))
+    out = np.empty((2,), np.float32)
+    kv.pull("w", out=out)  # first attempt chaos-dropped, retry lands
+    np.testing.assert_allclose(out, 3.0)
+    kv.close()
+
+
+def test_error_replies_are_never_retried(server, chaos_env):
+    """An ('err', ...) reply is a server-side REJECTION, not a
+    transport failure: it must surface immediately (a retried bad
+    request would just fail N times and hide the real error)."""
+    import mxnet_tpu as mx
+
+    chaos_env("rpc:drop@op=pull,n=0")  # engine active, nothing fires
+    kv = ServerKVStore(server.addr)
+    before = server._pushes_applied
+    with pytest.raises(mx.MXNetError, match="push before init"):
+        kv.push("never_inited", np.ones((2,), np.float32))
+    assert server._pushes_applied == before
+    kv.close()
+
+
+def test_dead_shard_error_names_the_shard(monkeypatch):
+    """Without restarts, the survivors' error must NAME the dead shard
+    (ISSUE 3 satellite) — 'connection refused' with no context is not
+    actionable in a sharded job."""
+    import mxnet_tpu as mx
+
+    monkeypatch.setenv("MXNET_KVSTORE_RPC_RETRIES", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RECONNECT_DEADLINE", "0.3")
+    srv = KVStoreServer(num_workers=1)
+    srv.serve_in_background()
+    kv = ServerKVStore(srv.addr)
+    kv.init("w", np.zeros((2,), np.float32))
+    srv.shutdown()  # the shard dies; no tracker, no respawn
+    with pytest.raises(mx.MXNetError,
+                       match=r"push.*shard 0 \(%s\).*failed after 2"
+                             % srv.addr):
+        kv.push("w", np.ones((2,), np.float32))
+    kv.close()
+
+
+def test_retry_rediscovers_respawned_server(monkeypatch):
+    """The full in-process respawn loop: shard dies mid-job, a
+    replacement (restored from 'checkpoint' state) registers with the
+    tracker under the old rank, and the client's retry re-discovers
+    the NEW port and lands the push there — Module.fit never sees the
+    outage."""
+    from mxnet_tpu.tracker import Tracker, TrackerClient
+
+    monkeypatch.setenv("MXNET_KVSTORE_RECONNECT_DEADLINE", "0.5")
+    trk = Tracker(num_workers=1, num_servers=1, max_restarts=1)
+    trk.serve_in_background()
+    srv_a = KVStoreServer(num_workers=1)
+    srv_a.serve_in_background()
+    sc_a = TrackerClient(trk.addr, "server", addr=srv_a.addr, rank=0)
+    wc = TrackerClient(trk.addr, "worker", rank=0)
+    try:
+        kv = ServerKVStore([srv_a.addr], tracker_client=wc)
+        kv.init("w", np.full((2,), 10.0, np.float32))
+        kv.push("w", np.ones((2,), np.float32))
+
+        srv_a.shutdown()  # crash
+        sc_a.close()
+        # respawned incarnation on a NEW port, pre-restored to the
+        # dead server's state (the checkpoint path in the real flow)
+        srv_b = KVStoreServer(num_workers=1)
+        srv_b._store = {k: v.copy() for k, v in srv_a._store.items()}
+        srv_b.serve_in_background()
+        sc_b = TrackerClient(trk.addr, "server", addr=srv_b.addr,
+                             rank=0, restart_count=1)
+
+        kv.push("w", np.ones((2,), np.float32))  # reconnect+rediscover
+        out = np.empty((2,), np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, 12.0)
+        assert kv._uris == [srv_b.addr], "client never learned new URI"
+        kv.close()
+        sc_b.close()
+        srv_b.shutdown()
+    finally:
+        srv_a.shutdown()
+        trk.shutdown()
+
+
+def test_elastic_barrier_retracts_dead_waiters_arrival():
+    """Elastic mode: a worker dying INSIDE the barrier retracts its own
+    arrival; the survivor keeps waiting for the respawn to re-arrive
+    instead of aborting the round — and the respawn completes it."""
+    import socket as _socket
+    import threading
+    import time
+
+    def _eat(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+
+    srv = KVStoreServer(num_workers=2, barrier_timeout=20.0, elastic=True)
+    srv.serve_in_background()
+    try:
+        ghost = ServerKVStore(srv.addr)
+        t_ghost = threading.Thread(target=lambda: _eat(ghost.barrier))
+        t_ghost.start()
+        time.sleep(0.3)          # ghost holds 1 pending arrival...
+        ghost._socks[0].shutdown(_socket.SHUT_RDWR)
+        ghost._socks[0].close()  # ...and dies (kernel FIN, like SIGKILL)
+        t_ghost.join(timeout=10)
+        time.sleep(0.6)          # liveness probe retracts the arrival
+
+        survivor = ServerKVStore(srv.addr)
+        done = []
+        t_surv = threading.Thread(
+            target=lambda: (survivor.barrier(), done.append("survivor")))
+        t_surv.start()
+        time.sleep(0.5)
+        assert t_surv.is_alive(), \
+            "survivor sailed through on the dead worker's stale arrival"
+        assert done == []
+        respawn = ServerKVStore(srv.addr)
+        respawn.barrier()        # the respawn re-arrives: round completes
+        t_surv.join(timeout=10)
+        assert done == ["survivor"]
+        survivor.close()
+        respawn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_opt_config_roundtrip(server):
+    """The plain-data optimizer config a respawned server rebuilds its
+    updater from is readable through the client."""
+    kv = ServerKVStore(server.addr)
+    assert kv.get_optimizer_config() is None
+    kv.set_optimizer("sgd", learning_rate=0.25, momentum=0.5)
+    name, kwargs, extras = kv.get_optimizer_config()
+    assert name == "sgd"
+    assert kwargs["learning_rate"] == 0.25 and kwargs["momentum"] == 0.5
+    kv.close()
+
+
+def test_server_restore_from_checkpoint_loads_only_its_shard(tmp_path):
+    """A respawned server preloads exactly ITS key shard (same crc32
+    assignment as the client's routing) plus the matching slice of the
+    optimizer-state map, and rebuilds the updater from the recorded
+    config — all before serving."""
+    import pickle
+
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.kvstore_server import shard_key
+
+    keys = ["fc%d_weight" % i for i in range(8)]
+    weights = {"arg:%s" % k: np.full((3,), float(i), np.float32)
+               for i, k in enumerate(keys)}
+    weights["aux:bn_mean"] = np.ones((2,), np.float32)  # never server-side
+    states = {k: np.full((3,), 0.5, np.float32) for k in keys}
+    mgr = CheckpointManager(tmp_path / "ck")
+    mgr.save(2, weights=weights,
+             optimizer_states=pickle.dumps(states, protocol=4),
+             optimizer_config=("sgd", {"learning_rate": 0.1,
+                                       "momentum": 0.9}, {}))
+
+    num_shards = 2
+    for shard in range(num_shards):
+        srv = KVStoreServer(num_workers=1)
+        n = srv.restore_from_checkpoint(mgr.latest(), shard_rank=shard,
+                                        num_shards=num_shards)
+        expect = {k for k in keys if shard_key(k, num_shards) == shard}
+        assert set(srv._store) == expect
+        assert n == len(expect)
+        assert srv._updater is not None, "optimizer not rebuilt"
+        assert set(srv._updater.states) == expect, "foreign shard state"
+        srv.shutdown()
+
+
+def test_named_barriers_do_not_pair_across_names():
+    """Arrivals at DIFFERENT barrier names must never release each
+    other — the checkpoint choreography's phase-A arrival of a
+    respawned worker must not free a survivor parked in phase B."""
+    import threading
+    import time
+
+    srv = KVStoreServer(num_workers=2, barrier_timeout=15.0)
+    srv.serve_in_background()
+    try:
+        a, b = ServerKVStore(srv.addr), ServerKVStore(srv.addr)
+        t = threading.Thread(target=lambda: a.barrier("phase-b"))
+        t.start()
+        time.sleep(0.3)
+        t2 = threading.Thread(target=lambda: b.barrier("phase-a"))
+        t2.start()
+        time.sleep(0.5)
+        assert t.is_alive() and t2.is_alive(), \
+            "differently-named rounds paired with each other"
+        # matching names complete both rounds
+        c = ServerKVStore(srv.addr)
+        c.barrier("phase-b")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        c.barrier("phase-a")
+        t2.join(timeout=5)
+        assert not t2.is_alive()
+        for kv in (a, b, c):
+            kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_push_dedupe_is_a_claimed_set_not_a_high_water_mark():
+    """A failed send's retry can arrive AFTER a concurrent higher
+    seqno landed; only the exact (cid, seq) pairs already claimed are
+    duplicates — a high-water check would drop the late never-applied
+    push. Claims are atomic (claim-then-apply) and released when the
+    apply fails, so an err'd push's retry is not falsely acked."""
+    srv = KVStoreServer(num_workers=1)
+    srv.serve_in_background()
+    try:
+        assert srv._claim_push({"cid": "c1", "seq": 6})
+        assert srv._claim_push({"cid": "c1", "seq": 5}), \
+            "never-applied seq 5 dropped because 6 landed first"
+        assert not srv._claim_push({"cid": "c1", "seq": 5})  # retry
+        assert not srv._claim_push({"cid": "c1", "seq": 6})
+        assert srv._claim_push({"cid": "c2", "seq": 6})  # other client
+        srv._release_push({"cid": "c1", "seq": 5})       # apply failed
+        assert srv._claim_push({"cid": "c1", "seq": 5})  # retry re-runs
+        # end-to-end: a push whose apply errs (never inited) must not
+        # poison the seqno — the key can be inited and re-pushed
+        kv = ServerKVStore(srv.addr)
+        with pytest.raises(Exception, match="push before init"):
+            kv._rpc_idx(0, "push", "w", {"cid": kv._client_id, "seq": 0},
+                        ("float32", (2,), b"\0" * 8))
+        kv.init("w", np.zeros((2,), np.float32))
+        kv._rpc_idx(0, "push", "w", {"cid": kv._client_id, "seq": 0},
+                    ("float32", (2,), np.ones((2,), np.float32).tobytes()))
+        out = np.empty((2,), np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, 1.0)
+        kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_entrypoint_restores_checkpoint_on_fresh_start(tmp_path):
+    """Full-job restart (NOT an elastic respawn: DMLC_RESTART_COUNT is
+    unset/0): a server booted against a populated MXNET_CHECKPOINT_DIR
+    must restore from it — the workers resume at the checkpointed
+    epoch from the same directory, and an empty server would let their
+    init() install fresh random weights under the resumed epoch."""
+    import pickle
+
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    w = np.arange(6, dtype=np.float32)
+    CheckpointManager(tmp_path / "ck").save(
+        4, weights={"arg:w": w},
+        optimizer_states=pickle.dumps({"w": np.ones((6,), np.float32)}),
+        optimizer_config=("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                          {}))
+    env = dict(os.environ)
+    env.pop("DMLC_RESTART_COUNT", None)
+    env.update(DMLC_ROLE="server", MXNET_KVSTORE_SERVER="1",
+               MXNET_PS_BIND_PORT="0", JAX_PLATFORMS="cpu",
+               MXNET_CHECKPOINT_DIR=str(tmp_path / "ck"),
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "event=restored-from" in line and "keys=1" in line, line
+        line = proc.stdout.readline()
+        assert "listening on" in line, line
+        addr = line.strip().rsplit(" ", 1)[-1]
+        kv = ServerKVStore(addr)
+        out = np.empty((6,), np.float32)
+        kv.pull("w", out=out)  # no init needed: the store is restored
+        np.testing.assert_allclose(out, w)
+        kv.stop_server()
+        assert proc.wait(timeout=30) == 0
+        kv.close()
+    finally:
+        proc.kill()

@@ -213,3 +213,264 @@ def test_tracker_env_spec_contract(monkeypatch):
     monkeypatch.setenv("DMLC_NUM_SERVER", "2")
     monkeypatch.setenv("DMLC_NUM_WORKER", "4")
     assert tracker_env_spec() == ("10.1.1.1:9091", 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery (ISSUE 3): respawn takeover, deferred aborts,
+# lifecycle timeline, validated env knobs
+# ---------------------------------------------------------------------------
+def test_env_knob_validation_fails_loudly(monkeypatch):
+    """MXNET_TRACKER_* nonsense (0, negative, non-numeric) must raise,
+    not silently fall back to a default (ISSUE 3 satellite)."""
+    from mxnet_tpu.tracker import env_nonneg_int, env_positive_float
+
+    for bad in ("abc", "0", "-3", "nan", "inf"):
+        monkeypatch.setenv("MXNET_TRACKER_HEARTBEAT_INTERVAL", bad)
+        with pytest.raises(TrackerError, match="MXNET_TRACKER_HEARTBEAT"):
+            env_positive_float("MXNET_TRACKER_HEARTBEAT_INTERVAL", 2.0)
+    monkeypatch.setenv("MXNET_TRACKER_HEARTBEAT_INTERVAL", "1.5")
+    assert env_positive_float("MXNET_TRACKER_HEARTBEAT_INTERVAL", 2.0) == 1.5
+    monkeypatch.delenv("MXNET_TRACKER_HEARTBEAT_INTERVAL")
+    assert env_positive_float("MXNET_TRACKER_HEARTBEAT_INTERVAL", 2.0) == 2.0
+    for bad in ("x", "-1", "2.5"):
+        monkeypatch.setenv("MXNET_MAX_RESTARTS", bad)
+        with pytest.raises(TrackerError, match="MXNET_MAX_RESTARTS"):
+            env_nonneg_int("MXNET_MAX_RESTARTS", 0)
+    monkeypatch.setenv("MXNET_MAX_RESTARTS", "0")
+    assert env_nonneg_int("MXNET_MAX_RESTARTS", 1) == 0
+
+
+def test_client_rejects_bad_heartbeat_env_before_connecting(monkeypatch,
+                                                            tracker):
+    monkeypatch.setenv("MXNET_TRACKER_HEARTBEAT_INTERVAL", "-1")
+    with pytest.raises(TrackerError, match="MXNET_TRACKER_HEARTBEAT"):
+        TrackerClient(tracker.addr, "worker")
+    monkeypatch.delenv("MXNET_TRACKER_HEARTBEAT_INTERVAL")
+
+
+def test_barrier_rejects_bad_timeout_env(monkeypatch, tracker):
+    w = TrackerClient(tracker.addr, "worker")
+    monkeypatch.setenv("MXNET_TRACKER_BARRIER_TIMEOUT", "bogus")
+    with pytest.raises(TrackerError, match="MXNET_TRACKER_BARRIER"):
+        w.barrier("b")
+    w.close()
+
+
+def _wait_until(pred, deadline=5.0):
+    end = time.monotonic() + deadline
+    while not pred() and time.monotonic() < end:
+        time.sleep(0.05)
+    assert pred()
+
+
+def test_respawn_takes_over_dead_rank_and_updates_uri():
+    """A dead server's rank is reusable in elastic mode: the respawn
+    registers with restart_count>0 and the SAME rank, replaces the dead
+    node (num_dead drops back), and get_server_uris returns the NEW
+    address — this is how a worker's retry loop finds the new port."""
+    trk = Tracker(num_workers=1, num_servers=1, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        s0 = TrackerClient(trk.addr, "server", addr="127.0.0.1:1111",
+                           rank=0)
+        w = TrackerClient(trk.addr, "worker", rank=0)
+        assert w.get_server_uris(timeout=5.0) == ["127.0.0.1:1111"]
+        s0.close()  # SIGKILL equivalent
+        _wait_until(lambda: w.num_dead_node() == 1)
+        s1 = TrackerClient(trk.addr, "server", addr="127.0.0.1:2222",
+                           rank=0, restart_count=1)
+        assert s1.rank == 0
+        assert w.num_dead_node() == 0, "replaced node still counted dead"
+        assert w.get_server_uris(timeout=5.0) == ["127.0.0.1:2222"]
+        w.close()
+        s1.close()
+    finally:
+        trk.shutdown()
+
+
+def test_register_alive_rank_conflict_raises(tracker):
+    w0 = TrackerClient(tracker.addr, "worker", rank=0)
+    with pytest.raises(TrackerError, match="already registered and alive"):
+        TrackerClient(tracker.addr, "worker", rank=0)
+    w0.close()
+
+
+def test_get_servers_waits_for_respawn_instead_of_raising():
+    """During the dead window of a respawnable server, get_server_uris
+    BLOCKS (bounded) instead of raising, then delivers the
+    replacement's URI."""
+    trk = Tracker(num_workers=1, num_servers=1, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        s0 = TrackerClient(trk.addr, "server", addr="127.0.0.1:1111",
+                           rank=0)
+        w = TrackerClient(trk.addr, "worker")
+        s0.close()
+        _wait_until(lambda: w.num_dead_node() == 1)
+        got = {}
+
+        def fetch():
+            got["uris"] = w.get_server_uris(timeout=15.0)
+
+        t = threading.Thread(target=fetch)
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive(), "must wait for the respawn, not raise"
+        s1 = TrackerClient(trk.addr, "server", addr="127.0.0.1:2222",
+                           rank=0, restart_count=1)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert got["uris"] == ["127.0.0.1:2222"]
+        w.close()
+        s1.close()
+    finally:
+        trk.shutdown()
+
+
+def test_elastic_barrier_waits_for_respawned_peer():
+    """ISSUE 3 tentpole: a dead-but-respawnable peer does NOT abort the
+    round; the respawn re-arrives and the survivor completes."""
+    trk = Tracker(num_workers=2, num_servers=0, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        w0 = TrackerClient(trk.addr, "worker", rank=0)
+        w1 = TrackerClient(trk.addr, "worker", rank=1)
+        outcome = {}
+
+        def arrive():
+            try:
+                w0.barrier("elastic", timeout=20.0)
+                outcome["ok"] = True
+            except TrackerError as e:
+                outcome["err"] = str(e)
+
+        t = threading.Thread(target=arrive)
+        t.start()
+        time.sleep(0.4)          # w0 waits inside the barrier...
+        w1.close()               # ...peer dies (no done sent)
+        time.sleep(1.0)          # dead detection + would-be abort window
+        assert t.is_alive(), "elastic barrier must keep waiting"
+        w1b = TrackerClient(trk.addr, "worker", rank=1, restart_count=1)
+        w1b.barrier("elastic", timeout=20.0)   # respawn re-arrives
+        t.join(timeout=10)
+        assert outcome == {"ok": True}, outcome
+        w0.close()
+        w1b.close()
+    finally:
+        trk.shutdown()
+
+
+def test_elastic_defers_shutdown_fanout_until_respawn_done():
+    """A dead-but-respawnable worker must hold the job open: the
+    scheduler must NOT fan out server shutdown while launch.py is mid-
+    respawn, even if every other worker already finished."""
+    trk = Tracker(num_workers=2, num_servers=0, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        w0 = TrackerClient(trk.addr, "worker", rank=0)
+        w1 = TrackerClient(trk.addr, "worker", rank=1)
+        w0.done()
+        w1.close()  # crash, respawn pending
+        _wait_until(lambda: w0.num_dead_node() == 1)
+        time.sleep(0.3)
+        assert not trk._fanned_out, "fan-out fired during respawn window"
+        w1b = TrackerClient(trk.addr, "worker", rank=1, restart_count=1)
+        w1b.done()
+        _wait_until(lambda: trk._fanned_out)
+        w0.close()
+        w1b.close()
+    finally:
+        trk.shutdown()
+
+
+def test_exhausted_restart_budget_restores_fail_fast():
+    """Once the (role, rank) budget is used up, the NEXT death behaves
+    like non-elastic mode: barriers abort and the job can finish."""
+    trk = Tracker(num_workers=2, num_servers=0, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        w0 = TrackerClient(trk.addr, "worker", rank=0)
+        w1 = TrackerClient(trk.addr, "worker", rank=1)
+        w1.close()
+        _wait_until(lambda: w0.num_dead_node() == 1)
+        w1b = TrackerClient(trk.addr, "worker", rank=1, restart_count=1)
+        err = {}
+
+        def arrive():
+            try:
+                w0.barrier("post-budget", timeout=20.0)
+            except TrackerError as e:
+                err["e"] = str(e)
+
+        t = threading.Thread(target=arrive)
+        t.start()
+        time.sleep(0.4)
+        w1b.close()  # second death: budget (1) exhausted
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert "died" in err.get("e", ""), err
+        w0.close()
+    finally:
+        trk.shutdown()
+
+
+def test_lifecycle_timeline_logged(capsys):
+    """The scheduler's stdout carries the structured timeline a
+    post-mortem reconstructs: registered / dead / respawned / done,
+    plus client-reported events (restored-from)."""
+    trk = Tracker(num_workers=1, num_servers=1, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        chunks = []
+
+        def drain():
+            chunks.append(capsys.readouterr().out)
+            return "".join(chunks)
+
+        s0 = TrackerClient(trk.addr, "server", addr="127.0.0.1:1111",
+                           rank=0)
+        s0.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if "event=dead" in drain():
+                break
+            time.sleep(0.05)
+        s1 = TrackerClient(trk.addr, "server", addr="127.0.0.1:2222",
+                           rank=0, restart_count=1)
+        s1.log_event("restored-from", ckpt="/ck/ckpt-00000003", rank=0)
+        w = TrackerClient(trk.addr, "worker")
+        w.done()
+        time.sleep(0.3)
+        out = drain()
+        assert "event=registered role=server rank=0" in out
+        assert "event=respawned role=server rank=0" in out
+        assert "restarts_used=1/1" in out
+        assert "event=restored-from" in out and "ckpt-00000003" in out
+        assert "event=done role=worker" in out
+        w.close()
+        s1.close()
+    finally:
+        trk.shutdown()
+
+
+def test_respawn_takes_over_done_node():
+    """A worker that exits nonzero AFTER its atexit done() (e.g. a
+    failed end-of-run assert) leaves a done-and-alive node behind; its
+    respawn must take the rank over instead of burning the restart
+    budget on 'already alive' errors."""
+    trk = Tracker(num_workers=2, num_servers=0, max_restarts=1)
+    trk.serve_in_background()
+    try:
+        w0 = TrackerClient(trk.addr, "worker", rank=0)
+        w1 = TrackerClient(trk.addr, "worker", rank=1)
+        w1.done()          # atexit ran...
+        w1.close()         # ...then the process exited nonzero
+        t0 = time.monotonic()
+        w1b = TrackerClient(trk.addr, "worker", rank=1, restart_count=1)
+        assert w1b.rank == 1
+        assert time.monotonic() - t0 < 5, \
+            "takeover of a done node must not sit in TAKEOVER_WAIT"
+        w0.close()
+        w1b.close()
+    finally:
+        trk.shutdown()

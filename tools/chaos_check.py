@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Elastic-recovery smoke: a launch.py job must survive an injected
+crash and finish training.
+
+Runs ``launch.py -n 2 -s 1 --max-restarts 1 --kv-store dist_async``
+over the tiny synthetic trainer (examples/distributed/dist_sync.py)
+with a deterministic ``MXNET_FAULT_SPEC`` crash (mxnet_tpu/chaos.py),
+then exits nonzero unless
+
+- the job's exit code is 0,
+- the injected crash actually fired (``[chaos]``) AND a respawn
+  happened (``respawning``) — a spec that never triggers would
+  green-light a recovery path that was never exercised,
+- the respawned node either resumed from a checkpoint (worker) or
+  restored its shard (server),
+- every worker reports a decreasing loss.
+
+CI wiring: tests/test_dist_async.py runs this script as a
+``slow``-marked test, keeping the default tier within its wall-time
+gate while the nightly tier exercises the full recovery loop twice
+(worker crash here, server crash in the default-tier e2e).
+
+Usage:
+    python tools/chaos_check.py                      # worker crash
+    python tools/chaos_check.py --spec 'server:0:crash@step=130'
+"""
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--spec", default="worker:1:crash@step=18",
+                    help="MXNET_FAULT_SPEC to inject "
+                         "(default: kill worker 1 mid-epoch)")
+    ap.add_argument("-n", "--num-workers", type=int, default=2)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--timeout", type=int, default=55,
+                    help="launch.py watchdog (seconds)")
+    args = ap.parse_args()
+
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    env["MXNET_FAULT_SPEC"] = args.spec
+
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", str(args.num_workers), "-s", str(args.num_servers),
+           "--max-restarts", str(args.max_restarts),
+           "--timeout", str(args.timeout),
+           sys.executable,
+           os.path.join(ROOT, "examples", "distributed", "dist_sync.py"),
+           "--kv-store", "dist_async", "--num-epochs", "3",
+           "--num-samples", "1200", "--batch-size", "100"]
+    print("chaos_check: %s  (MXNET_FAULT_SPEC=%s)"
+          % (" ".join(cmd), args.spec), flush=True)
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=args.timeout + 30)
+    out = proc.stdout + proc.stderr
+    sys.stdout.write(out)
+
+    failures = []
+    if proc.returncode != 0:
+        failures.append("job exited %d" % proc.returncode)
+    if "[chaos]" not in out:
+        failures.append("fault spec never fired (no [chaos] line) — "
+                        "nothing was actually tested")
+    if "respawning" not in out:
+        failures.append("no respawn observed")
+    if not ("resuming from checkpoint" in out
+            or "event=restored-from" in out):
+        failures.append("respawned node never restored from a checkpoint")
+    losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
+    if len(losses) != args.num_workers:
+        failures.append("expected %d worker loss reports, got %d"
+                        % (args.num_workers, len(losses)))
+    for rank, loss0, loss1 in losses:
+        if not float(loss1) < float(loss0):
+            failures.append("worker %s loss did not decrease (%s -> %s)"
+                            % (rank, loss0, loss1))
+
+    if failures:
+        print("chaos_check: FAIL\n  - " + "\n  - ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("chaos_check: OK — job recovered from %r and converged"
+          % args.spec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,9 +19,22 @@ server through the scheduler — no hand-set ``MXNET_PS_SERVER_URI``.
 When every worker reports done, the scheduler fans ``stop`` out to the
 servers, so the whole job exits cleanly.
 
+**Elastic recovery** (``--max-restarts K``, scheduler topology only):
+a worker or server that exits nonzero is respawned with its old rank
+and ``DMLC_RESTART_COUNT`` incremented, up to K times per node. A
+respawned server reloads its key shard from the latest checkpoint
+(``MXNET_CHECKPOINT_DIR``, auto-created when unset); a respawned
+worker resumes from the checkpointed epoch (see
+``callback.elastic_checkpoint``). When a node exhausts its budget the
+job fails cleanly with a per-node exit summary instead of hanging.
+Deterministic fault injection for testing: ``MXNET_FAULT_SPEC``
+(mxnet_tpu/chaos.py).
+
 Usage (reference-compatible):
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
     python tools/launch.py -n 2 -s 1 python train.py --kv-store dist_async
+    python tools/launch.py -n 2 -s 1 --max-restarts 1 \\
+        python train.py --kv-store dist_async
 
 Modes:
     --launcher local  (default) all processes on this host, each seeing
@@ -62,6 +75,14 @@ def _base_env(args, coord):
     env["DMLC_PS_ROOT_PORT"] = port
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
     env["DMLC_NUM_SERVER"] = str(args.num_servers)
+    if getattr(args, "max_restarts", 0):
+        # elastic contract: the tracker defers barrier aborts/shutdown
+        # while a respawn is pending
+        env["MXNET_MAX_RESTARTS"] = str(args.max_restarts)
+    if getattr(args, "checkpoint_dir", None):
+        # independent of --max-restarts: periodic snapshots alone (for
+        # a later full-job restart) are a legitimate configuration
+        env["MXNET_CHECKPOINT_DIR"] = args.checkpoint_dir
     # spawned helper processes (tracker/server modules) must import
     # mxnet_tpu regardless of the caller's cwd
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -101,7 +122,9 @@ def _serverless_worker_env(args, coord, rank):
     return _apply_env_overrides(env, args)
 
 
-def _print_env(env, keys_prefix=("MXNET_TPU_", "MXNET_KVSTORE_", "DMLC_"),
+def _print_env(env, keys_prefix=("MXNET_TPU_", "MXNET_KVSTORE_", "DMLC_",
+                                 "MXNET_MAX_", "MXNET_CHECKPOINT_",
+                                 "MXNET_FAULT_"),
                rank_keys=()):
     for k, v in sorted(env.items()):
         if k.startswith(keys_prefix):
@@ -149,55 +172,156 @@ def _wait_procs(procs, deadline):
     return rc, False
 
 
+class _Node:
+    """One supervised process slot: role + rank + restart accounting
+    (the slot survives respawns; the Popen inside it is replaced)."""
+
+    def __init__(self, name, role, rank, cmd, env_fn):
+        self.name = name
+        self.role = role
+        self.rank = rank
+        self.cmd = cmd
+        self.env_fn = env_fn     # restart_count -> env dict
+        self.proc = None
+        self.restarts = 0
+        self.exit_history = []   # every observed exit code, in order
+        self.finished = False    # exited 0 (terminal success)
+        self.failed = False      # budget exhausted (terminal failure)
+
+    def spawn(self):
+        self.proc = subprocess.Popen(self.cmd, env=self.env_fn(self.restarts))
+
+    def __str__(self):
+        rcs = ",".join(str(rc) for rc in self.exit_history) or "-"
+        return "%-10s rc=%s restarts=%d" % (self.name, rcs, self.restarts)
+
+
+def _print_exit_summary(nodes, out=None):
+    out = out or sys.stderr
+    print("launch.py: exit summary (per node: every observed exit code, "
+          "restarts used):", file=out)
+    for node in nodes:
+        print("launch.py:   %s" % node, file=out)
+
+
 def _spawn_topology(args, coord):
     """scheduler + S servers + W workers; workers' collective exit
-    status is the job's."""
-    procs = []  # (name, Popen)
-
-    def spawn(name, cmd, env):
-        procs.append((name, subprocess.Popen(cmd, env=env)))
-
+    status is the job's. With --max-restarts K a worker/server that
+    exits nonzero is respawned (same rank, DMLC_RESTART_COUNT bumped)
+    up to K times per node; an exhausted budget fails the whole job
+    with a per-node exit summary."""
     # -c, not -m: the package __init__ already imports .tracker, and
     # runpy warns when re-executing an imported submodule as __main__
     tracker_cmd = [sys.executable, "-c",
                    "import sys; from mxnet_tpu import tracker; "
                    "sys.exit(tracker.main())"]
-    deadline = (time.monotonic() + args.timeout) if args.timeout else None
-    try:
-        spawn("scheduler", tracker_cmd,
-              _role_env(args, coord, "scheduler"))
-        for i in range(args.num_servers):
-            spawn("server%d" % i,
-                  [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
-                  _role_env(args, coord, "server", i))
-        workers = []
-        for rank in range(args.num_workers):
-            spawn("worker%d" % rank, args.command,
-                  _role_env(args, coord, "worker", rank))
-            workers.append(procs[-1][1])
+    server_cmd = [sys.executable, "-m", "mxnet_tpu.kvstore_server"]
 
-        rc, timed_out = _wait_procs(workers, deadline)
-        if timed_out:
-            print("launch.py: timeout after %ds, killing the job"
-                  % args.timeout, file=sys.stderr)
-            return 124
+    def env_fn(role, rank):
+        def build(restart_count):
+            env = _role_env(args, coord, role, rank)
+            env["DMLC_RESTART_COUNT"] = str(restart_count)
+            return env
+        return build
+
+    nodes = [_Node("scheduler", "scheduler", 0, tracker_cmd,
+                   env_fn("scheduler", 0))]
+    nodes += [_Node("server%d" % i, "server", i, server_cmd,
+                    env_fn("server", i)) for i in range(args.num_servers)]
+    nodes += [_Node("worker%d" % r, "worker", r, list(args.command),
+                    env_fn("worker", r)) for r in range(args.num_workers)]
+    workers = [n for n in nodes if n.role == "worker"]
+    deadline = (time.monotonic() + args.timeout) if args.timeout else None
+    rc = 0
+    try:
+        for node in nodes:
+            node.spawn()
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                print("launch.py: timeout after %ds, killing the job"
+                      % args.timeout, file=sys.stderr)
+                _print_exit_summary(nodes)
+                return 124
+            progressed = False
+            for node in nodes:
+                if node.finished or node.failed:
+                    continue
+                code = node.proc.poll()
+                if code is None:
+                    continue
+                progressed = True
+                node.exit_history.append(code)
+                if code == 0:
+                    node.finished = True
+                    continue
+                if node.role != "scheduler" \
+                        and node.restarts < args.max_restarts:
+                    node.restarts += 1
+                    print("launch.py: %s exited %d; respawning "
+                          "(restart %d/%d)" % (node.name, code,
+                                               node.restarts,
+                                               args.max_restarts),
+                          file=sys.stderr)
+                    node.spawn()
+                    continue
+                if not args.max_restarts and node.role != "worker":
+                    # legacy (non-elastic) semantics: helper exit codes
+                    # never drive the job's status — the workers' own
+                    # failures surface the problem
+                    node.finished = True
+                    continue
+                node.failed = True
+                rc = rc or code
+                if args.max_restarts and node.role != "scheduler":
+                    print("launch.py: %s exited %d with restart budget "
+                          "exhausted (%d/%d); failing the job"
+                          % (node.name, code, node.restarts,
+                             args.max_restarts), file=sys.stderr)
+            failed = [n for n in nodes if n.failed]
+            if failed and args.max_restarts:
+                # elastic mode promises CLEAN failure: tear everything
+                # down now instead of letting survivors spin against a
+                # hole in the topology until some timeout fires
+                _print_exit_summary(nodes)
+                return rc or 1
+            if all(n.finished or n.failed for n in workers):
+                break
+            if not progressed:
+                time.sleep(0.1)
+        if rc:
+            # a worker failed terminally (non-elastic path: its peers'
+            # own exits were already waited for above). Fall through to
+            # the helper grace window all the same — the tracker's
+            # dead/done bookkeeping fans 'stop' out to the servers, and
+            # killing them instead would truncate the lifecycle
+            # timeline a post-mortem needs most on exactly this path.
+            _print_exit_summary(nodes)
         # workers done: the tracker fans out server shutdown itself
         # (workers' done reports); give the helpers a grace window
-        helpers = [p for _name, p in procs if p not in workers]
-        _rc, timed_out = _wait_procs(helpers, time.monotonic() + 15)
+        helpers = [n for n in nodes if n.role != "worker"
+                   and n.proc is not None and not n.finished]
+        _rc, timed_out = _wait_procs([n.proc for n in helpers],
+                                     time.monotonic() + 15)
         if timed_out:
             print("launch.py: scheduler/server did not exit after the "
                   "workers; killing them", file=sys.stderr)
             rc = rc or 1
+        for node in helpers:
+            if node.proc.poll() is not None:
+                node.exit_history.append(node.proc.returncode)
+                node.finished = node.proc.returncode == 0
+        if args.max_restarts:
+            _print_exit_summary(nodes, out=sys.stdout)
         return rc
     except KeyboardInterrupt:
-        for _name, p in procs:
-            p.send_signal(signal.SIGTERM)
+        for node in nodes:
+            if node.proc is not None:
+                node.proc.send_signal(signal.SIGTERM)
         return 1
     finally:
-        for _name, p in procs:
-            if p.poll() is None:
-                p.kill()
+        for node in nodes:
+            if node.proc is not None and node.proc.poll() is None:
+                node.proc.kill()
 
 
 def _spawn_serverless(args, coord):
@@ -242,20 +366,58 @@ def main():
     ap.add_argument("--timeout", type=int, default=0,
                     help="kill the whole job after this many seconds "
                          "(0 = no limit); exit code 124 on expiry")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="respawn a worker/server that exits nonzero up "
+                         "to K times PER NODE, with its old rank and "
+                         "DMLC_RESTART_COUNT incremented (scheduler "
+                         "topology only); a respawned server restores "
+                         "its shard from MXNET_CHECKPOINT_DIR. 0 "
+                         "(default) disables elastic recovery")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="coordinated checkpoint directory exported as "
+                         "MXNET_CHECKPOINT_DIR to every role (default: "
+                         "inherit the env, or auto-create a temp dir "
+                         "when --max-restarts > 0)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE for all roles (repeatable)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.max_restarts < 0:
+        ap.error("--max-restarts must be >= 0")
+    if args.max_restarts and args.num_servers <= 0:
+        ap.error("--max-restarts requires the scheduler topology "
+                 "(-s > 0): the serverless collective path has no "
+                 "server-held state to recover a worker against")
 
     coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
 
     if args.launcher == "manual":
+        # before the auto-checkpoint-dir block: a local temp dir is
+        # meaningless on the remote hosts the printed env targets (and
+        # would leak here)
         return _manual(args, coord)
+
+    auto_ckpt = None
+    if args.max_restarts and args.checkpoint_dir is None:
+        args.checkpoint_dir = os.environ.get("MXNET_CHECKPOINT_DIR")
+        if not args.checkpoint_dir:
+            import tempfile
+
+            auto_ckpt = tempfile.mkdtemp(prefix="mxnet-ckpt-")
+            args.checkpoint_dir = auto_ckpt
+            print("launch.py: checkpoints in %s (auto-created; kept on "
+                  "failure for post-mortem)" % auto_ckpt, flush=True)
     if args.num_servers > 0:
-        return _spawn_topology(args, coord)
-    return _spawn_serverless(args, coord)
+        rc = _spawn_topology(args, coord)
+    else:
+        rc = _spawn_serverless(args, coord)
+    if auto_ckpt is not None and rc == 0:
+        import shutil
+
+        shutil.rmtree(auto_ckpt, ignore_errors=True)
+    return rc
 
 
 if __name__ == "__main__":
