@@ -43,6 +43,12 @@ def main():
     p.add_argument("--num-epochs", type=int, default=4)
     p.add_argument("--num-samples", type=int, default=4000)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--gradient-compression", default=None,
+                   choices=["2bit"],
+                   help="wire-level gradient compression for the "
+                        "parameter-server tier (dense pushes quantize "
+                        "to 2 bits with error feedback)")
+    p.add_argument("--compression-threshold", type=float, default=0.5)
     p.add_argument("--checkpoint-dir", default=None,
                    help="coordinated checkpoint dir (default: "
                         "MXNET_CHECKPOINT_DIR from the launcher; "
@@ -117,7 +123,12 @@ def main():
                               label_name="softmax_label")
     eval_it = mx.io.NDArrayIter(x, y, args.batch_size,
                                 label_name="softmax_label")
-    mod = mx.mod.Module(net, context=mx.tpu(0))
+    compression = None
+    if args.gradient_compression:
+        compression = {"type": args.gradient_compression,
+                       "threshold": args.compression_threshold}
+    mod = mx.mod.Module(net, context=mx.tpu(0),
+                        compression_params=compression)
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
     mod.init_params(mx.init.Xavier(),

@@ -806,7 +806,16 @@ def kvstore_set_barrier_before_exit(kv, flag):
 
 
 def kvstore_set_gradient_compression(kv, keys, vals):
-    kv.set_gradient_compression(dict(zip(keys, vals)))
+    # the C API ships every value as a string (ref: MXKVStoreSet-
+    # GradientCompression const char** vals); coerce threshold here so
+    # the typed Python validation stays strict
+    params = dict(zip(keys, vals))
+    if isinstance(params.get("threshold"), str):
+        try:
+            params["threshold"] = float(params["threshold"])
+        except ValueError:
+            pass  # validate_compression_params raises loudly
+    kv.set_gradient_compression(params)
 
 
 def kvstore_send_command_to_servers(kv, head, body):

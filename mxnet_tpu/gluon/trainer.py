@@ -102,10 +102,15 @@ class Trainer:
             for i, param in enumerate(self._params):
                 if param.grad_req == "null":
                     continue
+                # async server tier: push returns a future immediately;
+                # the batched pull below waits only on these keys
                 self._kvstore.push(param.name, param.list_grad(), priority=-i)
-                live.append((i, param))
-            for i, param in live:
-                self._kvstore.pull(param.name, param.data(), priority=-i)
+                live.append(param)
+            if live:
+                # one batched pull (per-shard multi-key frames on the
+                # server tier) instead of a round trip per parameter
+                self._kvstore.pull([p.name for p in live],
+                                   [p.data() for p in live], priority=0)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

@@ -58,7 +58,11 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
     """Push all grads, then pull all weights (ref: model.py:126 — push
     priority -idx so comm overlaps backprop; here the push-all phase lets
     a dist kvstore batch every key into one collective before the first
-    pull flushes it, and XLA's async dispatch gives the overlap)."""
+    pull flushes it, and XLA's async dispatch gives the overlap). On the
+    async server tier the pushes enqueue onto the per-shard sender
+    threads and return immediately; the ONE batched pull then waits on
+    exactly those futures and fetches every weight in per-shard
+    multi-key frames instead of a round trip per key."""
     # a worker "step" for deterministic fault injection = one optimizer
     # round (MXNET_FAULT_SPEC worker:R:crash@step=N, mxnet_tpu/chaos.py)
     chaos.tick_step()
@@ -70,8 +74,9 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
         name = param_names[index]
         kvstore.push(name, grad_list, priority=-index)
         live.append((index, name, arg_list))
-    for index, name, arg_list in live:
-        kvstore.pull(name, arg_list, priority=-index)
+    if live:
+        kvstore.pull([name for _i, name, _a in live],
+                     [arg_list for _i, _n, arg_list in live], priority=0)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):

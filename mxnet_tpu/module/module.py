@@ -465,8 +465,13 @@ class Module(BaseModule):
             return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
-            for param_name, param_val in sorted(self._arg_params.items()):
-                self._kvstore.pull(param_name, param_val, priority=0)
+            # ONE batched pull (per-shard multi-key frames on the
+            # server tier) instead of a round trip per parameter
+            names = sorted(self._arg_params)
+            if names:
+                self._kvstore.pull(names,
+                                   [self._arg_params[n] for n in names],
+                                   priority=0)
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):

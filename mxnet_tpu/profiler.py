@@ -84,8 +84,59 @@ def dump_profile():
     """ref: MXDumpProfile → Chrome trace-event JSON (profiler.h:137-139)."""
     with _LOCK:
         payload = {"traceEvents": list(_STATE["events"]), "displayTimeUnit": "ms"}
+    comm = comm_stats()
+    if comm:
+        # comms counters ride along in the trace dump (Chrome ignores
+        # unknown top-level keys) so one artifact captures both views
+        payload["commStats"] = comm
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
+
+
+# ---------------------------------------------------------------------------
+# comms observability (ISSUE 4): always-on per-op counters for the
+# distributed data plane — raw (pre-compression) vs wire bytes, RPC
+# latency, in-flight depth. Cheap enough to run unconditionally; the
+# Chrome-trace events above stay gated on the profiler running.
+# ---------------------------------------------------------------------------
+_COMM_LOCK = threading.Lock()
+_COMM = {}
+
+
+def comm_record(op, raw_bytes=0, wire_bytes=0, seconds=0.0, count=0,
+                inflight=0):
+    """Accumulate comms counters for one kvstore op family."""
+    with _COMM_LOCK:
+        s = _COMM.get(op)
+        if s is None:
+            s = _COMM[op] = {"count": 0, "raw_bytes": 0, "wire_bytes": 0,
+                             "seconds": 0.0, "max_inflight": 0}
+        s["count"] += count
+        s["raw_bytes"] += raw_bytes
+        s["wire_bytes"] += wire_bytes
+        s["seconds"] += seconds
+        if inflight > s["max_inflight"]:
+            s["max_inflight"] = inflight
+
+
+def comm_stats(reset=False):
+    """Snapshot of the per-op comms counters, with derived avg_ms (and,
+    where raw bytes were recorded, the compression ratio)."""
+    with _COMM_LOCK:
+        snap = {op: dict(s) for op, s in _COMM.items()}
+        if reset:
+            _COMM.clear()
+    for s in snap.values():
+        if s["count"]:
+            s["avg_ms"] = round(s["seconds"] / s["count"] * 1e3, 3)
+        if s["raw_bytes"] and s["wire_bytes"]:
+            s["wire_reduction"] = round(s["raw_bytes"] / s["wire_bytes"], 2)
+    return snap
+
+
+def comm_reset():
+    with _COMM_LOCK:
+        _COMM.clear()
 
 
 def pause():

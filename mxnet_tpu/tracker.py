@@ -142,17 +142,51 @@ class _SafeUnpickler(pickle.Unpickler):
             % (module, name))
 
 
-def _pack(obj):
-    return pickle.dumps(obj, protocol=4)
+#: frames whose 4-byte length prefix carries this bit are EXTENDED: the
+#: pickled metadata is followed by out-of-band tensor buffers (pickle
+#: protocol 5), so large arrays cross the wire as raw memoryviews with
+#: no pickle-time copy on either side (ISSUE 4 zero-copy framing)
+_OOB_FLAG = 0x80000000
+#: bounds on the extended frame a peer may ask us to allocate — this is
+#: an in-cluster protocol, but a corrupt length must not OOM the server
+_OOB_MAX_BUFS = 4096
+_OOB_MAX_BYTES = 1 << 33
 
 
-def _unpack(raw):
-    return _SafeUnpickler(io.BytesIO(raw)).load()
+def _pack(obj, buffer_callback=None):
+    return pickle.dumps(obj, protocol=5, buffer_callback=buffer_callback)
+
+
+def _unpack(raw, buffers=None):
+    return _SafeUnpickler(io.BytesIO(raw), buffers=buffers).load()
 
 
 def _send_msg(sock, obj):
-    raw = _pack(obj)
-    sock.sendall(struct.pack(">I", len(raw)) + raw)
+    """Send one frame; returns the total bytes written (comms
+    accounting). Objects containing ``pickle.PickleBuffer``-wrapped
+    arrays are framed extended: metadata pickles WITHOUT the tensor
+    bytes, then each buffer is written straight from the array's own
+    memory (``sendall`` on a memoryview — no concatenation copy)."""
+    bufs = []
+    raw = _pack(obj, buffer_callback=bufs.append)
+    if len(raw) >= _OOB_FLAG:
+        # the flag bit halves the old 4 GiB inline ceiling: a frame
+        # that large must fail loudly, not masquerade as extended
+        raise ValueError(
+            "wire frame metadata too large (%d bytes; limit %d)"
+            % (len(raw), _OOB_FLAG - 1))
+    if not bufs:
+        payload = struct.pack(">I", len(raw)) + raw
+        sock.sendall(payload)
+        return len(payload)
+    views = [pb.raw() for pb in bufs]
+    header = struct.pack(">II", _OOB_FLAG | len(raw), len(views))
+    header += b"".join(struct.pack(">Q", v.nbytes) for v in views)
+    sock.sendall(header)
+    sock.sendall(raw)
+    for v in views:
+        sock.sendall(v)
+    return len(header) + len(raw) + sum(v.nbytes for v in views)
 
 
 def _recv_exact(sock, n):
@@ -165,9 +199,40 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _recv_msg(sock):
+def _recv_into_exact(sock, buf):
+    view = memoryview(buf)
+    got = 0
+    while got < len(buf):
+        n = sock.recv_into(view[got:])
+        if not n:
+            raise ConnectionError("tracker: peer closed")
+        got += n
+
+
+def _recv_msg(sock, with_size=False):
     (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-    return _unpack(_recv_exact(sock, n))
+    if not n & _OOB_FLAG:
+        obj = _unpack(_recv_exact(sock, n))
+        return (obj, 4 + n) if with_size else obj
+    raw_len = n & ~_OOB_FLAG
+    (nbufs,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if nbufs > _OOB_MAX_BUFS:
+        raise ConnectionError("bad frame: %d out-of-band buffers" % nbufs)
+    lens = struct.unpack(">%dQ" % nbufs, _recv_exact(sock, 8 * nbufs))
+    if sum(lens) > _OOB_MAX_BYTES:
+        raise ConnectionError("bad frame: %d buffer bytes" % sum(lens))
+    raw = _recv_exact(sock, raw_len)
+    # buffers land in writable bytearrays the deserialized arrays view
+    # directly — one kernel->user copy, nothing else
+    bufs = []
+    for ln in lens:
+        buf = bytearray(ln)
+        _recv_into_exact(sock, buf)
+        bufs.append(buf)
+    obj = _unpack(raw, buffers=bufs)
+    if with_size:
+        return obj, 4 + 4 + 8 * nbufs + raw_len + sum(lens)
+    return obj
 
 
 def connect_with_backoff(uri, deadline=30.0, base_delay=0.05, max_delay=2.0):

@@ -277,6 +277,35 @@ def test_restart_budget_exhaustion_fails_cleanly(tmp_path):
 
 
 @pytest.mark.slow
+def test_dist_async_with_2bit_compression_converges(tmp_path):
+    """ISSUE 4 satellite: the full scheduler topology with wire-level
+    2-bit gradient compression — dense pushes quantize client-side
+    (error-feedback residual), the packed payload crosses the wire, the
+    server dequantizes before its optimizer — still shows decreasing
+    loss on BOTH workers."""
+    env = _clean_env()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--timeout", "55",
+         sys.executable,
+         os.path.join(ROOT, "examples", "distributed", "dist_sync.py"),
+         "--kv-store", "dist_async", "--num-epochs", "3",
+         "--num-samples", "1200", "--batch-size", "100",
+         "--gradient-compression", "2bit",
+         "--compression-threshold", "0.5"],
+        env=env, capture_output=True, text=True, timeout=60)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    losses = re.findall(r"worker (\d) loss ([\d.]+) -> ([\d.]+)", out)
+    assert len(losses) == 2, out[-2000:]
+    for rank, loss0, loss1 in losses:
+        assert float(loss1) < float(loss0), \
+            "worker %s loss did not decrease under 2-bit compression: " \
+            "%s -> %s" % (rank, loss0, loss1)
+    assert {r for r, _, _ in losses} == {"0", "1"}
+
+
+@pytest.mark.slow
 def test_chaos_check_tool_passes():
     """CI smoke (ISSUE 3 satellite): tools/chaos_check.py runs a full
     crash-and-recover job and exits 0 only when the recovery actually

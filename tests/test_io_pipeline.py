@@ -162,6 +162,24 @@ def test_bandwidth_tool_runs():
     assert all(l["value"] > 0 for l in lines)
 
 
+def test_bandwidth_wire_mode_runs():
+    """tools/bandwidth.py --wire (ISSUE 4): the ServerKVStore push/pull
+    microbenchmark emits one bench.py-compatible metric line with the
+    sync-vs-async and raw-vs-2bit comparisons. Tiny payload: this is a
+    format/plumbing check, the real numbers come from the default
+    invocation."""
+    lines = _run_tool("bandwidth.py", "--wire", "--size-mb", "0.25",
+                      "--keys", "8", "--iters", "2", "--workers", "1",
+                      timeout=60)
+    (rec,) = [l for l in lines if l.get("metric") == "kvstore_wire_push_pull"]
+    assert rec["unit"] == "MB/s" and rec["value"] > 0
+    for field in ("sync_s", "async_s", "async_speedup",
+                  "wire_reduction_2bit", "rpc_frames_async"):
+        assert field in rec, rec
+    # the wire-level win the PR claims: 2-bit really shrinks the bytes
+    assert rec["wire_reduction_2bit"] >= 4.0, rec
+
+
 def test_parse_log_tool(tmp_path):
     """tools/parse_log.py (ref: tools/parse_log.py) turns Module.fit log
     lines into the markdown table."""

@@ -583,16 +583,23 @@ def test_pull_retries_transparently(server, chaos_env):
 
 def test_error_replies_are_never_retried(server, chaos_env):
     """An ('err', ...) reply is a server-side REJECTION, not a
-    transport failure: it must surface immediately (a retried bad
-    request would just fail N times and hide the real error)."""
+    transport failure: it must surface at the next wait point (a
+    retried bad request would just fail N times and hide the real
+    error). With the async pipelined client the push itself returns
+    immediately; the rejection lands on its future."""
     import mxnet_tpu as mx
 
     chaos_env("rpc:drop@op=pull,n=0")  # engine active, nothing fires
     kv = ServerKVStore(server.addr)
     before = server._pushes_applied
+    kv.push("never_inited", np.ones((2,), np.float32))
     with pytest.raises(mx.MXNetError, match="push before init"):
-        kv.push("never_inited", np.ones((2,), np.float32))
+        kv.wait_outstanding()
     assert server._pushes_applied == before
+    # the failure is sticky: the data plane is compromised and every
+    # subsequent op must keep failing loudly
+    with pytest.raises(mx.MXNetError, match="asynchronous push failed"):
+        kv.push("w", np.ones((2,), np.float32))
     kv.close()
 
 
@@ -613,6 +620,7 @@ def test_dead_shard_error_names_the_shard(monkeypatch):
                        match=r"push.*shard 0 \(%s\).*failed after 2"
                              % srv.addr):
         kv.push("w", np.ones((2,), np.float32))
+        kv.wait_outstanding()  # async push: the failure lands here
     kv.close()
 
 

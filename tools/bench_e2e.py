@@ -120,7 +120,7 @@ def main():
     jax.block_until_ready(loss)
     coupled_img_s = batch * n_coupled / (time.perf_counter() - t0)
 
-    print(json.dumps({
+    rec = {
         "metric": "resnet_e2e_train_throughput",
         "value": round(coupled_img_s, 2), "unit": "img/s",
         "io_img_s": round(io_img_s, 2),
@@ -129,7 +129,16 @@ def main():
         "num_layers": args.num_layers, "data_shape": ds,
         "batch_size": batch, "threads": args.threads,
         "fused": bool(args.fused), "backend": jax.default_backend(),
-    }))
+    }
+    # kvstore data-plane counters (raw vs wire bytes, RPC latency) ride
+    # along when this process did distributed push/pull — the ISSUE 4
+    # observability surface, empty on the single-chip path
+    from mxnet_tpu import profiler
+
+    comm = profiler.comm_stats()
+    if comm:
+        rec["comm"] = comm
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
