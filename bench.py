@@ -66,6 +66,8 @@ def _measure(variant):
 
     n_dev = len(jax.devices())
     cancel_watchdog()  # backend is up; compile/run own their time
+    if variant == "fit":
+        return _measure_fit(n_dev)
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
@@ -130,6 +132,64 @@ def _measure(variant):
     print(json.dumps({"error": "%s: all batch sizes OOM" % variant}))
 
 
+def _measure_fit(n_dev):
+    """End-to-end variant (ISSUE 5): host-fed Module.fit() on synthetic
+    NDArrayIter data through the async input pipeline + device-resident
+    metrics. Unlike the device-resident variants this number includes
+    every per-batch host cost of the real training loop — the trajectory
+    now tracks it so feed-path regressions are visible."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel.feed import DeviceQueueIter
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224), fused=False)
+    contexts = [mx.Context("cpu" if jax.default_backend() == "cpu"
+                           else "tpu", i) for i in range(n_dev)]
+    for per_dev_batch in (128, 64, 32):
+        batch = per_dev_batch * n_dev
+        n = batch * 6  # 6 batches/epoch keeps host RAM bounded (~450MB)
+        try:
+            rng = np.random.RandomState(0)
+            X = rng.randn(n, 3, 224, 224).astype(np.float32)
+            y = rng.randint(0, 1000, (n,)).astype(np.float32)
+            mod = mx.mod.Module(sym, context=contexts)
+            times = []
+            profiler.pipeline_reset()
+            with DeviceQueueIter(mx.io.NDArrayIter(X, y, batch_size=batch),
+                                 module=mod) as feed:
+                mod.fit(feed, num_epoch=4, kvstore="tpu", optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9},
+                        initializer=mx.initializer.Xavier(),
+                        epoch_end_callback=lambda *_: times.append(
+                            time.perf_counter()))
+            if mod._fused is None:
+                print(json.dumps({"error": "fit: fused path not engaged"}))
+                return
+            # epoch 0 pays compile; average the remaining epochs
+            img_s = n * (len(times) - 1) / (times[-1] - times[0])
+            stats = profiler.pipeline_stats()
+            print(json.dumps({"img_s": round(img_s, 2), "variant": "fit",
+                              "batch": per_dev_batch,
+                              "host_syncs": stats.get("host_syncs", 0),
+                              "avg_put_ms": stats.get("avg_put_ms"),
+                              "avg_stall_feed_ms":
+                                  stats.get("avg_stall_feed_ms")}))
+            return
+        except Exception as e:
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                continue
+            print(json.dumps({"error": "fit: %s" % msg[:500]}))
+            return
+    print(json.dumps({"error": "fit: all batch sizes OOM"}))
+
+
 def _report(results, kernels=None):
     best = max(results.values(), key=lambda r: r["img_s"])
     rec = {
@@ -187,11 +247,13 @@ def main():
     results = {}
     errors = []
     # unfused first (the known-compiling banker), then the fused
-    # headline; two tries each — a wedged tunnel sometimes recovers.
-    # A best-so-far line prints after EVERY success: the driver reads
-    # the LAST json line, so even if it kills this process mid-attempt
-    # the round still lands a number.
-    for variant in ("unfused", "fused", "unfused", "fused"):
+    # headline, then the end-to-end fit loop (ISSUE 5 — host-fed
+    # Module.fit through the async input pipeline); two tries each —
+    # a wedged tunnel sometimes recovers. A best-so-far line prints
+    # after EVERY success: the driver reads the LAST json line, so even
+    # if it kills this process mid-attempt the round still lands a
+    # number.
+    for variant in ("unfused", "fused", "fit", "unfused", "fused", "fit"):
         if variant in results:
             continue
         if time.time() > deadline - 60:

@@ -103,6 +103,20 @@ KNOBS = {
         "1", "honored", "world size (dist.py env_spec)"),
     "DMLC_WORKER_ID": (
         "0", "honored", "worker rank (dist.py env_spec)"),
+    # --- input pipeline / fit hot loop (ISSUE 5) ---
+    "MXNET_TPU_FEED_DEPTH": (
+        "2", "honored",
+        "DeviceQueueIter bounded pipeline depth: batches staged on the "
+        "mesh ahead of the consumer (parallel/feed.py)"),
+    "MXNET_TPU_MAX_INFLIGHT": (
+        "2", "honored",
+        "fused fit loop dispatch-ahead bound: compiled steps in flight "
+        "before the host throttles (module/spmd_group.py)"),
+    "MXNET_TPU_DEVICE_METRICS": (
+        "1", "honored",
+        "fold per-batch metric stats computed inside the compiled step "
+        "into device accumulators; host device_get only at Speedometer/"
+        "epoch boundaries (module/spmd_group.py, metric.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

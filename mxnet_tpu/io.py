@@ -14,6 +14,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 from collections import OrderedDict, namedtuple
 
 import numpy as np
@@ -165,7 +166,14 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (ref: io.py PrefetchingIter; the python
-    face of the C++ iter_prefetcher.h)."""
+    face of the C++ iter_prefetcher.h).
+
+    Lifecycle (ISSUE 5 satellite): the reference shut the threads down
+    only from ``__del__``, which leaks the daemon workers whenever
+    iteration stops early and the iterator stays referenced. Explicit
+    :meth:`close` (also a context manager) joins them deterministically;
+    ``reset()`` keeps working after ``StopIteration`` and restarts the
+    epoch cleanly."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -182,6 +190,7 @@ class PrefetchingIter(DataIter):
         for e in self.data_taken:
             e.set()
         self.started = True
+        self._closed = False
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
 
@@ -204,12 +213,39 @@ class PrefetchingIter(DataIter):
             thread.daemon = True
             thread.start()
 
-    def __del__(self):
+    def close(self):
+        """Join the prefetch threads and close the source iterators that
+        support close(). Idempotent; the iterator is unusable after."""
+        if self._closed:
+            return
+        self._closed = True
         self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join(timeout=1)
+        # a worker mid-fetch clears data_taken when its next() returns,
+        # erasing a single set() — keep re-signalling until each thread
+        # observes started=False. Bounded: a worker wedged inside the
+        # source iterator's next() is a daemon and is abandoned.
+        deadline = time.monotonic() + 5.0
+        for e, thread in zip(self.data_taken, self.prefetch_threads):
+            while thread.is_alive() and time.monotonic() < deadline:
+                e.set()
+                thread.join(timeout=0.05)
+        for it in self.iters:
+            inner_close = getattr(it, "close", None)
+            if callable(inner_close):
+                inner_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -246,6 +282,10 @@ class PrefetchingIter(DataIter):
         )
 
     def reset(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter: iterator is closed")
+        # after StopIteration data_ready is already set (next_batch is
+        # None), so this wait returns immediately and the epoch restarts
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
@@ -256,6 +296,8 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter: iterator is closed")
         for e in self.data_ready:
             e.wait()
         if self.next_batch[0] is None:
@@ -346,6 +388,12 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
+        # tail-batch staging (ISSUE 5 satellite): host numpy mirrors of
+        # each source plus one preallocated wraparound buffer per source,
+        # filled in place — the reference re-materialized BOTH full
+        # source arrays and concatenated fresh numpy per padded batch
+        self._np_cache = {}
+        self._tail_bufs = {}
 
     @property
     def provide_data(self):
@@ -384,18 +432,31 @@ class NDArrayIter(DataIter):
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
         if self.cursor + self.batch_size <= self.num_data:
-            return [
-                x[1][self.cursor : self.cursor + self.batch_size].copy() for x in data_source
-            ]
+            # zero-copy fast path (ISSUE 5 satellite): aligned batches are
+            # views into the source arrays — no per-batch copy on the feed
+            # path (shuffle already rematerialized its own arrays at
+            # __init__, so views stay consistent across epochs)
+            return [x[1][self.cursor : self.cursor + self.batch_size]
+                    for x in data_source]
         pad = self.batch_size - self.num_data + self.cursor
-        return [
-            nd.array(
-                np.concatenate(
-                    (x[1].asnumpy()[self.cursor :], x[1].asnumpy()[:pad]), axis=0
-                )
-            )
-            for x in data_source
-        ]
+        head = self.num_data - self.cursor
+        out = []
+        for name, arr in data_source:
+            src = self._np_cache.get(name)
+            if src is None:
+                src = self._np_cache[name] = np.asarray(arr._data())
+            buf = self._tail_bufs.get(name)
+            if buf is None:
+                buf = self._tail_bufs[name] = np.empty(
+                    (self.batch_size,) + src.shape[1:], src.dtype)
+            np.copyto(buf[:head], src[self.cursor:])
+            np.copyto(buf[head:], src[:pad])
+            # hand device_put a private copy: some backends alias the
+            # host buffer (or read it asynchronously), and the staging
+            # buffer is overwritten on the next epoch's tail while the
+            # previous batch may still be referenced downstream
+            out.append(nd.array(buf.copy()))
+        return out
 
     def getdata(self):
         return self._getdata(self.data)

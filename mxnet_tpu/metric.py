@@ -25,6 +25,30 @@ def register(*names):
     return deco
 
 
+def _materialize_dicts(label, pred):
+    """ONE batched ``jax.device_get`` covering every device-backed array
+    in both name->array dicts (ISSUE 5 satellite). The per-array
+    ``asnumpy`` calls inside ``update()`` are each a blocking D2H round
+    trip; fetching the whole tree at once overlaps the transfers and
+    syncs a single time. Host numpy values pass through untouched."""
+    keys, vals = [], []
+    for which, d in (("l", label), ("p", pred)):
+        for k, v in d.items():
+            data = v._data() if hasattr(v, "_data") else v
+            if type(data).__module__.startswith("jax"):
+                keys.append((which, k))
+                vals.append(data)
+    if not vals:
+        return label, pred
+    import jax
+
+    host = jax.device_get(vals)
+    label, pred = dict(label), dict(pred)
+    for (which, k), h in zip(keys, host):
+        (label if which == "l" else pred)[k] = h
+    return label, pred
+
+
 def check_label_shapes(labels, preds, shape=False):
     if shape:
         label_shape, pred_shape = len(labels), len(preds)
@@ -56,6 +80,7 @@ class EvalMetric:
         return config
 
     def update_dict(self, label, pred):
+        label, pred = _materialize_dicts(label, pred)
         if self.output_names is not None:
             pred = [pred[name] for name in self.output_names if name in pred]
         else:
@@ -69,11 +94,30 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # -- device-resident statistics (ISSUE 5) --------------------------------
+    def _attach_device_source(self, source):
+        """Register a device accumulator (FusedSPMDGroup's device-metric
+        path). Its (sum, count) stays on device until :meth:`get` folds
+        it in — the ONE host sync per Speedometer/epoch interval."""
+        srcs = self.__dict__.setdefault("_device_sources", [])
+        if source not in srcs:
+            srcs.append(source)
+
+    def _fold_device_sources(self):
+        for src in self.__dict__.get("_device_sources", ()):
+            s, n = src.drain()
+            if n:
+                self.sum_metric += s
+                self.num_inst += n
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        for src in self.__dict__.get("_device_sources", ()):
+            src.clear()
 
     def get(self):
+        self._fold_device_sources()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -102,6 +146,9 @@ class CompositeEvalMetric(EvalMetric):
             return ValueError("Metric index {} is out of range 0 and {}".format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
+        # materialize ONCE for all children (their own update_dict then
+        # sees host numpy and skips the device_get)
+        labels, preds = _materialize_dicts(labels, preds)
         for metric in self.metrics:
             metric.update_dict(labels, preds)
 

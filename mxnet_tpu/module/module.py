@@ -103,6 +103,11 @@ class Module(BaseModule):
     def _reset_bind(self):
         self.binded = False
         self._exec_group = None
+        if self._fused is not None:
+            # the fused group owns the device-resident optimizer state:
+            # dropping it must force init_optimizer to rebuild the
+            # group, else a re-bound fit() silently trains unfused
+            self.optimizer_initialized = False
         self._fused = None
         self._data_shapes = None
         self._label_shapes = None

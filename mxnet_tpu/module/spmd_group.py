@@ -14,18 +14,61 @@ introspection parity.
 """
 from __future__ import annotations
 
+import collections
 import pickle
+import time
 
 import numpy as np
 
+from .. import config, profiler
 from ..base import MXNetError
 from ..ndarray import ndarray as nd
+from ..parallel.feed import is_preplaced, place_batch_array
 from ..parallel.mesh import make_mesh
 from ..parallel.spmd import (
     TrainStep,
     data_sharding,
     functional_from_optimizer,
 )
+
+
+class _DeviceMetricSource:
+    """Device-resident (sum, count) accumulator attached to an EvalMetric
+    by :meth:`FusedSPMDGroup.update_metric`. ``add`` folds one batch's
+    in-step statistics with an async jitted device add (jit, not eager:
+    the stats are replicated over the group's GLOBAL mesh, and eager ops
+    on non-fully-addressable arrays are rejected on multi-host — jit is
+    the supported multiprocess path); ``drain`` is the ONE blocking
+    ``jax.device_get`` (legal on fully-replicated arrays), run by
+    ``EvalMetric.get()`` at Speedometer/epoch boundaries."""
+
+    def __init__(self, group, kind):
+        self.group = group
+        self.kind = kind  # stats key: "correct" | "sum_ce" | "sum_loss"
+        self._sum = None
+        self._n = None
+
+    def add(self, stats):
+        s, n = stats[self.kind], stats["n"]
+        if self._sum is None:
+            self._sum, self._n = s, n
+        else:
+            self._sum, self._n = self.group._metric_accumulate(
+                (self._sum, self._n), (s, n))
+
+    def drain(self):
+        if self._sum is None:
+            return 0.0, 0
+        import jax
+
+        s, n = jax.device_get((self._sum, self._n))
+        self._sum = None
+        self._n = None
+        return float(s), int(n)
+
+    def clear(self):
+        self._sum = None
+        self._n = None
 
 
 class FusedSPMDGroup:
@@ -74,6 +117,18 @@ class FusedSPMDGroup:
             self.mesh = make_mesh({"dp": len(devices)}, devices=devices)
             data_axes = ("dp",)
         self._data_axes = tuple(data_axes)
+        # ISSUE 5 knobs: bound on compiled steps dispatched ahead of the
+        # device (donated carry makes >1 safe) and the in-step metric
+        # statistics that keep the hot loop free of per-batch host syncs
+        max_inflight = config.get_int("MXNET_TPU_MAX_INFLIGHT", 2)
+        if max_inflight is None or max_inflight < 1:
+            raise MXNetError(
+                "MXNET_TPU_MAX_INFLIGHT must be an integer >= 1 (got %r)"
+                % config.get("MXNET_TPU_MAX_INFLIGHT"))
+        self._max_inflight = max_inflight
+        self._inflight = collections.deque()
+        self._device_metrics = config.get_bool("MXNET_TPU_DEVICE_METRICS",
+                                               True)
         self._fopt = functional_from_optimizer(
             optimizer, [n for n in symbol.list_arguments()
                         if n not in data_names and n not in label_names])
@@ -82,6 +137,7 @@ class FusedSPMDGroup:
             symbol, self._fopt, mesh=self.mesh, data_axes=self._data_axes,
             data_names=tuple(data_names), label_names=tuple(label_names),
             compute_dtype=None, normalize_grads=False, return_outputs=True,
+            metric_stats=self._device_metrics,
         )
         self.param_names = list(self._ts.param_names)
         self.aux_names = list(self._ts.aux_names)
@@ -98,6 +154,14 @@ class FusedSPMDGroup:
         self._loss = None
         self._outputs = None
         self._raw_outputs = None
+        self._batch_sharding = data_sharding(self.mesh, self._data_axes)
+        self._stats = None           # last step's in-program metric stats
+        # per-metric double-accumulation guard: ids of the EvalMetric
+        # objects that already folded the CURRENT batch's stats (a
+        # batch-global flag would starve a second metric updated for
+        # the same batch)
+        self._stats_consumers = set()
+        self._accum_fn = None        # jitted pairwise metric-stat add
 
     def _sync_rank0(self, params, aux):
         """Rank-0's host values win on every process (the reference's
@@ -153,49 +217,45 @@ class FusedSPMDGroup:
                 "batch so every rank agrees"
                 % (list(n_rows_list), rows.tolist()))
 
-    def _put_batch_array(self, name, arr):
-        """Host batch → device: local device_put, or the process-local
-        shard of the global batch in distributed mode."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        value = arr._data()
-        if not self.distributed or jax.process_count() == 1:
-            ndev = self.mesh.devices.size
-            if value.shape[0] % ndev != 0:
-                raise MXNetError(
-                    "fused SPMD step: batch dim %d of %r not divisible by "
-                    "%d mesh devices" % (value.shape[0], name, ndev))
-            return jax.device_put(value, data_sharding(self.mesh,
-                                                       self._data_axes))
-        local = np.asarray(value)
-        nproc = jax.process_count()
-        if local.shape[0] % jax.local_device_count() != 0:
-            raise MXNetError(
-                "fused dist step: local batch dim %d of %r not divisible "
-                "by %d local devices"
-                % (local.shape[0], name, jax.local_device_count()))
-        sh = NamedSharding(self.mesh, P(self._data_axes))
-        return jax.make_array_from_process_local_data(
-            sh, local, global_shape=(local.shape[0] * nproc,) + local.shape[1:])
-
     # -- the hot loop --------------------------------------------------------
     def forward_backward_update(self, data_batch):
         """Run one fused step: shard batch over the mesh data axes,
-        fwd+bwd+update in XLA (cross-host all-reduce included)."""
+        fwd+bwd+update in XLA (cross-host all-reduce included).
+
+        Batches already placed on the mesh (DeviceQueueIter) skip the
+        device_put AND the per-batch cross-host agreement collective —
+        a pre-placed global array fixed its global shape at
+        construction. The step itself is dispatched asynchronously; the
+        host throttles only when more than MXNET_TPU_MAX_INFLIGHT steps
+        are outstanding (dispatch-ahead, ISSUE 5)."""
         import jax
 
         arrays = list(zip(self._data_names, data_batch.data))
         labels = getattr(data_batch, "label", None) or []
         arrays += list(zip(self._label_names, labels))
-        if self.distributed and jax.process_count() > 1:
-            self._check_local_batch_agreement(
-                [a.shape[0] for _n, a in arrays])
-        batch = {}
+        values = []
+        host_rows = []
         for name, arr in arrays:
-            batch[name] = self._put_batch_array(name, arr)
+            value = arr._data() if isinstance(arr, nd.NDArray) else arr
+            if not is_preplaced(value, self._batch_sharding):
+                host_rows.append(value.shape[0])
+            values.append((name, value))
+        if host_rows and self.distributed and jax.process_count() > 1:
+            self._check_local_batch_agreement(host_rows)
+        batch = {
+            name: place_batch_array(self.mesh, self._data_axes,
+                                    self.distributed, name, value,
+                                    sharding=self._batch_sharding)
+            for name, value in values
+        }
         key = jax.random.fold_in(self._key, self._step_no)
-        self._carry, (loss, outs) = self._ts(self._carry, batch, key)
+        if self._device_metrics:
+            self._carry, (loss, outs, stats) = self._ts(self._carry, batch,
+                                                        key)
+            self._stats = stats
+            self._stats_consumers.clear()
+        else:
+            self._carry, (loss, outs) = self._ts(self._carry, batch, key)
         self._step_no += 1
         self._loss = loss
         # keep raw device arrays — materialization is deferred to
@@ -203,6 +263,33 @@ class FusedSPMDGroup:
         # aren't consumed every step
         self._raw_outputs = outs
         self._outputs = None
+        self._throttle(loss)
+
+    def _throttle(self, token):
+        """Dispatch-ahead bound: enqueue this step's completion token and
+        block on the OLDEST one only when more than MXNET_TPU_MAX_INFLIGHT
+        steps are outstanding — the host never runs unboundedly ahead of
+        the device, but also never serializes on the step it just
+        dispatched."""
+        import jax
+
+        self._inflight.append(token)
+        while len(self._inflight) > self._max_inflight:
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._inflight.popleft())
+            profiler.h2d_record(
+                stall_compute=time.perf_counter() - t0)
+        profiler.h2d_record(steps=1, inflight=len(self._inflight))
+
+    def drain(self):
+        """Block until every dispatched step has retired. The explicit
+        pipeline drain point: checkpoint/epoch/eval boundaries
+        (copy_params_to, get_states) call it, and the PR 3 quiesce
+        choreography inherits it through save_optimizer_states."""
+        import jax
+
+        while self._inflight:
+            jax.block_until_ready(self._inflight.popleft())
 
     def _materialize_outputs(self, outs):
         """Wrap step outputs; in multi-process mode return each
@@ -210,27 +297,55 @@ class FusedSPMDGroup:
         matching what this worker's metric expects to see."""
         import jax
 
+        # a blocking device→host materialization: when this happens at
+        # batch rate the loop is NOT stall-free — the profiler counter
+        # is what the ISSUE 5 acceptance test asserts is zero on the
+        # device-metric path
+        profiler.h2d_record(host_syncs=1)
         if not self.distributed or jax.process_count() == 1:
             return [nd.NDArray(o) for o in outs]
-        res = []
-        for o in outs:
-            if getattr(o, "is_fully_replicated", False):
-                res.append(nd.array(np.asarray(o.addressable_data(0))))
+        return [nd.array(self._local_rows_host(o)) for o in outs]
+
+    @staticmethod
+    def _local_rows_host(o):
+        """One global device array → this worker's own rows on host:
+        fully-replicated arrays dedupe to shard 0; sharded arrays
+        reassemble the addressable shards in row order."""
+        if getattr(o, "is_fully_replicated", False):
+            return np.asarray(o.addressable_data(0))
+        # shards live on different local devices: assemble on host
+        shards = sorted(
+            o.addressable_shards,
+            key=lambda s: (s.index[0].start or 0) if s.index else 0)
+        seen = set()
+        pieces = []
+        for s in shards:
+            k = tuple((sl.start, sl.stop) for sl in s.index)
+            if k in seen:
                 continue
-            # shards live on different local devices: assemble on host
-            shards = sorted(
-                o.addressable_shards,
-                key=lambda s: (s.index[0].start or 0) if s.index else 0)
-            seen = set()
-            pieces = []
-            for s in shards:
-                k = tuple((sl.start, sl.stop) for sl in s.index)
-                if k in seen:
-                    continue
-                seen.add(k)
-                pieces.append(np.asarray(s.data))
-            res.append(nd.array(np.concatenate(pieces, axis=0)))
-        return res
+            seen.add(k)
+            pieces.append(np.asarray(s.data))
+        return np.concatenate(pieces, axis=0)
+
+    def _materialize_labels(self, labels):
+        """Pre-placed (DeviceQueueIter) labels in multi-process jobs are
+        global arrays whose remote shards ``jax.device_get`` cannot
+        fetch; pull back this worker's own rows, mirroring
+        :meth:`_materialize_outputs` for preds. Host arrays and
+        single-process device labels pass through — the metric's
+        batched ``device_get`` handles those."""
+        import jax
+
+        if not self.distributed or jax.process_count() == 1:
+            return list(labels)
+        out = []
+        for l in labels:
+            data = l._data() if isinstance(l, nd.NDArray) else l
+            if (type(data).__module__.startswith("jax")
+                    and not getattr(data, "is_fully_addressable", True)):
+                l = nd.array(self._local_rows_host(data))
+            out.append(l)
+        return out
 
     def get_outputs(self):
         if self._outputs is None:
@@ -239,10 +354,81 @@ class FusedSPMDGroup:
             self._outputs = self._materialize_outputs(self._raw_outputs)
         return list(self._outputs)
 
+    def _device_metric_plan(self, eval_metric):
+        """[(leaf_metric, stats_key)] when EVERY leaf of eval_metric can
+        be reproduced exactly from the in-step statistics; None forces
+        the host fallback (mixed accumulation would double-count)."""
+        from .. import metric as metric_mod
+
+        # the in-step stats cover outputs[0]/labels[0] only; a
+        # multi-output/multi-label graph's host metric sums over EVERY
+        # (label, pred) pair — force the host path rather than silently
+        # reporting half the pairs
+        if len(self._output_names) != 1 or len(self._label_names) != 1:
+            return None
+        stats = self._stats
+        leaves, stack = [], [eval_metric]
+        while stack:
+            m = stack.pop()
+            if isinstance(m, metric_mod.CompositeEvalMetric):
+                stack.extend(m.metrics)
+                continue
+            leaves.append(m)
+        plan = []
+        for m in leaves:
+            if m.output_names is not None or m.label_names is not None:
+                return None  # name-filtered metrics need the real arrays
+            if (type(m) is metric_mod.Accuracy and m.axis == 1
+                    and "correct" in stats):
+                plan.append((m, "correct"))
+            elif (type(m) in (metric_mod.CrossEntropy,
+                              metric_mod.NegativeLogLikelihood)
+                    and m.eps == 1e-12 and "sum_ce" in stats):
+                plan.append((m, "sum_ce"))
+            else:
+                return None
+        return plan
+
+    def _metric_accumulate(self, acc, batch_stats):
+        """Jitted pairwise add of (sum, n) device scalars (async; the
+        multiprocess-legal way to combine replicated global arrays)."""
+        import jax
+
+        if self._accum_fn is None:
+            self._accum_fn = jax.jit(
+                lambda a, b: jax.tree_util.tree_map(
+                    lambda x, y: x + y, a, b))
+        return self._accum_fn(acc, batch_stats)
+
+    def _attach_source(self, m, kind):
+        by_kind = m.__dict__.setdefault("_fused_metric_srcs", {})
+        src = by_kind.get((id(self), kind))
+        if src is None:
+            src = by_kind[(id(self), kind)] = _DeviceMetricSource(self, kind)
+        m._attach_device_source(src)
+        return src
+
     def update_metric(self, eval_metric, labels):
-        # Same name-keyed dispatch as DataParallelExecutorGroup.update_metric
-        # so metrics with output_names/label_names pick the right arrays.
-        labels_ = dict(zip(self._label_names, labels))
+        # Device-resident path (ISSUE 5): fold the step's in-program
+        # statistics into device accumulators — eager async adds, zero
+        # host syncs; EvalMetric.get() drains them at Speedometer/epoch
+        # boundaries. In multi-process jobs the stats are GLOBAL sums
+        # (they psum across hosts inside the compiled step), so every
+        # worker's log shows the global metric.
+        if self._device_metrics and self._stats is not None:
+            plan = self._device_metric_plan(eval_metric)
+            if plan is not None:
+                if id(eval_metric) not in self._stats_consumers:
+                    for m, kind in plan:
+                        self._attach_source(m, kind).add(self._stats)
+                    self._stats_consumers.add(id(eval_metric))
+                return
+        # Host fallback — same name-keyed dispatch as
+        # DataParallelExecutorGroup.update_metric so metrics with
+        # output_names/label_names pick the right arrays. Materializes
+        # outputs: a per-batch host sync (profiler host_syncs counts it).
+        labels_ = dict(zip(self._label_names,
+                           self._materialize_labels(labels)))
         preds_ = dict(zip(self._output_names, self.get_outputs()))
         eval_metric.update_dict(labels_, preds_)
 
@@ -250,6 +436,7 @@ class FusedSPMDGroup:
     def copy_params_to(self, arg_params, aux_params):
         import jax
 
+        self.drain()
         params, _opt, aux, _step = self._carry
         host_p, host_a = jax.device_get((params, aux))  # one batched D2H
         for k in self.param_names:
@@ -263,6 +450,7 @@ class FusedSPMDGroup:
         import jax.numpy as jnp
         from ..parallel.spmd import replicated
 
+        self.drain()
         old_p, old_o, old_a, old_s = self._carry
         p = params if params is not None else dict(old_p)
         o = opt_state if opt_state is not None else old_o
@@ -287,8 +475,11 @@ class FusedSPMDGroup:
     def get_states(self):
         import jax
 
+        self.drain()
         _params, opt_state, _aux, step_no = self._carry
-        host = jax.tree_util.tree_map(np.asarray, opt_state)
+        # ONE tree device_get instead of a blocking np.asarray per state
+        # array (ISSUE 5 satellite: batched D2H on the checkpoint path)
+        host = jax.device_get(opt_state)
         return pickle.dumps({"format": self._STATE_FORMAT,
                              "opt_state": host, "step": int(step_no)})
 

@@ -43,7 +43,8 @@ def _is_tensor_like(v):
 class NDArray:
     """Multi-dimensional array on a device context."""
 
-    __slots__ = ("_jax", "_ctx", "_grad_entry", "_base", "_index", "_stype", "__weakref__")
+    __slots__ = ("_jax", "_ctx", "_grad_entry", "_base", "_index", "_stype",
+                 "_view_cache", "__weakref__")
 
     # numpy should defer binary ops to us
     __array_priority__ = 100.0
@@ -55,11 +56,23 @@ class NDArray:
         self._base = base  # parent NDArray when this is a view
         self._index = index  # index into parent
         self._stype = stype
+        self._view_cache = None  # (base buffer, sliced value) memo
 
     # -- raw value access ----------------------------------------------------
     def _data(self):
         if self._base is not None:
-            return self._base._data()[self._index]
+            # memoize the computed slice per base buffer: every property
+            # read (shape/dtype) goes through _data(), and zero-copy
+            # iterator batches (NDArrayIter fast path) are views read
+            # many times per batch — without the memo each read would
+            # dispatch a fresh slice op
+            base = self._base._data()
+            cached = self._view_cache
+            if cached is not None and cached[0] is base:
+                return cached[1]
+            value = base[self._index]
+            self._view_cache = (base, value)
+            return value
         return self._jax
 
     def _rebind(self, new_value):
@@ -205,16 +218,43 @@ class NDArray:
         key = self._norm_key(key)
         if isinstance(key, NDArray):
             return invoke("take", [self, key], {"axis": 0, "mode": "clip"})
+        if self._base is not None:
+            composed = self._chain_index(key)
+            if composed is None:
+                # the key has no single-root-index form (tuple/fancy
+                # keys, or a view over one): read out of the
+                # materialized view instead — writes to the result do
+                # not flow back to the root, same as take() copies
+                return NDArray(self._data()[key], ctx=self._ctx)
+            return NDArray(None, ctx=self._ctx, base=self._root(),
+                           index=composed)
         # return a view that writes through on _rebind
-        return NDArray(None, ctx=self._ctx, base=self._root(), index=self._chain_index(key))
+        return NDArray(None, ctx=self._ctx, base=self._root(), index=key)
 
     def _root(self):
         return self._base if self._base is not None else self
 
     def _chain_index(self, key):
-        if self._base is None:
-            return key
-        raise MXNetError("nested views are not supported; copy first")
+        """Compose a key applied to this view into one root index, or
+        None when the composition has no single-index form (tuple and
+        fancy keys). Slice-of-slice (any step/sign) and integer keys
+        stay zero-copy write-through views — the batch-feed path
+        slices iterator views again per device
+        (executor_group._load_slice on NDArrayIter's zero-copy batches)
+        and must not force a copy, and a detached copy would silently
+        break the write-through contract single-level views have."""
+        idx = self._index
+        if not isinstance(idx, slice):
+            return None  # view over an int/fancy key: row has no axis 0
+        rows = range(*idx.indices(self._base._data().shape[0]))
+        if isinstance(key, (int, _np.integer)) and not isinstance(key, bool):
+            return rows[int(key)]  # IndexError out of range, as numpy
+        if isinstance(key, slice):
+            r = rows[key]
+            # a negative normalized stop only happens stepping downward
+            # past row 0, where the sentinel is None
+            return slice(r.start, r.stop if r.stop >= 0 else None, r.step)
+        return None
 
     def _norm_key(self, key):
         if isinstance(key, NDArray) and key.dtype != _np.bool_:
@@ -540,6 +580,7 @@ class NDArray:
         self._base = None
         self._index = None
         self._stype = "default"
+        self._view_cache = None
 
 
 # ---------------------------------------------------------------------------

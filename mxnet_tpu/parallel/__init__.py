@@ -7,6 +7,7 @@ reference's data parallelism it adds the TPU generalizations the survey
 mandates: ring-attention/Ulysses sequence parallelism (ring.py) and a
 GPipe collective-permute pipeline (pipeline.py).
 """
+from .feed import DeviceQueueIter, place_batch_array  # noqa: F401
 from .mesh import default_mesh, make_mesh, set_default_mesh  # noqa: F401
 from .ring import (  # noqa: F401
     full_attention, ring_attention, ring_attention_inner,

@@ -89,6 +89,9 @@ def dump_profile():
         # comms counters ride along in the trace dump (Chrome ignores
         # unknown top-level keys) so one artifact captures both views
         payload["commStats"] = comm
+    pipe = pipeline_stats()
+    if pipe:
+        payload["pipelineStats"] = pipe
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -137,6 +140,80 @@ def comm_stats(reset=False):
 def comm_reset():
     with _COMM_LOCK:
         _COMM.clear()
+
+
+# ---------------------------------------------------------------------------
+# input-pipeline observability (ISSUE 5): always-on counters for the
+# host→device feed path and the fit hot loop. `puts`/`nbytes` count the
+# actual device_put transfers (on the DeviceQueueIter worker thread when
+# the async pipeline is active); `preplaced` counts batch arrays that
+# arrived on the mesh already sharded (the pipelined fast path);
+# `host_syncs` counts blocking device→host materializations *in the
+# steady-state fit loop* — the acceptance number for a stall-free loop
+# is host_syncs == 0; `stall_feed`/`stall_compute` split consumer wait
+# time between "waiting on the feed queue" and "throttling dispatch
+# ahead of the device".
+# ---------------------------------------------------------------------------
+_PIPE_LOCK = threading.Lock()
+_PIPE_ZERO = {
+    "puts": 0, "preplaced": 0, "batches": 0, "steps": 0, "nbytes": 0,
+    "put_seconds": 0.0, "stall_feed_seconds": 0.0,
+    "stall_compute_seconds": 0.0, "host_syncs": 0,
+    "max_queue_depth": 0, "max_inflight": 0,
+}
+_PIPE = dict(_PIPE_ZERO)
+
+
+def h2d_record(nbytes=0, puts=0, preplaced=0, batches=0, steps=0,
+               seconds=0.0, stall_feed=0.0, stall_compute=0.0,
+               queue_depth=None, inflight=None, host_syncs=0):
+    """Accumulate input-pipeline counters (thread-safe; cheap enough to
+    run unconditionally, like comm_record)."""
+    with _PIPE_LOCK:
+        s = _PIPE
+        s["puts"] += puts
+        s["preplaced"] += preplaced
+        s["batches"] += batches
+        s["steps"] += steps
+        s["nbytes"] += nbytes
+        s["put_seconds"] += seconds
+        s["stall_feed_seconds"] += stall_feed
+        s["stall_compute_seconds"] += stall_compute
+        s["host_syncs"] += host_syncs
+        if queue_depth is not None and queue_depth > s["max_queue_depth"]:
+            s["max_queue_depth"] = queue_depth
+        if inflight is not None and inflight > s["max_inflight"]:
+            s["max_inflight"] = inflight
+
+
+def pipeline_stats(reset=False):
+    """Snapshot of the input-pipeline counters with derived averages.
+    Empty dict when nothing was recorded."""
+    with _PIPE_LOCK:
+        snap = dict(_PIPE)
+        if reset:
+            _PIPE.update(_PIPE_ZERO)
+    if not any(snap[k] for k in ("puts", "preplaced", "batches", "steps",
+                                 "host_syncs")):
+        return {}
+    if snap["puts"]:
+        snap["avg_put_ms"] = round(
+            snap["put_seconds"] / snap["puts"] * 1e3, 3)
+        if snap["put_seconds"] > 0:
+            snap["put_MBps"] = round(
+                snap["nbytes"] / snap["put_seconds"] / 1e6, 1)
+    if snap["batches"]:
+        snap["avg_stall_feed_ms"] = round(
+            snap["stall_feed_seconds"] / snap["batches"] * 1e3, 3)
+    if snap["steps"]:
+        snap["avg_stall_compute_ms"] = round(
+            snap["stall_compute_seconds"] / snap["steps"] * 1e3, 3)
+    return snap
+
+
+def pipeline_reset():
+    with _PIPE_LOCK:
+        _PIPE.update(_PIPE_ZERO)
 
 
 def pause():

@@ -85,9 +85,27 @@ def main():
         batch = mx.io.DataBatch(data=[nd.array(X[lo:lo + LOCAL_BATCH])],
                                 label=[nd.array(y[lo:lo + LOCAL_BATCH])])
 
-    for _ in range(STEPS):
-        mod.forward_backward(batch)
-        mod.update()
+    if args.single:
+        for _ in range(STEPS):
+            mod.forward_backward(batch)
+            mod.update()
+    else:
+        # feed through the async input pipeline (ISSUE 5): batches cross
+        # as pre-placed global arrays (make_array_from_process_local_data
+        # on the worker thread) and the trajectory must still match the
+        # single-process reference bit-for-bit
+        from mxnet_tpu.parallel.feed import DeviceQueueIter
+
+        feed = DeviceQueueIter(
+            mx.io.NDArrayIter(X[lo:lo + LOCAL_BATCH], y[lo:lo + LOCAL_BATCH],
+                              batch_size=LOCAL_BATCH),
+            group=mod._fused)
+        for step in range(STEPS):
+            if step:
+                feed.reset()
+            mod.forward_backward(feed.next())
+            mod.update()
+        feed.close()
 
     arg, _aux = mod.get_params()
 

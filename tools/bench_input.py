@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Input-pipeline microbenchmark: sync vs pipelined host→device feed.
+
+The headline bench (bench.py) measures the compiled step with the batch
+pre-placed on device; the real ``Module.fit`` loop pays per-batch host
+costs the bench never sees. This tool measures exactly that gap on the
+SAME fused train loop, three ways:
+
+- ``device_resident``: step rate with the batch already on the mesh
+  (the bench.py convention — the ceiling).
+- ``sync``: the fit hot loop fed raw host batches, device metrics off —
+  every batch pays a synchronous device_put and a blocking metric
+  materialization (the pre-ISSUE-5 behavior).
+- ``pipelined``: the same loop behind DeviceQueueIter with
+  device-resident metrics — batch N+1 is sharded onto the mesh while
+  step N runs, metrics fold on device, zero per-batch host syncs
+  (asserted via the profiler's host_syncs counter).
+
+Prints ONE bench.py-style JSON line::
+
+  {"metric": "input_pipeline_fit_throughput", "value": <pipelined img/s>,
+   "sync_img_s": ..., "pipelined_img_s": ..., "device_resident_img_s": ...,
+   "pipeline_speedup": ..., "host_syncs_sync": ..., "host_syncs_pipelined": 0,
+   ...}
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _symbol(hidden, classes):
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _make_module(sym, contexts, batch, dim, device_metrics):
+    import mxnet_tpu as mx
+
+    prev = os.environ.get("MXNET_TPU_DEVICE_METRICS")
+    os.environ["MXNET_TPU_DEVICE_METRICS"] = "1" if device_metrics else "0"
+    try:
+        mod = mx.mod.Module(sym, context=contexts)
+        mod.bind(data_shapes=[("data", (batch, dim))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(initializer=mx.initializer.Xavier())
+        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_TPU_DEVICE_METRICS", None)
+        else:
+            os.environ["MXNET_TPU_DEVICE_METRICS"] = prev
+    if mod._fused is None:
+        raise SystemExit("fused SPMD path did not engage (kvstore='tpu')")
+    return mod
+
+
+def _fit_epochs(mod, feed, metric, epochs):
+    """The Module.fit hot-loop structure: forward_backward + update +
+    update_metric per batch, metric drain at each epoch end."""
+    n = 0
+    for _ in range(epochs):
+        feed.reset()
+        metric.reset()
+        for batch in feed:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+            n += 1
+        metric.get()          # the one boundary sync per epoch
+    # retire everything still in flight so the measurement is honest
+    mod._fused.drain()
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--num-batches", type=int, default=8,
+                   help="batches per epoch of synthetic data")
+    p.add_argument("--dim", type=int, default=3072,
+                   help="feature dim (drives H2D bytes/batch)")
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--classes", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=3,
+                   help="measured epochs (one extra warmup epoch compiles)")
+    p.add_argument("--depth", type=int, default=None,
+                   help="DeviceQueueIter depth (default MXNET_TPU_FEED_DEPTH)")
+    args = p.parse_args()
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.parallel.feed import DeviceQueueIter
+    from mxnet_tpu.parallel.spmd import data_sharding
+
+    contexts = [mx.Context("cpu" if jax.default_backend() == "cpu" else "tpu",
+                           i) for i in range(len(jax.devices()))]
+    batch, dim = args.batch_size, args.dim
+    rng = np.random.RandomState(0)
+    n = batch * args.num_batches
+    X = rng.randn(n, dim).astype(np.float32)
+    y = rng.randint(0, args.classes, (n,)).astype(np.float32)
+    sym = _symbol(args.hidden, args.classes)
+
+    def make_iter():
+        return mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False)
+
+    results = {}
+
+    # -- device-resident ceiling -----------------------------------------
+    mod = _make_module(sym, contexts, batch, dim, device_metrics=True)
+    ts = mod._fused._ts
+    sharding = data_sharding(mod._fused.mesh, mod._fused._data_axes)
+    dev_batch = mx.io.DataBatch(
+        data=[mx.nd.NDArray(jax.device_put(X[:batch], sharding))],
+        label=[mx.nd.NDArray(jax.device_put(y[:batch], sharding))])
+    metric = mx.metric.Accuracy()
+    _fit_epochs(mod, _OneBatch(dev_batch, args.num_batches), metric, 1)
+    t0 = time.perf_counter()
+    steps = _fit_epochs(mod, _OneBatch(dev_batch, args.num_batches), metric,
+                        args.epochs)
+    results["device_resident"] = batch * steps / (time.perf_counter() - t0)
+
+    # -- sync host feed ----------------------------------------------------
+    mod = _make_module(sym, contexts, batch, dim, device_metrics=False)
+    metric = mx.metric.Accuracy()
+    it = make_iter()
+    _fit_epochs(mod, it, metric, 1)
+    profiler.pipeline_reset()
+    t0 = time.perf_counter()
+    steps = _fit_epochs(mod, it, metric, args.epochs)
+    results["sync"] = batch * steps / (time.perf_counter() - t0)
+    sync_stats = profiler.pipeline_stats(reset=True)
+
+    # -- pipelined feed ----------------------------------------------------
+    mod = _make_module(sym, contexts, batch, dim, device_metrics=True)
+    metric = mx.metric.Accuracy()
+    with DeviceQueueIter(make_iter(), group=mod._fused,
+                         depth=args.depth) as dq:
+        _fit_epochs(mod, dq, metric, 1)
+        profiler.pipeline_reset()
+        t0 = time.perf_counter()
+        steps = _fit_epochs(mod, dq, metric, args.epochs)
+        results["pipelined"] = batch * steps / (time.perf_counter() - t0)
+    pipe_stats = profiler.pipeline_stats(reset=True)
+
+    rec = {
+        "metric": "input_pipeline_fit_throughput",
+        "value": round(results["pipelined"], 2), "unit": "img/s",
+        "sync_img_s": round(results["sync"], 2),
+        "pipelined_img_s": round(results["pipelined"], 2),
+        "device_resident_img_s": round(results["device_resident"], 2),
+        "pipeline_speedup": round(results["pipelined"] / results["sync"], 3),
+        "host_syncs_sync": sync_stats.get("host_syncs", 0),
+        "host_syncs_pipelined": pipe_stats.get("host_syncs", 0),
+        "preplaced_batches": pipe_stats.get("preplaced", 0),
+        "pipeline": {k: pipe_stats[k] for k in
+                     ("avg_put_ms", "put_MBps", "avg_stall_feed_ms",
+                      "avg_stall_compute_ms", "max_queue_depth",
+                      "max_inflight") if k in pipe_stats},
+        "batch_size": batch, "num_batches": args.num_batches, "dim": dim,
+        "backend": jax.default_backend(), "devices": len(jax.devices()),
+    }
+    print(json.dumps(rec))
+
+
+class _OneBatch:
+    """Reuse one pre-placed device batch N times per epoch (the
+    device-resident ceiling's feed)."""
+
+    def __init__(self, batch, n):
+        self.batch, self.n, self.i = batch, n, 0
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self.i = 0
+
+    def __next__(self):
+        if self.i >= self.n:
+            raise StopIteration
+        self.i += 1
+        return self.batch
+
+    next = __next__
+
+
+if __name__ == "__main__":
+    main()
