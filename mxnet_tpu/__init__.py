@@ -58,6 +58,7 @@ from . import engine  # noqa: F401
 from . import dist  # noqa: F401
 from . import tracker  # noqa: F401
 from . import chaos  # noqa: F401
+from . import serving  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 from . import test_utils  # noqa: F401

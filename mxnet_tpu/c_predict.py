@@ -7,6 +7,13 @@ embeds CPython; this module owns everything behind it — symbol JSON
 parsing, param loading, binding the jitted XLA inference program. A C
 deployment links one .so and never sees Python, while the compiled
 program underneath is the same HloModule the framework trains with.
+
+Since ISSUE 6 the bind path is the serving tier's
+:class:`~mxnet_tpu.serving.AOTPredictor` in exact-shape mode
+(``ladder=None``): the C ABI and the dynamic-batching server share one
+predictor — constant folding, weight layout freezing, and the
+``get_internals`` partial-output selection behave identically on both
+surfaces.
 """
 from __future__ import annotations
 
@@ -34,54 +41,32 @@ def _as_ndarray_map(param_bytes):
 
 
 class CPredictor:
-    """One bound inference program (the PredictorHandle's payload)."""
+    """One bound inference program (the PredictorHandle's payload).
+
+    A thin ABI adapter over the serving tier's AOT predictor bound at
+    the exact ``input_shapes`` (``ladder=None``): no padding, no bucket
+    selection — the reference's fixed-shape PredictorHandle contract —
+    but the same constant-folded, layout-frozen compiled forward the
+    dynamic-batching server runs."""
 
     def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
                  input_shapes, output_names=None):
         from . import context as ctx_mod
         from . import symbol as sym_mod
-        from .ndarray.ndarray import zeros
+        from .serving import AOTPredictor
 
         sym = sym_mod.load_json(symbol_json)
-        if output_names:
-            # partial-out picks internal nodes (ref: c_predict_api.cc uses
-            # sym.GetInternals() so any layer can be an output)
-            internals = sym.get_internals()
-            outs = internals.list_outputs()
-            picked = []
-            for name in output_names:
-                want = name if name in outs else name + "_output"
-                if want not in outs:
-                    raise ValueError("unknown output %r (have %s)" % (name, outs))
-                picked.append(internals[outs.index(want)])
-            sym = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
-
         # dev_type follows the reference enum: 1=cpu, 2=gpu(=accelerator)
         ctx = ctx_mod.cpu(dev_id) if dev_type == 1 else ctx_mod.gpu(dev_id)
-
         arg_params, aux_params = _as_ndarray_map(param_bytes)
-        arg_shapes, _, aux_shapes = sym.infer_shape(**dict(input_shapes))
-        args = {}
-        for name, shape in zip(sym.list_arguments(), arg_shapes):
-            if name in input_shapes:
-                args[name] = zeros(input_shapes[name], ctx=ctx)
-            elif name in arg_params:
-                args[name] = arg_params[name].as_in_context(ctx)
-            else:
-                # ref parity: c_predict_api.cc warns and zero-fills args
-                # absent from the params file (loss labels, eval-only args)
-                args[name] = zeros(shape, ctx=ctx)
-        aux = {}
-        for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
-            if name in aux_params:
-                aux[name] = aux_params[name].as_in_context(ctx)
-            else:
-                aux[name] = zeros(shape, ctx=ctx)
-
-        self._exe = sym.bind(ctx, args, args_grad=None, grad_req="null",
-                             aux_states=aux)
-        self._ctx = ctx
-        self._input_shapes = dict(input_shapes)
+        self._pred = AOTPredictor(
+            sym, arg_params, aux_params, data_shapes=dict(input_shapes),
+            ladder=None, device=ctx,
+            output_names=list(output_names) if output_names else None)
+        self._input_shapes = {k: tuple(v) for k, v in
+                              dict(input_shapes).items()}
+        self._inputs = {k: _np.zeros(v, _np.float32)
+                        for k, v in self._input_shapes.items()}
         self._outputs = None
 
     # -- ABI backend methods (called from src/c_predict.cc) -----------------
@@ -96,19 +81,16 @@ class CPredictor:
             raise ValueError("input %r: expected %d floats, got %d"
                              % (key, n, size))
         buf = (ctypes.c_float * size).from_address(ptr)
-        data = _np.frombuffer(buf, dtype=_np.float32).reshape(shape)
-        from .ndarray.ndarray import array
-
-        # allocate on the predictor's device: the default context may
-        # differ (e.g. a CPU-default host feeding a TPU-bound program)
-        self._exe.arg_dict[key][:] = array(data.copy(), ctx=self._ctx)
+        self._inputs[key] = _np.frombuffer(
+            buf, dtype=_np.float32).reshape(shape).copy()
+        self._outputs = None  # stale against the new input
 
     def forward(self):
-        self._outputs = [o.asnumpy().astype(_np.float32)
-                         for o in self._exe.forward(is_train=False)]
+        self._outputs = [_np.asarray(o, dtype=_np.float32)
+                         for o in self._pred.predict(self._inputs)]
 
     def num_outputs(self):
-        return len(self._exe._symbol.list_outputs())
+        return self._pred.num_outputs
 
     def output_shape(self, index):
         if self._outputs is None:

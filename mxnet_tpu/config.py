@@ -117,6 +117,24 @@ KNOBS = {
         "fold per-batch metric stats computed inside the compiled step "
         "into device accumulators; host device_get only at Speedometer/"
         "epoch boundaries (module/spmd_group.py, metric.py)"),
+    # --- serving tier (ISSUE 6) ---
+    "MXNET_SERVE_BATCH_LADDER": (
+        "1,4,16,64", "honored",
+        "comma-separated batch-size buckets the AOT predictor binds; "
+        "requests pad up to the nearest bucket (serving/predictor.py; "
+        "malformed or non-increasing ladders raise)"),
+    "MXNET_SERVE_QUEUE_DEPTH": (
+        "256", "honored",
+        "per-model bounded request queue; a full queue backpressures "
+        "submit() (serving/broker.py)"),
+    "MXNET_SERVE_MAX_EXECUTABLES": (
+        "32", "honored",
+        "LRU capacity of compiled (model, bucket, dtype) executables "
+        "shared by all resident models (serving/predictor.py)"),
+    "MXNET_SERVE_SUBMIT_TIMEOUT": (
+        "60", "honored",
+        "seconds submit() may block on backpressure before raising "
+        "(serving/broker.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

@@ -30,6 +30,25 @@ from .symbol.symbol import _AUX_PARAMS, Symbol
 _RNG_SALT = 0x5EED
 
 
+def eval_node(node, ins, key, salt, is_train):
+    """Evaluate ONE symbol-graph node under the executor's op-invocation
+    contract: ``__is_train__`` threading for train/infer-polymorphic ops
+    and the per-node RNG fold-in for stochastic ops. Always returns a
+    tuple of outputs. Shared by the training/inference closures below
+    and the serving tier's constant-fold / inference split
+    (``mxnet_tpu/serving/predictor.py``) so both bind paths invoke ops
+    identically."""
+    attrs = dict(node.attrs)
+    if "__is_train__" in node.op.attr_defaults:
+        attrs["__is_train__"] = is_train
+    if node.op.needs_rng:
+        sub = jax.random.fold_in(key, salt + _RNG_SALT)
+        out = node.op.fn(sub, *ins, **attrs)
+    else:
+        out = node.op.fn(*ins, **attrs)
+    return out if isinstance(out, tuple) else (out,)
+
+
 def _graph_closure(symbol: Symbol, is_train: bool, placement=None):
     """Build a pure function evaluating the symbol graph.
 
@@ -65,16 +84,7 @@ def _graph_closure(symbol: Symbol, is_train: bool, placement=None):
                 results[i] = _place(node, (values[node.name],))
                 continue
             ins = [results[node_ids[id(inp)]][idx] for inp, idx in node.inputs]
-            attrs = dict(node.attrs)
-            if "__is_train__" in node.op.attr_defaults:
-                attrs["__is_train__"] = is_train
-            if node.op.needs_rng:
-                sub = jax.random.fold_in(key, i + _RNG_SALT)
-                out = node.op.fn(sub, *ins, **attrs)
-            else:
-                out = node.op.fn(*ins, **attrs)
-            out = out if isinstance(out, tuple) else (out,)
-            out = _place(node, out)
+            out = _place(node, eval_node(node, ins, key, i, is_train))
             results[i] = out
             # generic aux-state contract: op declares which outputs
             # replace which aux inputs each training step (fused blocks)
@@ -85,7 +95,7 @@ def _graph_closure(symbol: Symbol, is_train: bool, placement=None):
                         aux_updates[inode.name] = out[idx]
             # aux-state update semantics (BatchNorm moving stats)
             elif is_train and node.op.name in _AUX_PARAMS and node._arity:
-                momentum = attrs.get("momentum", 0.9)
+                momentum = node.attrs.get("momentum", 0.9)
                 for pname, (inode, _) in zip(node._arity, node.inputs):
                     if not inode.is_variable():
                         continue

@@ -92,6 +92,9 @@ def dump_profile():
     pipe = pipeline_stats()
     if pipe:
         payload["pipelineStats"] = pipe
+    serve = serving_stats()
+    if serve:
+        payload["servingStats"] = serve
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -214,6 +217,75 @@ def pipeline_stats(reset=False):
 def pipeline_reset():
     with _PIPE_LOCK:
         _PIPE.update(_PIPE_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# serving observability (ISSUE 6): always-on per-model counters for the
+# serving tier — request/batch counts, batch-fill ratio (rows actually
+# served / bucket capacity dispatched), queue depth, and a bounded
+# latency reservoir for p50/p99. Cheap enough to run unconditionally,
+# like comm_record/h2d_record.
+# ---------------------------------------------------------------------------
+_SERVE_LOCK = threading.Lock()
+_SERVE = {}
+_SERVE_LAT_CAP = 8192  # newest-N latency reservoir per model
+
+
+def serving_record(model, requests=0, batches=0, rows=0, capacity=0,
+                   errors=0, queue_depth=None, latencies=None):
+    """Accumulate serving counters for one model (thread-safe)."""
+    with _SERVE_LOCK:
+        s = _SERVE.get(model)
+        if s is None:
+            from collections import deque
+
+            s = _SERVE[model] = {
+                "requests": 0, "batches": 0, "rows": 0, "capacity": 0,
+                "errors": 0, "max_queue_depth": 0,
+                "lat": deque(maxlen=_SERVE_LAT_CAP)}
+        s["requests"] += requests
+        s["batches"] += batches
+        s["rows"] += rows
+        s["capacity"] += capacity
+        s["errors"] += errors
+        if queue_depth is not None and queue_depth > s["max_queue_depth"]:
+            s["max_queue_depth"] = queue_depth
+        if latencies:
+            s["lat"].extend(latencies)
+
+
+def _percentile_ms(sorted_secs, q):
+    idx = int(round(q * (len(sorted_secs) - 1)))
+    return round(sorted_secs[idx] * 1e3, 3)
+
+
+def serving_stats(reset=False):
+    """Per-model snapshot with derived batch-fill ratio, mean batch
+    size, and p50/p99 request latency (ms). Empty dict when the serving
+    tier never ran."""
+    with _SERVE_LOCK:
+        # lat copied to a list INSIDE the lock: handing the live deque
+        # out would race serving_record's extend during sorted()
+        snap = {m: dict(s, lat=list(s["lat"])) for m, s in _SERVE.items()}
+        if reset:
+            _SERVE.clear()
+    out = {}
+    for model, s in snap.items():
+        lat = sorted(s.pop("lat"))
+        if s["batches"]:
+            s["avg_batch_rows"] = round(s["rows"] / s["batches"], 2)
+        if s["capacity"]:
+            s["batch_fill"] = round(s["rows"] / s["capacity"], 3)
+        if lat:
+            s["p50_ms"] = _percentile_ms(lat, 0.50)
+            s["p99_ms"] = _percentile_ms(lat, 0.99)
+        out[model] = s
+    return out
+
+
+def serving_reset():
+    with _SERVE_LOCK:
+        _SERVE.clear()
 
 
 def pause():

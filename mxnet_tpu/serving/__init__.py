@@ -1,0 +1,21 @@
+"""Serving tier (ISSUE 6): AOT-compiled predictor + dynamic-batching
+async server for heavy online traffic.
+
+Reference counterpart: the dedicated inference ABI the reference ships
+as ``c_predict_api`` (PAPER.md §layer 8) — grown here into a full
+serving subsystem: bind-time constant folding and weight layout
+freezing (Relay, nncase), a batch-size ladder of donated-buffer jitted
+forwards, a drain-and-coalesce request broker with backpressure,
+multi-model residency behind one compiled-executable LRU, and
+zero-drop checkpoint hot-swap. ``mxnet_tpu/c_predict.py`` (the C ABI
+backend) binds through the same :class:`AOTPredictor` path.
+"""
+from .predictor import (  # noqa: F401
+    AOTPredictor,
+    DEFAULT_LADDER,
+    ExecutableCache,
+    ServingError,
+    env_batch_ladder,
+    validate_ladder,
+)
+from .broker import ModelServer  # noqa: F401

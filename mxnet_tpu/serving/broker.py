@@ -1,0 +1,387 @@
+"""Dynamic-batching async request broker (the server half of ISSUE 6).
+
+Design: one worker thread per resident model, the PR-4 ``_ShardSender``
+drain-and-coalesce pattern turned 90°: clients enqueue single requests
+and get a Future back immediately; the worker drains everything queued
+up to the model's largest batch bucket into ONE padded forward, then
+slices results back per request. Under light load a request rides alone
+in the smallest bucket (lowest latency); under heavy load the queue
+refills while a batch computes, so the next drain coalesces into the
+largest ready bucket (highest throughput) — no artificial batching
+delay in either regime.
+
+Bounded queue depth gives backpressure: ``submit`` blocks (up to
+``MXNET_SERVE_SUBMIT_TIMEOUT``) while a model's queue holds
+``MXNET_SERVE_QUEUE_DEPTH`` requests, then raises. A worker-thread
+death is sticky and surfaces on the next submit (the kvstore async
+convention). ``close()`` stops and joins every worker with a bounded
+deadline (the PR-5 ``PrefetchingIter.close`` lesson: no leaked
+daemons) and fails still-queued futures loudly.
+
+Checkpoint hot-swap reuses the PR-3/PR-5 quiesce choreography in
+miniature: the swap takes the model's execution lock (waits out the
+in-flight batch = drain), refreezes + refolds the weights, and
+publishes them in one assignment — queued and future requests are
+served by the new model, in-flight ones complete on the old one, and
+nothing is dropped.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import profiler
+from .predictor import (
+    AOTPredictor,
+    ExecutableCache,
+    ServingError,
+    env_batch_ladder,
+    env_positive_float,
+    env_positive_int,
+)
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t_submit")
+
+    def __init__(self, inputs, rows):
+        self.inputs = inputs
+        self.rows = rows
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class _ModelWorker:
+    """One model's queue + serving thread (drain-and-coalesce)."""
+
+    def __init__(self, name, predictor, queue_depth):
+        self.name = name
+        self.predictor = predictor
+        self._depth = queue_depth
+        self._cond = threading.Condition()
+        self._q = deque()
+        self._stopped = False
+        self._error = None       # sticky worker-death error
+        self._busy = False       # a batch is executing right now
+        # quiesce lock: held around every batch forward; swap() takes it
+        # to wait out the in-flight batch before republishing weights
+        self._exec_lock = threading.Lock()
+        self._batch_hook = None  # test seam: called before each forward
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-%s" % name)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+    def enqueue(self, req, timeout):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._stopped:
+                    if self._error is not None:
+                        raise ServingError(
+                            "model %r: worker died: %r"
+                            % (self.name, self._error))
+                    raise ServingError(
+                        "model %r: worker is stopped" % self.name)
+                if len(self._q) < self._depth:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ServingError(
+                        "model %r: request queue full (%d queued, "
+                        "MXNET_SERVE_QUEUE_DEPTH=%d) — backpressure "
+                        "timeout" % (self.name, len(self._q), self._depth))
+                self._cond.wait(min(remaining, 0.1))
+            self._q.append(req)
+            depth = len(self._q)
+            self._cond.notify_all()
+        return depth
+
+    # -- worker side ---------------------------------------------------------
+    def _drain_locked(self):
+        """Pop the largest ready batch: requests in FIFO order while the
+        running row total still fits the biggest bucket."""
+        cap = self.predictor.max_bucket
+        reqs = [self._q.popleft()]
+        total = reqs[0].rows
+        while self._q and total + self._q[0].rows <= cap:
+            r = self._q.popleft()
+            reqs.append(r)
+            total += r.rows
+        return reqs, total
+
+    def _run(self):
+        try:
+            while True:
+                with self._cond:
+                    while not self._q and not self._stopped:
+                        self._cond.wait()
+                    if self._stopped:
+                        return
+                    reqs, rows = self._drain_locked()
+                    self._busy = True
+                    self._cond.notify_all()  # queue space freed
+                try:
+                    self._execute(reqs, rows)
+                except BaseException as e:  # bad batch — fail ITS futures,
+                    for r in reqs:          # keep serving the next ones
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                    profiler.serving_record(self.name, errors=len(reqs))
+                finally:
+                    with self._cond:
+                        self._busy = False
+                        self._cond.notify_all()
+        except BaseException as e:  # worker death: sticky, fail the queue
+            with self._cond:
+                self._error = e
+                self._stopped = True
+                pending = list(self._q)
+                self._q.clear()
+                self._cond.notify_all()
+            for r in pending:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _execute(self, reqs, rows):
+        pred = self.predictor
+        bucket = pred.pick_bucket(rows)
+        with self._exec_lock:
+            if self._batch_hook is not None:
+                self._batch_hook(reqs)
+            if len(reqs) == 1 and reqs[0].rows == bucket:
+                inputs = reqs[0].inputs  # exact fit: no assembly copy
+            else:
+                inputs = {}
+                for name in pred.data_names:
+                    first = reqs[0].inputs[name]
+                    buf = np.zeros((bucket,) + first.shape[1:],
+                                   dtype=first.dtype)
+                    ofs = 0
+                    for r in reqs:
+                        buf[ofs:ofs + r.rows] = r.inputs[name]
+                        ofs += r.rows
+                    inputs[name] = buf
+            outs = pred.run_bucket(inputs, bucket)
+        now = time.perf_counter()
+        lats, ofs = [], 0
+        for r in reqs:
+            res = [o[ofs:ofs + r.rows]
+                   if o.ndim and o.shape[0] == bucket else o
+                   for o in outs]
+            ofs += r.rows
+            r.future.set_result(res)
+            lats.append(now - r.t_submit)
+        profiler.serving_record(self.name, batches=1, rows=rows,
+                                capacity=bucket, latencies=lats)
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def join(self, timeout):
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def fail_pending(self, exc):
+        with self._cond:
+            pending = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(exc)
+        return len(pending)
+
+
+class ModelServer:
+    """Multi-model dynamic-batching inference server.
+
+    ::
+
+        with ModelServer() as srv:
+            srv.add_model("resnet", symbol=sym, arg_params=args,
+                          aux_params=auxs,
+                          data_shapes={"data": (1, 3, 224, 224)})
+            fut = srv.submit("resnet", batch_np)   # -> Future
+            probs = fut.result()[0]
+
+    All resident models share one LRU of compiled executables
+    (``MXNET_SERVE_MAX_EXECUTABLES``) keyed by (model, bucket, dtype);
+    evictions recompile on next use, parameters stay resident.
+    """
+
+    def __init__(self, ladder=None, queue_depth=None, cache_capacity=None,
+                 submit_timeout=None, dtype="float32", device=None):
+        from .predictor import validate_ladder
+
+        self._ladder = env_batch_ladder() if ladder is None \
+            else validate_ladder(ladder)
+        self._queue_depth = env_positive_int(
+            "MXNET_SERVE_QUEUE_DEPTH", 256) if queue_depth is None \
+            else int(queue_depth)
+        if self._queue_depth < 1:
+            raise ServingError("ModelServer: queue_depth must be >= 1, "
+                               "got %d" % self._queue_depth)
+        capacity = env_positive_int("MXNET_SERVE_MAX_EXECUTABLES", 32) \
+            if cache_capacity is None else cache_capacity
+        self._cache = ExecutableCache(capacity)
+        self._submit_timeout = env_positive_float(
+            "MXNET_SERVE_SUBMIT_TIMEOUT", 60.0) if submit_timeout is None \
+            else float(submit_timeout)
+        self._dtype = dtype
+        self._device = device
+        self._workers = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- model residency -----------------------------------------------------
+    def add_model(self, name, symbol=None, arg_params=None, aux_params=None,
+                  data_shapes=None, predictor=None, **predictor_kwargs):
+        """Make ``name`` resident: either hand in a prebuilt
+        :class:`AOTPredictor`, or a symbol + params + data_shapes and
+        the server binds one on its shared executable cache."""
+        self._check_open()
+        if predictor is None:
+            if symbol is None or data_shapes is None:
+                raise ServingError(
+                    "add_model(%r): need either predictor= or "
+                    "symbol=/data_shapes= (+params)" % name)
+            predictor_kwargs.setdefault("ladder", self._ladder)
+            predictor_kwargs.setdefault("dtype", self._dtype)
+            predictor_kwargs.setdefault("device", self._device)
+            predictor = AOTPredictor(
+                symbol, arg_params, aux_params, data_shapes=data_shapes,
+                cache=self._cache, model_name=name, **predictor_kwargs)
+        if predictor.ladder is None:
+            raise ServingError(
+                "add_model(%r): exact-bound predictors (ladder=None) "
+                "cannot serve coalesced traffic" % name)
+        with self._lock:
+            if name in self._workers:
+                raise ServingError("model %r is already resident; use "
+                                   "swap() to update its weights" % name)
+            self._workers[name] = _ModelWorker(name, predictor,
+                                               self._queue_depth)
+        return predictor
+
+    def models(self):
+        with self._lock:
+            return sorted(self._workers)
+
+    def _worker(self, name):
+        with self._lock:
+            worker = self._workers.get(name)
+        if worker is None:
+            raise ServingError("unknown model %r (resident: %s)"
+                               % (name, self.models()))
+        return worker
+
+    def _check_open(self):
+        if self._closed:
+            raise ServingError("ModelServer is closed")
+
+    # -- request surface -----------------------------------------------------
+    def submit(self, name, inputs, timeout=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the list of output arrays (request row count).
+        Blocks for queue space up to ``timeout`` (backpressure), then
+        raises :class:`ServingError`."""
+        self._check_open()
+        worker = self._worker(name)
+        pred = worker.predictor
+        inputs, rows = pred._normalize(inputs)
+        pred.pick_bucket(rows)  # reject oversized requests in the caller
+        req = _Request(inputs, rows)
+        depth = worker.enqueue(
+            req, self._submit_timeout if timeout is None else timeout)
+        profiler.serving_record(name, requests=1, queue_depth=depth)
+        return req.future
+
+    def predict(self, name, inputs, timeout=None):
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(name, inputs, timeout=timeout).result()
+
+    # -- hot swap ------------------------------------------------------------
+    def swap(self, name, arg_params=None, aux_params=None,
+             allow_extra=False):
+        """Atomically replace a resident model's weights without
+        dropping requests: waits out the in-flight batch (quiesce),
+        swaps, releases — queued requests are served by the new model."""
+        self._check_open()
+        worker = self._worker(name)
+        with worker._exec_lock:
+            return worker.predictor.swap_params(
+                arg_params, aux_params, allow_extra=allow_extra)
+
+    def swap_from_checkpoint(self, name, prefix=None, epoch=None,
+                             directory=None):
+        """Hot-swap from a checkpoint: either the two-artifact format
+        (``prefix``/``epoch``) or the newest committed checkpoint of an
+        elastic-training ``CheckpointManager`` ``directory``
+        (``CheckpointManager.latest()``)."""
+        if (prefix is None) == (directory is None):
+            raise ServingError("swap_from_checkpoint: pass exactly one "
+                               "of prefix= or directory=")
+        if prefix is not None:
+            from ..model import load_checkpoint
+
+            _, arg_params, aux_params = load_checkpoint(
+                prefix, 0 if epoch is None else int(epoch))
+        else:
+            from ..checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(directory).latest()
+            if ckpt is None:
+                raise ServingError(
+                    "swap_from_checkpoint: no committed checkpoint "
+                    "under %r" % directory)
+            arg_params, aux_params = ckpt.split_weights()
+        return self.swap(name, arg_params, aux_params, allow_extra=True)
+
+    # -- observability -------------------------------------------------------
+    def stats(self, reset=False):
+        """Per-model serving counters (see profiler.serving_stats)."""
+        return profiler.serving_stats(reset=reset)
+
+    @property
+    def executable_cache(self):
+        return self._cache
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop and join every worker (bounded — no leaked daemons),
+        fail still-queued requests. Idempotent; submits after close
+        raise."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.stop()
+        deadline = time.monotonic() + timeout
+        for w in workers:
+            w.join(max(0.0, deadline - time.monotonic()))
+        exc = ServingError("ModelServer closed")
+        for w in workers:
+            w.fail_pending(exc)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

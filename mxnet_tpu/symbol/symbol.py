@@ -142,6 +142,28 @@ class Symbol:
     def _var_nodes(self):
         return [n for n in self._topo() if n.is_variable()]
 
+    def data_dependent_nodes(self, dynamic_names):
+        """Topo indices (into :meth:`_topo` order) of every node whose
+        value depends on any variable named in ``dynamic_names``.
+
+        The bind-time split behind serving constant folding
+        (``mxnet_tpu/serving/predictor.py``): a node NOT in this set is
+        a pure function of the remaining variables (the weights), so an
+        AOT bind can evaluate it once per parameter swap instead of once
+        per request."""
+        dynamic_names = set(dynamic_names)
+        nodes = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        dep = set()
+        for i, node in enumerate(nodes):
+            if node.is_variable():
+                if node.name in dynamic_names:
+                    dep.add(i)
+                continue
+            if any(node_ids[id(inp)] in dep for inp, _ in node.inputs):
+                dep.add(i)
+        return dep
+
     def _aux_names_set(self):
         aux = []
         for node in self._topo():

