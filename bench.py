@@ -78,7 +78,10 @@ def _measure(variant):
     # 2560 at 1024 — TPU_EVIDENCE/ and PROFILE.md round-5 second
     # window). fused: 256 is the largest on-chip-validated batch; a 512
     # attempt can spend minutes in Mosaic compile before falling back.
-    ladder = (512, 256, 128, 64, 32) if variant == "unfused" \
+    # zero (ISSUE 7): the unfused graph with the weight-update sharded
+    # (reduce-scatter → 1/N update → all-gather); acceptance is per-step
+    # time within ~5% of unfused at 1/N per-device optimizer state.
+    ladder = (512, 256, 128, 64, 32) if variant in ("unfused", "zero") \
         else (256, 128, 64, 32)
     for per_dev_batch in ladder:
         batch = per_dev_batch * n_dev
@@ -89,6 +92,7 @@ def _measure(variant):
                                      wd=1e-4),
                 mesh=make_mesh({"dp": n_dev}),
                 compute_dtype="bfloat16",
+                zero=(variant == "zero"),
             )
             params, opt_state, aux = ts.init_params(
                 {"data": (batch, 3, 224, 224), "softmax_label": (batch,)},
@@ -122,8 +126,21 @@ def _measure(variant):
             # where a remote-tunnel runtime under-reports block_until_ready
             dt = time.perf_counter() - t0
             img_s = batch * n_steps / dt
-            print(json.dumps({"img_s": round(img_s, 2), "variant": variant,
-                              "batch": per_dev_batch}))
+            rec = {"img_s": round(img_s, 2), "variant": variant,
+                   "batch": per_dev_batch}
+            if variant == "zero":
+                # measured per-device optimizer-state bytes next to the
+                # analytic replicated baseline (momentum = one fp32
+                # copy of every param, replicated on each device)
+                mem = ts.memory_stats(carry)
+                repl = sum(
+                    int(np.prod(tuple(v.shape) or (1,))) * 4
+                    for v in carry[0].values())
+                rec["opt_bytes_per_dev"] = mem["opt_bytes_per_dev"]
+                rec["repl_opt_bytes_per_dev"] = repl
+                rec["opt_bytes_ratio"] = round(
+                    mem["opt_bytes_per_dev"] / max(repl, 1), 4)
+            print(json.dumps(rec))
             return
         except Exception as e:  # OOM at this batch — try smaller
             msg = str(e)
@@ -237,6 +254,11 @@ def _report(results, kernels=None):
     if "serve" in results:
         rec["serve"] = {k: v for k, v in results["serve"].items()
                         if k != "variant"}
+    if "zero" in results and "opt_bytes_per_dev" in results["zero"]:
+        rec["zero_mem"] = {
+            k: results["zero"][k]
+            for k in ("opt_bytes_per_dev", "repl_opt_bytes_per_dev",
+                      "opt_bytes_ratio")}
     if kernels:
         rec["kernels"] = kernels
     print(json.dumps(rec))
@@ -290,8 +312,8 @@ def main():
     # after EVERY success: the driver reads the LAST json line, so even
     # if it kills this process mid-attempt the round still lands a
     # number.
-    for variant in ("unfused", "fused", "fit", "serve",
-                    "unfused", "fused", "fit", "serve"):
+    for variant in ("unfused", "fused", "fit", "zero", "serve",
+                    "unfused", "fused", "fit", "zero", "serve"):
         if variant in results:
             continue
         if time.time() > deadline - 60:

@@ -146,12 +146,38 @@ class Checkpoint:
         p = os.path.join(self.path, "optimizer.states")
         return p if os.path.exists(p) else None
 
+    def optimizer_state_shard_paths(self):
+        """Per-rank optimizer-state shard files (ISSUE 7: a sharded
+        quiesce where each rank snapshots only ITS addressable slice
+        instead of rank 0 materializing the full replicated state),
+        sorted by rank. Empty when the checkpoint was written
+        single-file."""
+        try:
+            names = sorted(n for n in os.listdir(self.path)
+                           if n.startswith("optimizer-shard-")
+                           and n.endswith(".states"))
+        except OSError:
+            return []
+        return [os.path.join(self.path, n) for n in names]
+
     def optimizer_states(self):
+        """The optimizer-state blob: the single ``optimizer.states``
+        file when present, else the per-rank shard files merged into
+        one pickled ``{key: state}`` map (shards hold disjoint key
+        sets, so the union is exact). Shard files are LOCAL trusted
+        artifacts like every other checkpoint file."""
         p = self.optimizer_states_path()
-        if p is None:
+        if p is not None:
+            with open(p, "rb") as f:
+                return f.read()
+        shards = self.optimizer_state_shard_paths()
+        if not shards:
             return None
-        with open(p, "rb") as f:
-            return f.read()
+        merged = {}
+        for sp in shards:
+            with open(sp, "rb") as f:
+                merged.update(unwrap_states_map(pickle.loads(f.read())))
+        return pickle.dumps(merged, protocol=4)
 
     def optimizer_config(self):
         """(name, kwargs, extras) plain-data tuple, or None."""
@@ -233,6 +259,21 @@ class CheckpointManager:
         (``kv.save_optimizer_states`` writes here directly, reusing the
         existing wire plumbing)."""
         return os.path.join(self.tmp_path_for(epoch), "optimizer.states")
+
+    def staged_optimizer_shard_path(self, epoch, rank):
+        """Where rank ``rank`` stages ITS optimizer-state shard between
+        begin/commit — the staging surface for sharded snapshot writers
+        (ISSUE 7): each shard file holds a disjoint ``{key: state}``
+        map, ``Checkpoint.optimizer_states()`` merges them on read
+        (every restore path — server respawn included — reads through
+        that merge), and a reload under a different mesh/server count
+        re-splits the merged logical map. The stock fused/server tiers
+        still write the single ``optimizer.states`` file (rank 0
+        gathers, which for ZeRO-sharded state means an allgather at
+        checkpoint time); a writer that wants the snapshot to stay
+        1/N per host stages per-rank files here instead."""
+        return os.path.join(self.tmp_path_for(epoch),
+                            "optimizer-shard-%05d.states" % int(rank))
 
     # -- staged write --------------------------------------------------------
     def begin(self, epoch):

@@ -117,6 +117,37 @@ KNOBS = {
         "fold per-batch metric stats computed inside the compiled step "
         "into device accumulators; host device_get only at Speedometer/"
         "epoch boundaries (module/spmd_group.py, metric.py)"),
+    # --- weight-update sharding / ZeRO (ISSUE 7) ---
+    "MXNET_TPU_ZERO": (
+        "0", "honored",
+        "shard the weight update (reduce-scatter grads, update a 1/N "
+        "optimizer-state shard, all-gather weights) over the data axes "
+        "of the fused SPMD step — arXiv:2004.13336 (parallel/spmd.py, "
+        "module/spmd_group.py); 0|1, anything else raises"),
+    "MXNET_TPU_ZERO_WIRE": (
+        "raw", "honored",
+        "gradient-shard wire treatment inside the ZeRO step: 'raw' or "
+        "'2bit' (the PR 4 error-feedback two-bit quantizer applied to "
+        "the reduce-scattered shard; residual is 1/N-sharded too) "
+        "(parallel/spmd.py)"),
+    "MXNET_TPU_ZERO_WIRE_THRESHOLD": (
+        "0.5", "honored",
+        "ternary threshold for MXNET_TPU_ZERO_WIRE=2bit; finite float "
+        "> 0 (parallel/spmd.py)"),
+    "MXNET_TPU_ZERO_MIN_SIZE": (
+        "1024", "honored",
+        "parameters with fewer elements keep replicated optimizer "
+        "state (sharding tiny biases costs more collective latency "
+        "than the bytes saved); shared by the fused tier and the "
+        "dist_async value-sharded server tier (parallel/spmd.py, "
+        "kvstore_server.py)"),
+    "MXNET_TPU_ZERO_SERVER": (
+        "0", "honored",
+        "dist_async mirror of weight-update sharding: slice each "
+        "large dense key's value AND optimizer state across ALL "
+        "servers (push scatters slices, pull gathers) so per-server "
+        "memory scales 1/num_servers; must be set job-wide "
+        "(kvstore_server.py); 0|1, anything else raises"),
     # --- serving tier (ISSUE 6) ---
     "MXNET_SERVE_BATCH_LADDER": (
         "1,4,16,64", "honored",
@@ -162,6 +193,57 @@ def get_int(name, default=None):
 def get_bool(name, default=False):
     v = get(name, "1" if default else "0")
     return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+# --- strict typed accessors (PR 6 convention: a malformed knob is a
+# job misconfiguration — fail loudly at the read site, never train with
+# a silently-substituted default) ------------------------------------
+def get_strict_bool(name):
+    """0/1/true/false/yes/no/on/off; anything else raises MXNetError."""
+    from .base import MXNetError
+
+    v = str(get(name)).strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    raise MXNetError("%s=%r must be a boolean (0|1)" % (name, get(name)))
+
+
+def get_choice(name, choices):
+    from .base import MXNetError
+
+    v = str(get(name)).strip().lower()
+    if v not in choices:
+        raise MXNetError("%s=%r must be one of %s"
+                         % (name, get(name), "|".join(choices)))
+    return v
+
+
+def get_nonneg_int(name):
+    from .base import MXNetError
+
+    raw = get(name)
+    try:
+        v = int(str(raw).strip())
+    except (TypeError, ValueError):
+        v = -1
+    if v < 0:
+        raise MXNetError("%s=%r must be an integer >= 0" % (name, raw))
+    return v
+
+
+def get_positive_float(name):
+    from .base import MXNetError
+
+    raw = get(name)
+    try:
+        v = float(str(raw).strip())
+    except (TypeError, ValueError):
+        v = float("nan")
+    if not 0.0 < v < float("inf"):  # also rejects NaN
+        raise MXNetError("%s=%r must be a finite float > 0" % (name, raw))
+    return v
 
 
 def describe():

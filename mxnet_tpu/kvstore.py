@@ -71,6 +71,47 @@ def validate_compression_params(compression_params):
 _QUANT_JIT = {}
 
 
+def two_bit_pack_core(a, threshold):
+    """Traceable 2-bit pack: ternary threshold, 4 codes per byte.
+    Returns ``(packed uint8, quantized values)``. Pure jnp — callable
+    from inside any jit/pjit program (the local tier's kernels below
+    AND the fused ZeRO step's wire-compression path share it)."""
+    import jax.numpy as jnp
+
+    pos = a >= threshold
+    neg = a <= -threshold
+    quant = jnp.where(pos, threshold,
+                      jnp.where(neg, -threshold, 0.0)).astype(a.dtype)
+    codes = pos.astype(jnp.uint8) | (neg.astype(jnp.uint8) << 1)
+    flat = codes.reshape(-1)
+    pad = (-flat.size) % 4
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), jnp.uint8)])
+    q4 = flat.reshape(-1, 4)
+    packed = (q4[:, 0] | (q4[:, 1] << 2)
+              | (q4[:, 2] << 4) | (q4[:, 3] << 6))
+    return packed, quant
+
+
+def two_bit_round_trip_core(g, res, threshold):
+    """Traceable quantize→dequantize with error feedback: the value
+    ``g`` would have after crossing the packed 2-bit wire, plus the new
+    residual. Round-trips through the ACTUAL packed codes, so fidelity
+    matches the server-tier wire bit-for-bit."""
+    import jax.numpy as jnp
+
+    a = g + res
+    packed, quant = two_bit_pack_core(a, threshold)
+    t = jnp.asarray(threshold, a.dtype)
+    codes = jnp.stack([(packed >> (2 * j)) & 3 for j in range(4)],
+                      axis=1).reshape(-1)[:a.size]
+    q = jnp.where(codes == 1, t,
+                  jnp.where(codes == 2, -t,
+                            jnp.zeros((), a.dtype))).reshape(a.shape)
+    return q, a - quant
+
+
 def _two_bit_kernels():
     """The jitted 2-bit cores (compiled once per (shape, dtype,
     threshold)): ``quantize`` — error-feedback add, ternary threshold,
@@ -83,41 +124,16 @@ def _two_bit_kernels():
         import functools
 
         import jax
-        import jax.numpy as jnp
-
-        def _pack(a, threshold):
-            pos = a >= threshold
-            neg = a <= -threshold
-            quant = jnp.where(pos, threshold,
-                              jnp.where(neg, -threshold, 0.0)).astype(a.dtype)
-            codes = pos.astype(jnp.uint8) | (neg.astype(jnp.uint8) << 1)
-            flat = codes.reshape(-1)
-            pad = (-flat.size) % 4
-            if pad:
-                flat = jnp.concatenate(
-                    [flat, jnp.zeros((pad,), jnp.uint8)])
-            q4 = flat.reshape(-1, 4)
-            packed = (q4[:, 0] | (q4[:, 1] << 2)
-                      | (q4[:, 2] << 4) | (q4[:, 3] << 6))
-            return packed, quant
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def quantize(g, res, threshold):
             a = g + res
-            packed, quant = _pack(a, threshold)
+            packed, quant = two_bit_pack_core(a, threshold)
             return packed, a - quant
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def sim(g, res, threshold):
-            a = g + res
-            packed, quant = _pack(a, threshold)
-            t = jnp.asarray(threshold, a.dtype)
-            codes = jnp.stack([(packed >> (2 * j)) & 3 for j in range(4)],
-                              axis=1).reshape(-1)[:a.size]
-            q = jnp.where(codes == 1, t,
-                          jnp.where(codes == 2, -t,
-                                    jnp.zeros((), a.dtype))).reshape(a.shape)
-            return q, a - quant
+            return two_bit_round_trip_core(g, res, threshold)
 
         fns = _QUANT_JIT["fns"] = (quantize, sim)
     return fns

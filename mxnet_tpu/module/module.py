@@ -31,8 +31,12 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 compression_params=None, zero=None):
         super().__init__(logger=logger)
+        # ISSUE 7: weight-update sharding on the fused tier. True/False
+        # forces it; None defers to the MXNET_TPU_ZERO env knob — so
+        # Module.fit users get ZeRO without touching jax.
+        self._zero = zero
         if context is None:
             context = ctx_mod.current_context()
         if isinstance(context, ctx_mod.Context):
@@ -326,6 +330,7 @@ class Module(BaseModule):
                     batch_size=self._exec_group.batch_size,
                     inputs_need_grad=self.inputs_need_grad,
                     distributed=distributed,
+                    zero=self._zero,
                 )
                 if hasattr(kvstore, "attach_mesh"):
                     kvstore.attach_mesh(self._fused.mesh)

@@ -86,7 +86,8 @@ class FusedSPMDGroup:
 
     def __init__(self, symbol, contexts, optimizer, arg_params, aux_params,
                  data_names, label_names, fixed_param_names=None, logger=None,
-                 batch_size=None, inputs_need_grad=False, distributed=False):
+                 batch_size=None, inputs_need_grad=False, distributed=False,
+                 zero=None):
         import jax
 
         if fixed_param_names:
@@ -129,6 +130,12 @@ class FusedSPMDGroup:
         self._inflight = collections.deque()
         self._device_metrics = config.get_bool("MXNET_TPU_DEVICE_METRICS",
                                                True)
+        # ISSUE 7: weight-update sharding — explicit arg wins, else the
+        # (strictly validated) MXNET_TPU_ZERO knob, so Module.fit users
+        # opt in via env or ctor without touching jax
+        if zero is None:
+            zero = config.get_strict_bool("MXNET_TPU_ZERO")
+        self.zero = bool(zero)
         self._fopt = functional_from_optimizer(
             optimizer, [n for n in symbol.list_arguments()
                         if n not in data_names and n not in label_names])
@@ -137,7 +144,7 @@ class FusedSPMDGroup:
             symbol, self._fopt, mesh=self.mesh, data_axes=self._data_axes,
             data_names=tuple(data_names), label_names=tuple(label_names),
             compute_dtype=None, normalize_grads=False, return_outputs=True,
-            metric_stats=self._device_metrics,
+            metric_stats=self._device_metrics, zero=self.zero,
         )
         self.param_names = list(self._ts.param_names)
         self.aux_names = list(self._ts.aux_names)
@@ -433,12 +440,41 @@ class FusedSPMDGroup:
         eval_metric.update_dict(labels_, preds_)
 
     # -- host sync -----------------------------------------------------------
-    def copy_params_to(self, arg_params, aux_params):
+    def _fetch_host(self, tree):
+        """Device tree → host tree, legal on EVERY tier. A plain
+        ``jax.device_get`` crashes on global arrays with non-addressable
+        shards (the multi-process tier — same bug class as the PR 5
+        label fallback): fully-replicated leaves dedupe to this
+        process's shard 0, and genuinely sharded leaves (ZeRO optimizer
+        state) all-gather through a jitted identity first (the
+        multiprocess-legal collective), then read the local copy.
+        Single-process trees keep the one batched device_get."""
         import jax
 
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if all(getattr(l, "is_fully_addressable", True) for l in leaves):
+            return jax.device_get(tree)
+        rep = None
+        out = []
+        for l in leaves:
+            if getattr(l, "is_fully_addressable", True):
+                out.append(jax.device_get(l))
+            elif getattr(l, "is_fully_replicated", False):
+                out.append(np.asarray(l.addressable_data(0)))
+            else:
+                if rep is None:
+                    from ..parallel.spmd import replicated
+
+                    rep = jax.jit(
+                        lambda x: x,
+                        out_shardings=replicated(self.mesh))
+                out.append(np.asarray(rep(l).addressable_data(0)))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def copy_params_to(self, arg_params, aux_params):
         self.drain()
         params, _opt, aux, _step = self._carry
-        host_p, host_a = jax.device_get((params, aux))  # one batched D2H
+        host_p, host_a = self._fetch_host((params, aux))  # one batched D2H
         for k in self.param_names:
             nd.NDArray(host_p[k]).copyto(arg_params[k])
         for k in self.aux_names:
@@ -473,15 +509,20 @@ class FusedSPMDGroup:
     _STATE_FORMAT = "fused-spmd-v1"
 
     def get_states(self):
-        import jax
-
         self.drain()
-        _params, opt_state, _aux, step_no = self._carry
-        # ONE tree device_get instead of a blocking np.asarray per state
-        # array (ISSUE 5 satellite: batched D2H on the checkpoint path)
-        host = jax.device_get(opt_state)
+        params, opt_state, _aux, step_no = self._carry
+        # ONE tree fetch instead of a blocking np.asarray per state
+        # array (ISSUE 5 satellite), through the per-shard/allgather
+        # path so ZeRO-sharded state on the multi-process tier never
+        # hits device_get's non-addressable crash (ISSUE 7 satellite).
+        # The blob stores the LOGICAL layout — un-padded, param-shaped,
+        # mesh-size independent — so a state saved under zero=True on N
+        # devices restores bit-exactly under zero=False (and any mesh).
+        host = self._fetch_host(opt_state)
+        logical = self._ts.logical_opt_state(host, params)
         return pickle.dumps({"format": self._STATE_FORMAT,
-                             "opt_state": host, "step": int(step_no)})
+                             "opt_state": logical, "step": int(step_no),
+                             "zero": self._ts.zero})
 
     def set_states(self, blob):
         try:

@@ -257,10 +257,30 @@ class TrainStep:
     size, mirroring Module's ``rescale_grad=1/batch`` convention so lr
     values transfer.
 
-    ``zero=True`` shards optimizer state over the data axes (weight-update
-    sharding / ZeRO: XLA reduce-scatters grads into the update and
-    all-gathers the new weights — the TPU answer to the reference's
-    server-side optimizer, kvstore_dist_server.h).
+    ``zero=True`` (default: the ``MXNET_TPU_ZERO`` knob) turns on
+    weight-update sharding (ZeRO / arXiv:2004.13336 — the TPU answer to
+    the reference's server-side optimizer, kvstore_dist_server.h): each
+    large replicated parameter's update is computed on an explicit
+    ``(num_shards, chunk)`` view of its flattened (zero-padded) value,
+    with the gradient view constrained to the data axes — the
+    reduce-scatter point: XLA materializes each device's 1/N gradient
+    shard directly instead of all-reducing the full gradient — the
+    optimizer update runs on that 1/N shard (momentum/Adam state lives
+    ONLY in its shard between steps, so per-device optimizer-state
+    bytes scale 1/N), and the updated shards are constrained back to
+    replicated — the all-gather point. Collective volume equals the
+    plain all-reduce (RS+AG == AR); memory and update FLOPs drop to
+    1/N. Parameters smaller than ``MXNET_TPU_ZERO_MIN_SIZE`` elements
+    and tensor-parallel-sharded parameters keep the mirrored path.
+    Uneven sizes (``size % N != 0``) are zero-padded; the padding lanes
+    provably stay zero under sgd/momentum/adam + wd. With
+    ``zero_wire="2bit"`` (``MXNET_TPU_ZERO_WIRE``) the reduce-scattered
+    gradient shard additionally round-trips through the PR 4 packed
+    two-bit wire codes with a 1/N-sharded error-feedback residual
+    (multi-host: this is the quantizer sitting on the reduce-scattered
+    DCN wire; single-host: the exact-fidelity simulation, like the
+    local tier). The residual is transient — it resets on
+    checkpoint restore, matching the server tier's residuals.
 
     ``metric_stats=True`` (requires ``return_outputs=True``) additionally
     returns a dict of replicated per-batch metric statistics computed
@@ -276,13 +296,32 @@ class TrainStep:
     def __init__(self, symbol, optimizer, mesh=None, data_axes=("dp",),
                  param_rules=None, label_names=("softmax_label",),
                  data_names=("data",), compute_dtype=None, loss_fn=None,
-                 zero=False, remat=False, normalize_grads=True,
-                 return_outputs=False, metric_stats=False):
+                 zero=None, remat=False, normalize_grads=True,
+                 return_outputs=False, metric_stats=False, zero_wire=None,
+                 zero_min_size=None):
+        from .. import config
         from ..executor import _graph_closure
 
         self.symbol = symbol
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
+        # ZeRO knobs (ISSUE 7): explicit ctor args win; None consults the
+        # env knobs, which are strictly validated (nonsense raises)
+        if zero is None:
+            zero = config.get_strict_bool("MXNET_TPU_ZERO")
+        self.zero = bool(zero)
+        if zero_wire is None:
+            zero_wire = config.get_choice("MXNET_TPU_ZERO_WIRE",
+                                          ("raw", "2bit"))
+        elif zero_wire not in ("raw", "2bit"):
+            raise MXNetError("TrainStep: zero_wire=%r must be raw|2bit"
+                             % (zero_wire,))
+        self.zero_wire = zero_wire
+        self.zero_threshold = config.get_positive_float(
+            "MXNET_TPU_ZERO_WIRE_THRESHOLD")
+        if zero_min_size is None:
+            zero_min_size = config.get_nonneg_int("MXNET_TPU_ZERO_MIN_SIZE")
+        self.zero_min_size = int(zero_min_size)
         self.optimizer = (
             optimizer if isinstance(optimizer, FunctionalOptimizer)
             else functional_optimizer(**optimizer) if isinstance(optimizer, dict)
@@ -292,7 +331,6 @@ class TrainStep:
         self.data_names = tuple(data_names)
         self.compute_dtype = compute_dtype
         self.loss_fn = loss_fn or cross_entropy_loss
-        self.zero = zero
         self.remat = remat
         self.normalize_grads = normalize_grads
         self.return_outputs = return_outputs
@@ -361,37 +399,141 @@ class TrainStep:
             _rnd_mod._INIT_RNG = prev_init_rng
         return params, opt_state, aux
 
+    # -- weight-update sharding (ZeRO, ISSUE 7) ------------------------------
+    def _zero_axes(self):
+        """Mesh axes the weight update shards over (the data axes)."""
+        if not self.zero or self.mesh is None:
+            return ()
+        return tuple(a for a in self.data_axes if a in self.mesh.axis_names)
+
+    def zero_plan(self, params, param_rules=None):
+        """{param_name: (shape, size, num_shards, chunk)} for every
+        parameter whose update shards over the data axes: replicated by
+        the tp rules, at least ``zero_min_size`` (and ``num_shards``)
+        elements. Empty when zero is off or the mesh has one device."""
+        axes = self._zero_axes()
+        if not axes:
+            return {}
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        n = 1
+        for a in axes:
+            n *= sizes[a]
+        if n <= 1:
+            return {}
+        rules = self.param_rules if param_rules is None else param_rules
+        ps = param_shardings(params, self.mesh, rules)
+        plan = {}
+        for k, v in params.items():
+            shape = tuple(v.shape)
+            if not shape or ps[k].spec != P():
+                continue  # scalars and tp-sharded params keep mirrors
+            size = 1
+            for d in shape:
+                size *= int(d)
+            if size < max(self.zero_min_size, n):
+                continue
+            plan[k] = (shape, size, n, -(-size // n))
+        return plan
+
+    _ZERO_RES = "__zero_wire_residual__"
+
+    @staticmethod
+    def _zsplit_np(x, n, chunk):
+        """Host-side logical → (num_shards, chunk) zero layout."""
+        flat = _np.asarray(x).reshape(-1)
+        pad = n * chunk - flat.size
+        if pad:
+            flat = _np.concatenate([flat, _np.zeros((pad,), flat.dtype)])
+        return flat.reshape(n, chunk)
+
+    def _opt_state_to_zero(self, opt_state, plan):
+        """Lay optimizer state out for the sharded update: every array
+        leaf of a planned param becomes its padded (num_shards, chunk)
+        view, and the 2-bit wire residual tree is created when missing.
+        Idempotent — leaves already in zero layout pass through, so
+        re-placing a live carry (set_params/_replace) is a no-op."""
+        if not plan:
+            return opt_state
+        out = {}
+        for k, v in opt_state.items():
+            if k == self._ZERO_RES:
+                out[k] = v  # live residual: keep it across re-places
+                continue
+            if k not in plan:
+                out[k] = v
+                continue
+            _shape, _size, n, chunk = plan[k]
+            out[k] = jax.tree_util.tree_map(
+                lambda x: x if tuple(getattr(x, "shape", ())) == (n, chunk)
+                else self._zsplit_np(x, n, chunk), v)
+        if self.zero_wire == "2bit":
+            # reconcile the residual tree with THIS plan: keep live
+            # per-key residuals whose shard shape still matches, zero
+            # the rest (a rules change mid-life alters the plan; a
+            # stale residual key would KeyError inside the step)
+            res = out.get(self._ZERO_RES) or {}
+            out[self._ZERO_RES] = {
+                k: res[k] if (k in res and tuple(_np.shape(res[k]))
+                              == (plan[k][2], plan[k][3]))
+                else _np.zeros((plan[k][2], plan[k][3]), _np.float32)
+                for k in plan}
+        elif self._ZERO_RES in out:
+            del out[self._ZERO_RES]  # wire turned off: drop residuals
+        return out
+
+    def logical_opt_state(self, opt_state, params, param_rules=None):
+        """Zero-layout (host) optimizer state → the mesh-size-independent
+        logical layout checkpoints store: planned leaves are un-padded
+        and reshaped back to their parameter's shape; the transient wire
+        residual is dropped (it resets on restore, like the server
+        tier's residuals). The inverse of :meth:`_opt_state_to_zero`, so
+        a state saved under ``zero=True`` on N devices restores bit-
+        exactly under ``zero=False`` or any other mesh size."""
+        plan = self.zero_plan(params, param_rules)
+        out = {}
+        for k, v in opt_state.items():
+            if k == self._ZERO_RES:
+                continue
+            if k not in plan:
+                out[k] = v
+                continue
+            shape, size, n, chunk = plan[k]
+            out[k] = jax.tree_util.tree_map(
+                lambda x: _np.asarray(x).reshape(-1)[:size].reshape(shape)
+                if tuple(getattr(x, "shape", ())) == (n, chunk) else x, v)
+        return out
+
     # -- sharding ------------------------------------------------------------
     def shardings(self, params, opt_state, aux, param_rules=None):
+        """Shardings for a carry whose opt_state is already in the
+        layout :meth:`place` produces (zero keys as (num_shards, chunk)
+        views); leaves not in that layout mirror their param."""
         mesh = self.mesh
         if mesh is None:
             return None
         rules = self.param_rules if param_rules is None else param_rules
         ps = param_shardings(params, mesh, rules)
         rep = replicated(mesh)
-        if self.zero:
-            # ZeRO / weight-update sharding: optimizer state shards its
-            # leading dim over the data axes (stacked with any tp sharding
-            # the param already has on later dims).
-            def zero_shard(k):
-                def leaf(x):
-                    if x.ndim == 0:
-                        return rep
-                    base = list(tuple(ps[k].spec) + (None,) * (x.ndim - len(ps[k].spec)))
-                    if base[0] is not None:  # already tp-sharded on dim 0
-                        return ps[k]
-                    spec = P(*([self.data_axes] + base[1:]))
-                    if _spec_fits(spec, x.shape, mesh):
-                        return NamedSharding(mesh, spec)
-                    return ps[k]
-                return leaf
+        plan = self.zero_plan(params, rules)
+        axes = self._zero_axes()
+        zspec = NamedSharding(mesh, P(axes, None)) if axes else rep
 
-            opt_s = {k: jax.tree_util.tree_map(zero_shard(k), v)
-                     for k, v in opt_state.items()}
-        else:
-            # opt state mirrors its param's sharding
-            opt_s = {k: jax.tree_util.tree_map(lambda _, k=k: ps[k], v)
-                     for k, v in opt_state.items()}
+        def opt_shard(k):
+            def leaf(x):
+                shape = tuple(getattr(x, "shape", ()))
+                if k in plan and shape == (plan[k][2], plan[k][3]):
+                    return zspec
+                if not shape:
+                    return rep
+                return ps.get(k, rep)
+            return leaf
+
+        opt_s = {}
+        for k, v in opt_state.items():
+            if k == self._ZERO_RES:
+                opt_s[k] = jax.tree_util.tree_map(lambda _x: zspec, v)
+            else:
+                opt_s[k] = jax.tree_util.tree_map(opt_shard(k), v)
         aux_s = {k: rep for k in aux}
         return ps, opt_s, aux_s
 
@@ -435,6 +577,75 @@ class TrainStep:
         normalize = self.normalize_grads
         want_stats = self.metric_stats
 
+        # -- ZeRO weight-update sharding (ISSUE 7 tentpole) ------------------
+        rules = self.param_rules if param_rules is None else param_rules
+        plan = self.zero_plan(params, rules)
+        mesh = self.mesh
+        zaxes = self._zero_axes()
+        zspec = NamedSharding(mesh, P(zaxes, None)) if plan else None
+        zrep = replicated(mesh) if plan else None
+        wire2bit = bool(plan) and self.zero_wire == "2bit"
+        zthresh = self.zero_threshold
+        zres_key = self._ZERO_RES
+
+        def zsplit(x, n, chunk, size):
+            flat = x.reshape(-1)
+            pad = n * chunk - size
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            return flat.reshape(n, chunk)
+
+        def apply_update(params_c, grads, opt_state_c, step_no):
+            """Optimizer update; with a zero plan, the explicit
+            reduce-scatter → 1/N-shard update → all-gather. The update
+            math is elementwise per key (sgd/momentum/adam/wd/lr_mult),
+            so running it on the padded flat view is bit-identical to
+            the replicated update on the original shape."""
+            if not plan:
+                return opt.apply(params_c, grads, opt_state_c, step_no)
+            wsc = jax.lax.with_sharding_constraint
+            res = opt_state_c.get(zres_key)
+            core = {k: v for k, v in opt_state_c.items() if k != zres_key}
+            vp, vg, new_res = {}, {}, {}
+            for k, w in params_c.items():
+                if k not in plan:
+                    vp[k] = w
+                    vg[k] = grads[k]
+                    continue
+                _shape, size, n, chunk = plan[k]
+                # THE reduce-scatter point: constraining the gradient's
+                # (shards, chunk) view to the data axes lets XLA emit a
+                # reduce-scatter — each device materializes only its
+                # 1/N shard of the gradient sum (arXiv:2004.13336)
+                g = wsc(zsplit(grads[k], n, chunk, size), zspec)
+                if wire2bit:
+                    # PR 4 two-bit quantizer on the reduce-scattered
+                    # wire: error-feedback residual is 1/N-sharded too
+                    from ..kvstore import two_bit_round_trip_core
+
+                    g, r = two_bit_round_trip_core(
+                        g.astype(jnp.float32), res[k], zthresh)
+                    new_res[k] = wsc(r, zspec)
+                    g = wsc(g, zspec)
+                vg[k] = g
+                # the replicated param's shard view is a local slice
+                vp[k] = wsc(zsplit(w, n, chunk, size), zspec)
+            new_p, new_s = opt.apply(vp, vg, core, step_no)
+            out_p = {}
+            for k, w in new_p.items():
+                if k not in plan:
+                    out_p[k] = w
+                    continue
+                shape, size, _n, _chunk = plan[k]
+                # THE all-gather point: the updated 1/N shards rebuild
+                # the replicated weights for the next forward
+                out_p[k] = wsc(w, zrep).reshape(-1)[:size].reshape(shape)
+            if wire2bit:
+                new_s = dict(new_s)
+                new_s[zres_key] = new_res
+            return out_p, new_s
+
         def metric_stats_of(loss, outs, batch):
             """Reducible per-batch metric statistics, computed on the
             sharded global arrays inside the program (cross-shard sums
@@ -473,7 +684,8 @@ class TrainStep:
                 # Module convention: rescale_grad = 1/global_batch (model.py)
                 bsz = batch[data_names[0]].shape[0]
                 grads = {k: g / bsz for k, g in grads.items()}
-            new_params, new_opt = opt.apply(params_c, grads, opt_state_c, step_no)
+            new_params, new_opt = apply_update(params_c, grads,
+                                               opt_state_c, step_no)
             new_aux = dict(aux_c)
             for k, v in aux_updates.items():
                 if k in new_aux:
@@ -486,10 +698,13 @@ class TrainStep:
                 return new_carry, (loss, tuple(outs))
             return new_carry, loss
 
-        mesh = self.mesh
         if mesh is None:
             return self._bind_fused_scope(jax.jit(step, donate_argnums=(0,)))
 
+        # in_shardings reflect the carry layout place() produces: make
+        # sure a logical-layout opt_state handed to a raw compile() call
+        # yields the same tree (idempotent for the placed carry)
+        opt_state = self._opt_state_to_zero(opt_state, plan)
         ps, opt_s, aux_s = self.shardings(params, opt_state, aux, param_rules)
         rep = replicated(mesh)
         batch_s = {
@@ -521,13 +736,22 @@ class TrainStep:
         return self._step_fn
 
     def place(self, params, opt_state, aux, param_rules=None):
-        """device_put the carry with its shardings (host → HBM once)."""
+        """device_put the carry with its shardings (host → HBM once).
+        With ``zero``, optimizer state is laid out as its padded
+        (num_shards, chunk) views first — accepts both the logical
+        layout (init/checkpoint restore: this is where a checkpoint
+        saved on a different mesh size re-splits) and an already-placed
+        zero-layout carry (idempotent)."""
         if param_rules is not None:
             self.param_rules = list(param_rules)
             self._step_fn = None
         step_no = jnp.zeros((), jnp.int32)
         if self.mesh is None:
-            return (params, opt_state, aux, step_no)
+            carry = (params, opt_state, aux, step_no)
+            self.record_memory_stats(carry)
+            return carry
+        opt_state = self._opt_state_to_zero(
+            opt_state, self.zero_plan(params, self.param_rules))
         ps, opt_s, aux_s = self.shardings(params, opt_state, aux, self.param_rules)
         params = {k: jax.device_put(v, ps[k]) for k, v in params.items()}
         opt_state = (
@@ -536,7 +760,75 @@ class TrainStep:
         )
         aux = {k: jax.device_put(v, aux_s[k]) for k, v in aux.items()}
         step_no = jax.device_put(step_no, replicated(self.mesh))
-        return (params, opt_state, aux, step_no)
+        carry = (params, opt_state, aux, step_no)
+        self.record_memory_stats(carry)
+        return carry
+
+    # -- memory observability (ISSUE 7) --------------------------------------
+    def memory_stats(self, carry):
+        """Measured per-device bytes of the resident carry plus analytic
+        per-step estimates. ``param/opt/aux_bytes_per_dev`` are MEASURED
+        (summed over this process's first mesh device's actual shards);
+        ``grad_bytes_per_dev_est`` is the gradient working set the
+        update consumes (1/N shards for zero-planned params) and
+        ``collective_bytes_per_step_est`` the per-device wire volume of
+        the gradient sync (ring all-reduce == reduce-scatter +
+        all-gather: 2·size·(N-1)/N either way — ZeRO changes memory,
+        not collective volume)."""
+        params, opt_state, aux, _step = carry
+        dev = None
+        if self.mesh is not None:
+            pidx = jax.process_index()
+            dev = next((d for d in self.mesh.devices.flat
+                        if d.process_index == pidx), None)
+
+        def per_dev(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards is None:
+                    total += int(getattr(leaf, "nbytes", 0))
+                    continue
+                d = dev if dev is not None else shards[0].device
+                total += sum(int(s.data.nbytes) for s in shards
+                             if s.device == d)
+            return total
+
+        plan = self.zero_plan(params, self.param_rules)
+        grad_est = 0
+        coll_est = 0
+        n_total = 1
+        if self.mesh is not None:
+            sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            for a in self.data_axes:
+                n_total *= sizes.get(a, 1)
+        for k, v in params.items():
+            nbytes = int(_np.prod(tuple(v.shape) or (1,))) * \
+                _np.dtype(v.dtype).itemsize
+            if k in plan:
+                _shape, _size, n, chunk = plan[k]
+                grad_est += chunk * _np.dtype(v.dtype).itemsize
+            else:
+                grad_est += nbytes
+            if n_total > 1:
+                coll_est += int(2 * nbytes * (n_total - 1) / n_total)
+        return {
+            "param_bytes_per_dev": per_dev(params),
+            "opt_bytes_per_dev": per_dev(opt_state),
+            "aux_bytes_per_dev": per_dev(aux),
+            "grad_bytes_per_dev_est": int(grad_est),
+            "collective_bytes_per_step_est": coll_est,
+            "zero": bool(plan),
+            "zero_params": len(plan),
+            "num_shards": n_total,
+        }
+
+    def record_memory_stats(self, carry):
+        """Publish :meth:`memory_stats` to the profiler gauge (rides
+        ``dump_profile`` as ``memoryStats``)."""
+        from .. import profiler
+
+        profiler.memory_record(**self.memory_stats(carry))
 
     def __call__(self, carry, batch, key=None):
         if key is None:

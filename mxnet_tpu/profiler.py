@@ -95,6 +95,9 @@ def dump_profile():
     serve = serving_stats()
     if serve:
         payload["servingStats"] = serve
+    mem = memory_stats()
+    if mem:
+        payload["memoryStats"] = mem
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -286,6 +289,39 @@ def serving_stats(reset=False):
 def serving_reset():
     with _SERVE_LOCK:
         _SERVE.clear()
+
+
+# ---------------------------------------------------------------------------
+# memory observability (ISSUE 7): a GAUGE (latest snapshot, not an
+# accumulator) of the training carry's per-device residency — measured
+# param/opt-state/aux bytes on this process's first mesh device plus the
+# analytic gradient/collective per-step estimates. Published by
+# TrainStep.place()/record_memory_stats; the ZeRO acceptance assert
+# (per-device opt bytes scale 1/N) reads exactly this surface.
+# ---------------------------------------------------------------------------
+_MEM_LOCK = threading.Lock()
+_MEM = {}
+
+
+def memory_record(**fields):
+    """Replace the memory gauge with the latest snapshot's fields."""
+    with _MEM_LOCK:
+        _MEM.clear()
+        _MEM.update(fields)
+
+
+def memory_stats(reset=False):
+    """Latest memory snapshot ({} when no carry was ever placed)."""
+    with _MEM_LOCK:
+        snap = dict(_MEM)
+        if reset:
+            _MEM.clear()
+    return snap
+
+
+def memory_reset():
+    with _MEM_LOCK:
+        _MEM.clear()
 
 
 def pause():

@@ -194,3 +194,30 @@ def test_split_weights_partitions_arg_and_aux(tmp_path):
     arg, aux = mgr.latest().split_weights()
     assert set(arg) == {"fc_w"} and set(aux) == {"bn_mean"}
     np.testing.assert_allclose(aux["bn_mean"], 7.0)
+
+
+def test_optimizer_state_shard_files_merge_on_read(tmp_path):
+    """ISSUE 7 sharded quiesce: each rank stages its own
+    optimizer-shard-<rank>.states file; the read side merges the
+    disjoint key maps into one blob, and the single-file layout keeps
+    precedence when both exist."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.begin(3)
+    from mxnet_tpu.checkpoint import atomic_write_bytes
+
+    atomic_write_bytes(mgr.staged_optimizer_shard_path(3, 0),
+                       pickle.dumps({"a": np.ones((2,), np.float32)}))
+    atomic_write_bytes(mgr.staged_optimizer_shard_path(3, 1),
+                       pickle.dumps({"b": np.zeros((3,), np.float32)}))
+    mgr.commit(3, weights={"arg:a": np.ones((2,))}, num_workers=2)
+    ck = mgr.latest()
+    assert ck.optimizer_states_path() is None
+    assert len(ck.optimizer_state_shard_paths()) == 2
+    merged = pickle.loads(ck.optimizer_states())
+    assert set(merged) == {"a", "b"}
+    np.testing.assert_array_equal(merged["a"], np.ones((2,)))
+    # a full optimizer.states file wins over shards when present
+    atomic_write_bytes(os.path.join(ck.path, "optimizer.states"),
+                       pickle.dumps({"c": 1}))
+    ck2 = mgr.latest()
+    assert set(pickle.loads(ck2.optimizer_states())) == {"c"}

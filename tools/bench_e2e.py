@@ -38,6 +38,11 @@ def main():
                    help="measured epochs over the packed dataset")
     p.add_argument("--fused", action="store_true",
                    help="use the Pallas fused-bottleneck graph")
+    p.add_argument("--zero", action="store_true",
+                   help="also measure the ZeRO weight-update-sharded "
+                        "step (ISSUE 7) and report its img/s and "
+                        "measured per-device optimizer-state bytes "
+                        "next to the replicated baseline")
     p.add_argument("--fit-loop", action="store_true",
                    help="also run Module.fit() behind the async input "
                         "pipeline (DeviceQueueIter + device metrics) and "
@@ -101,6 +106,41 @@ def main():
         carry, loss = ts(carry, syn, key)
     jax.block_until_ready(loss)
     synthetic_img_s = batch * n_syn / (time.perf_counter() - t0)
+    repl_mem = ts.memory_stats(carry)
+
+    # -- ZeRO variant (ISSUE 7): same graph, weight-update sharded -------
+    zero_rec = None
+    if args.zero:
+        ts_z = TrainStep(
+            sym, functional_optimizer("sgd", learning_rate=0.1,
+                                      momentum=0.9),
+            mesh=make_mesh({"dp": n_dev}), zero=True,
+            compute_dtype="bfloat16" if jax.default_backend() == "tpu"
+            else None,
+        )
+        p_z, s_z, a_z = ts_z.init_params(
+            {"data": (batch, 3, ds, ds), "softmax_label": (batch,)},
+            initializer=mx.initializer.Xavier())
+        carry_z = ts_z.place(p_z, s_z, a_z)
+        carry_z, loss_z = ts_z(carry_z, syn, key)   # compile
+        jax.block_until_ready(loss_z)
+        t0 = time.perf_counter()
+        for _ in range(n_syn):
+            carry_z, loss_z = ts_z(carry_z, syn, key)
+        jax.block_until_ready(loss_z)
+        zero_img_s = batch * n_syn / (time.perf_counter() - t0)
+        zero_mem = ts_z.memory_stats(carry_z)
+        zero_rec = {
+            "img_s": round(zero_img_s, 2),
+            "vs_replicated": round(zero_img_s / synthetic_img_s, 3),
+            "opt_bytes_per_dev": zero_mem["opt_bytes_per_dev"],
+            "repl_opt_bytes_per_dev": repl_mem["opt_bytes_per_dev"],
+            "opt_bytes_ratio": round(
+                zero_mem["opt_bytes_per_dev"]
+                / max(repl_mem["opt_bytes_per_dev"], 1), 4),
+            "num_shards": zero_mem["num_shards"],
+        }
+        del carry_z
 
     # -- decode-only ------------------------------------------------------
     it = make_iter()
@@ -175,6 +215,8 @@ def main():
         rec["fit_img_s"] = round(fit_img_s, 2)
         rec["fit_host_syncs"] = fit_pipe.get("host_syncs", 0)
         rec["fit_preplaced"] = fit_pipe.get("preplaced", 0)
+    if zero_rec is not None:
+        rec["zero"] = zero_rec
     # kvstore data-plane counters (raw vs wire bytes, RPC latency) ride
     # along when this process did distributed push/pull — the ISSUE 4
     # observability surface, empty on the single-chip path
