@@ -110,8 +110,10 @@ def main():
             # restore it from the checkpoint (arg weights arrive via
             # the server pull in init_optimizer)
             _arg, resume_aux = ck.split_weights()
-            print("worker %d resuming from checkpoint epoch %d (%s)"
-                  % (kv.rank, begin_epoch, ck.path), flush=True)
+            print("worker %d resuming from checkpoint epoch %d (%s) "
+                  "preempted=%s"
+                  % (kv.rank, begin_epoch, ck.path,
+                     bool(state and state.get("preempted"))), flush=True)
 
     data = mx.sym.var("data")
     net = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=64, name="fc1"), act_type="relu")

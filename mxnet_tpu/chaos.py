@@ -44,6 +44,23 @@ Rules:
     wedged-process simulation (sockets stay open, beats stop), which is
     exactly what the scheduler's heartbeat timeout exists to catch.
 
+``worker:R:nan@step=N`` (ISSUE 9 fault matrix)
+    The matching worker's N-th optimizer round runs with a poisoned
+    gradient: the per-executor tiers overwrite ONE gradient array with
+    NaN before the update/push, the fused tier poisons the step's data
+    batch so the whole compiled step's gradients go non-finite — the
+    *silent* fault the in-graph sentinel and the fit health guard
+    exist to catch. Fires once per incarnation (``restart`` gating as
+    for crash).
+
+``worker:R:preempt@step=N``  /  ``server:R:preempt@step=N``
+    The matching process sends SIGTERM to itself at its N-th step —
+    the scheduler-preemption simulation. With the preemption handler
+    installed (launch.py-spawned workers, mxnet_tpu/health.py) the
+    process drains, checkpoints inside ``MXNET_PREEMPT_GRACE`` and
+    exits with the resumable ``EXIT_PREEMPTED`` status; without it the
+    default SIGTERM disposition kills the process like a crash.
+
 A malformed spec raises :class:`FaultSpecError` at parse time — a chaos
 harness that silently no-ops would certify recovery paths that were
 never exercised.
@@ -52,12 +69,14 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import sys
 
 _EXIT_CODE = 137  # SIGKILL'd processes report 128+9; crash mimics that
 
 _TARGETS = ("worker", "server", "rpc", "heartbeat")
-_ACTIONS = {"worker": ("crash",), "server": ("crash",),
+_ACTIONS = {"worker": ("crash", "nan", "preempt"),
+            "server": ("crash", "preempt"),
             "rpc": ("drop",), "heartbeat": ("stall",)}
 
 
@@ -126,9 +145,10 @@ class _Rule:
 
     def _validate(self):
         p = self.params
-        if self.action == "crash" and "step" not in p:
+        if self.action in ("crash", "nan", "preempt") and "step" not in p:
             raise FaultSpecError(
-                "fault rule %r: crash requires step=N" % self.text)
+                "fault rule %r: %s requires step=N"
+                % (self.text, self.action))
         if self.action == "stall" and "after" not in p:
             raise FaultSpecError(
                 "fault rule %r: stall requires after=N" % self.text)
@@ -214,6 +234,7 @@ class ChaosEngine:
         self._step = 0
         self._beats = 0
         self._exit = os._exit  # injectable for tests
+        self._kill = lambda: os.kill(os.getpid(), signal.SIGTERM)  # ditto
 
     def _crash(self, rule):
         print("[chaos] injecting crash: rule %r fired at %s %d step %d "
@@ -223,18 +244,49 @@ class ChaosEngine:
         sys.stderr.flush()
         self._exit(_EXIT_CODE)
 
+    def _preempt(self, rule):
+        print("[chaos] injecting preemption (SIGTERM to self): rule %r "
+              "fired at %s %d step %d (restart %d)"
+              % (rule.text, self.role, self.rank, self._step,
+                 self.restart), file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        self._kill()
+
+    def _match_step_rule(self, rule, action, step):
+        return (rule.action == action and rule.target == self.role
+                and rule.rank == self.rank
+                and rule.restart_matches(self.restart)
+                and step == int(rule.params["step"])
+                and not rule.fired)
+
     def step(self):
         """One unit of progress (worker: optimizer round; server:
-        applied push). Fires crash rules scheduled for this step."""
+        applied push). Fires crash/preempt rules scheduled for this
+        step."""
         self._step += 1
         for rule in self.rules:
-            if (rule.action == "crash" and rule.target == self.role
-                    and rule.rank == self.rank
-                    and rule.restart_matches(self.restart)
-                    and self._step == int(rule.params["step"])
-                    and not rule.fired):
+            if self._match_step_rule(rule, "crash", self._step):
                 rule.fired += 1
                 self._crash(rule)
+            elif self._match_step_rule(rule, "preempt", self._step):
+                rule.fired += 1
+                self._preempt(rule)
+
+    def nan(self):
+        """True when the round ABOUT to run matches a nan rule. Callers
+        check before their ``tick_step()`` for the round (the gradient
+        must be poisoned before the update/push consumes it), so this
+        matches against ``step + 1``."""
+        nxt = self._step + 1
+        for rule in self.rules:
+            if self._match_step_rule(rule, "nan", nxt):
+                rule.fired += 1
+                print("[chaos] poisoning gradient with NaN: rule %r "
+                      "fired at %s %d step %d (restart %d)"
+                      % (rule.text, self.role, self.rank, nxt,
+                         self.restart), file=sys.stderr, flush=True)
+                return True
+        return False
 
     def rpc(self, op, phase="send", side="client"):
         """True when a matching rpc:drop rule fires for this op."""
@@ -296,6 +348,13 @@ def tick_step():
     e = engine()
     if e is not None:
         e.step()
+
+
+def nan_fault():
+    """True when the upcoming optimizer round should run with a
+    poisoned gradient (worker:R:nan@step=N). Call BEFORE tick_step()."""
+    e = engine()
+    return e is not None and e.nan()
 
 
 def rpc_fault(op, phase="send", side="client"):

@@ -148,6 +148,132 @@ KNOBS = {
         "servers (push scatters slices, pull gathers) so per-server "
         "memory scales 1/num_servers; must be set job-wide "
         "(kvstore_server.py); 0|1, anything else raises"),
+    # --- self-healing training (ISSUE 9) ---
+    "MXNET_TPU_SENTINEL": (
+        "off", "honored",
+        "in-graph anomaly sentinel for the fused step: per-step health "
+        "word (finite loss, global grad norm, updated params) computed "
+        "INSIDE the compiled program with device-resident counters — "
+        "no per-batch host sync. off|record|skip|halt: 'record' only "
+        "counts, 'skip' additionally turns an unhealthy step into a "
+        "no-op (pre-update params/opt-state selected via jnp.where — "
+        "bit-identical params), 'halt' checks the health word on host "
+        "EVERY step (a per-batch sync, counted in host_syncs) and "
+        "raises on the first unhealthy one (parallel/spmd.py)"),
+    "MXNET_TPU_GUARD": (
+        "1", "honored",
+        "arm the Module.fit self-healing guardrail when a coordinated "
+        "checkpoint directory (MXNET_CHECKPOINT_DIR) is configured: "
+        "consecutive-unhealthy / loss-spike detection triggers a "
+        "coordinated rollback to CheckpointManager.latest() with LR "
+        "backoff (health.py); 0|1, anything else raises"),
+    "MXNET_TPU_GUARD_CONSEC": (
+        "3", "honored",
+        "consecutive unhealthy steps (fused: the sentinel's device "
+        "consec counter; host tier: consecutive non-finite-output "
+        "batches) that trigger a rollback (health.py)"),
+    "MXNET_TPU_GUARD_SPIKE": (
+        "10.0", "honored",
+        "loss-spike rollback trigger: a checked loss above this ratio "
+        "of its running EMA rolls back; 0 disables spike detection "
+        "(health.py)"),
+    "MXNET_TPU_GUARD_BACKOFF": (
+        "0.5", "honored",
+        "learning-rate multiplier applied on every rollback (in (0, "
+        "1]); applied server-side on dist_async via the rollback RPC "
+        "and via a fused-step rebuild on kvstore='tpu' (health.py)"),
+    "MXNET_TPU_GUARD_BUDGET": (
+        "2", "honored",
+        "bounded rollback budget: after this many rollbacks the next "
+        "trigger fails the job loudly (elastic supervision resumes it "
+        "from the last checkpoint) instead of looping (health.py)"),
+    "MXNET_TPU_GUARD_INTERVAL": (
+        "10", "honored",
+        "fused-tier guard check cadence in batches: the sentinel "
+        "counters are drained (one blocking device read) every N "
+        "batches, amortized like the Speedometer (health.py)"),
+    "MXNET_PREEMPT_GRACE": (
+        "15", "honored",
+        "preemption grace window in seconds: on SIGTERM/SIGINT a "
+        "launch.py-spawned worker drains in-flight steps and writes a "
+        "resumable checkpoint, then exits with the distinguished "
+        "EXIT_PREEMPTED status; a hard-exit timer guarantees the "
+        "process is gone within the window either way (health.py)"),
+    # --- elastic recovery / fault injection (ISSUE 3, registered here
+    # per the ISSUE 9 knob-drift audit) ---
+    "MXNET_CHECKPOINT_DIR": (
+        "", "honored",
+        "coordinated checkpoint directory (CheckpointManager.from_env; "
+        "exported by tools/launch.py to every role)"),
+    "MXNET_CHECKPOINT_PERIOD": (
+        "1", "honored", "checkpoint every N epochs (checkpoint.py)"),
+    "MXNET_CHECKPOINT_RETAIN": (
+        "2", "honored", "newest complete checkpoints kept (checkpoint.py)"),
+    "MXNET_MAX_RESTARTS": (
+        "0", "honored",
+        "elastic respawn budget per node; > 0 switches the tracker and "
+        "server barriers into elastic mode (tracker.py, launch.py)"),
+    "MXNET_FAULT_SPEC": (
+        "", "honored",
+        "deterministic fault injection rules (chaos.py grammar: "
+        "crash/nan/preempt @step, rpc drop, heartbeat stall)"),
+    # --- kvstore data plane (ISSUE 4, registered per the drift audit) ---
+    "MXNET_KVSTORE_PIPELINE": (
+        "1", "honored",
+        "async per-shard sender pipeline for the server tier; 0 falls "
+        "back to the synchronous client (kvstore_server.py)"),
+    "MXNET_KVSTORE_RPC_RETRIES": (
+        "2", "honored",
+        "bounded kvstore RPC retries with reconnect + server "
+        "rediscovery (kvstore_server.py)"),
+    "MXNET_KVSTORE_RECONNECT_DEADLINE": (
+        "5", "honored", "seconds per reconnect attempt (kvstore_server.py)"),
+    "MXNET_KVSTORE_REDISCOVER_TIMEOUT": (
+        "30", "honored",
+        "seconds to wait for a respawned server's new URI via the "
+        "tracker (kvstore_server.py)"),
+    "MXNET_KVSTORE_COALESCE_KEYS": (
+        "16", "honored", "max keys per coalesced push_multi frame"),
+    "MXNET_KVSTORE_COALESCE_BYTES": (
+        str(1 << 20), "honored", "max bytes per coalesced push_multi frame"),
+    "MXNET_KVSTORE_BARRIER_TIMEOUT": (
+        "120", "honored",
+        "server barrier timeout in seconds — raises instead of "
+        "spinning (kvstore_server.py)"),
+    # --- tracker / process topology (ISSUE 2, registered per the
+    # drift audit; the per-role DMLC-style identity vars launch.py
+    # sets are allowlisted in tests/test_knob_registry.py instead) ---
+    "MXNET_TRACKER_HEARTBEAT_INTERVAL": (
+        "2.0", "honored", "client heartbeat period in seconds (tracker.py)"),
+    "MXNET_TRACKER_HEARTBEAT_TIMEOUT": (
+        "30.0", "honored",
+        "scheduler-side beat-loss dead-node threshold (tracker.py)"),
+    "MXNET_TRACKER_BARRIER_TIMEOUT": (
+        "120", "honored", "tracker barrier timeout in seconds (tracker.py)"),
+    "MXNET_PS_SERVER_URI": (
+        "", "honored",
+        "manual server URI list for deployments without the tracker "
+        "rendezvous (kvstore_server.py)"),
+    "MXNET_PS_BIND_HOST": (
+        "", "honored", "server bind host override (kvstore_server.py)"),
+    "MXNET_PS_BIND_PORT": (
+        "0", "honored", "server bind port override (kvstore_server.py)"),
+    "MXNET_PS_ADVERTISE_HOST": (
+        "", "honored",
+        "address a multi-host server publishes to the tracker "
+        "(kvstore_server.py)"),
+    # --- misc registered per the drift audit ---
+    "MXNET_TPU_FUSED_ROW_TILE": (
+        "", "honored",
+        "fused Pallas kernel row-tile override (kernels/fused_block.py)"),
+    "MXNET_GLUON_REPO": (
+        "", "honored",
+        "gluon model-zoo repo URL or local directory "
+        "(gluon/model_zoo/model_store.py)"),
+    "MXNET_INFER_DEBUG": (
+        "0", "honored",
+        "full tracebacks from shape/type inference failures "
+        "(executor.py)"),
     # --- serving tier (ISSUE 6) ---
     "MXNET_SERVE_BATCH_LADDER": (
         "1,4,16,64", "honored",
@@ -230,6 +356,32 @@ def get_nonneg_int(name):
         v = -1
     if v < 0:
         raise MXNetError("%s=%r must be an integer >= 0" % (name, raw))
+    return v
+
+
+def get_positive_int(name):
+    from .base import MXNetError
+
+    raw = get(name)
+    try:
+        v = int(str(raw).strip())
+    except (TypeError, ValueError):
+        v = 0
+    if v < 1:
+        raise MXNetError("%s=%r must be an integer >= 1" % (name, raw))
+    return v
+
+
+def get_nonneg_float(name):
+    from .base import MXNetError
+
+    raw = get(name)
+    try:
+        v = float(str(raw).strip())
+    except (TypeError, ValueError):
+        v = float("nan")
+    if not 0.0 <= v < float("inf"):  # also rejects NaN
+        raise MXNetError("%s=%r must be a finite float >= 0" % (name, raw))
     return v
 
 

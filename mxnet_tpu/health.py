@@ -1,0 +1,459 @@
+"""Self-healing training: the reaction half of ISSUE 9.
+
+The DETECTION half is the in-graph anomaly sentinel
+(``parallel/spmd.py`` ``TrainStep(sentinel=...)``: per-step health word
++ device-resident counters, zero steady-state host syncs). This module
+owns what happens when training is actually sick:
+
+- :class:`HealthGuard` — the ``Module.fit`` guardrail. At a bounded
+  cadence it inspects health (fused tier: drains the sentinel's device
+  counters; per-executor/dist_async tier: finite-check on the batch
+  outputs plus a batch cross-entropy), and on N consecutive unhealthy
+  steps or a loss spike rolls the job back to
+  ``CheckpointManager.latest()`` with a learning-rate backoff and a
+  bounded rollback budget. On the dist_async parameter-server tier all
+  ranks agree through NAMED barrier rounds (the PR 3 machinery — a
+  respawn replaying an old phase can never pair with a live rollback)
+  and every server restores exactly its shard through the same
+  ``restore_from_checkpoint`` path elastic recovery uses (the ZeRO
+  value-sharded layout included: with sharded optimizer state any
+  rollback that bypasses the checkpoint layer is wrong by
+  construction, arXiv:2004.13336).
+
+- preemption-aware exit: ``launch.py``-spawned workers install a
+  SIGTERM/SIGINT handler; the fit loop drains the dispatch-ahead
+  in-flight steps at the next batch boundary, writes a resumable
+  checkpoint inside the ``MXNET_PREEMPT_GRACE`` window, and exits with
+  the distinguished :data:`EXIT_PREEMPTED` status that ``launch.py
+  --max-restarts`` supervision respawns WITHOUT burning the restart
+  budget. A hard-exit timer guarantees the process is gone within the
+  grace window even if the checkpoint hangs.
+
+Reference counterpart: none — the reference's answer to silent faults
+is ``Monitor`` (host-side per-op stats, one device sync per batch,
+python/mxnet/monitor.py) and its answer to preemption is "lose the
+epoch". Counters ride ``dump_profile`` as ``healthStats``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from . import config, profiler
+from .base import MXNetError
+
+#: EX_TEMPFAIL — the resumable exit status a preempted worker reports
+#: after its grace-window checkpoint; launch.py treats it as a FREE
+#: respawn (the restart budget guards against crash loops, and a
+#: preempted node did nothing wrong). Mirrored as a literal in
+#: tools/launch.py, which stays stdlib-only.
+EXIT_PREEMPTED = 75
+
+
+class HealthGuard:
+    """Detection→reaction→resumption guardrail for one ``fit()`` run.
+
+    Constructed automatically by ``BaseModule.fit`` via
+    :meth:`from_env` when the job has a coordinated checkpoint
+    directory (``MXNET_CHECKPOINT_DIR``) and ``MXNET_TPU_GUARD=1``
+    (the default); tests construct it directly with an explicit
+    manager. All thresholds come from strict ``config.KNOBS``
+    accessors — a malformed knob raises at arm time, never trains with
+    a silently-substituted default.
+    """
+
+    def __init__(self, module, kv=None, manager=None, logger=None,
+                 consec=None, spike=None, backoff=None, budget=None,
+                 interval=None, grace=None):
+        self.module = module
+        self.kv = kv
+        self.manager = manager
+        self.logger = logger or logging.getLogger(__name__)
+        self.consec = config.get_positive_int("MXNET_TPU_GUARD_CONSEC") \
+            if consec is None else int(consec)
+        self.spike = config.get_nonneg_float("MXNET_TPU_GUARD_SPIKE") \
+            if spike is None else float(spike)
+        self.backoff = config.get_positive_float("MXNET_TPU_GUARD_BACKOFF") \
+            if backoff is None else float(backoff)
+        if not 0.0 < self.backoff <= 1.0:
+            raise MXNetError(
+                "MXNET_TPU_GUARD_BACKOFF=%r must be in (0, 1] — a "
+                "rollback that RAISES the learning rate re-diverges"
+                % (self.backoff,))
+        self.budget = config.get_nonneg_int("MXNET_TPU_GUARD_BUDGET") \
+            if budget is None else int(budget)
+        self.interval = config.get_positive_int("MXNET_TPU_GUARD_INTERVAL") \
+            if interval is None else int(interval)
+        self.grace = config.get_positive_float("MXNET_PREEMPT_GRACE") \
+            if grace is None else float(grace)
+        self.rollbacks = 0
+        self._consec_host = 0
+        self._ema = None
+        self._warm = 0
+        self._metric = None
+        self._preempt = threading.Event()
+        self._preempt_t = None
+        self._handler_installed = False
+        # Spike detection must only TRIGGER where every rank reaches
+        # the same verdict, or the coordinated-rollback barrier never
+        # pairs (one rank parks in health-rb-K-enter while its peers
+        # keep training). The fused tier's sentinel word is replicated
+        # by construction; the host tier's per-batch CE is rank-LOCAL,
+        # so on a multi-worker server job a single rank's transient
+        # spike would strand the barrier until its timeout kills the
+        # job. Non-finite detection stays on everywhere: a poisoned
+        # server weight poisons every rank's pulls, so that verdict IS
+        # globally correlated (the bounded barrier timeout backstops
+        # pathological skew).
+        self._spike_coordinated = not (
+            kv is not None and getattr(kv, "server_side", False)
+            and int(getattr(kv, "num_workers", 1) or 1) > 1)
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_env(cls, module, kv=None, logger=None):
+        """The armed guard for this job, or None: requires a
+        coordinated checkpoint directory (rollback without a committed
+        checkpoint to roll back TO is meaningless) and the
+        MXNET_TPU_GUARD knob (default on)."""
+        from .checkpoint import CheckpointManager
+
+        if not config.get_strict_bool("MXNET_TPU_GUARD"):
+            return None
+        manager = CheckpointManager.from_env()
+        if manager is None:
+            return None
+        return cls(module, kv=kv, manager=manager, logger=logger)
+
+    # -- preemption-aware exit -----------------------------------------------
+    def install_preemption_handler(self):
+        """SIGTERM/SIGINT → resumable drain-checkpoint-exit, installed
+        for launch.py-spawned workers (DMLC_ROLE=worker) from the main
+        thread only; idempotent. Interactive/pytest processes (no DMLC
+        role) keep the default signal disposition."""
+        if self._handler_installed:
+            return
+        if os.environ.get("DMLC_ROLE", "").lower() != "worker":
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        try:
+            signal.signal(signal.SIGTERM, self._on_signal)
+            signal.signal(signal.SIGINT, self._on_signal)
+        except ValueError:
+            return  # embedded interpreter quirk: not installable
+        self._handler_installed = True
+
+    def _on_signal(self, signum, frame):
+        if not self._preempt.is_set():
+            self._preempt_t = time.monotonic()
+            self._preempt.set()
+            # the scheduler WILL kill us at the end of the grace window;
+            # exiting resumable beats being SIGKILLed mid-checkpoint
+            t = threading.Timer(self.grace, self._hard_exit)
+            t.daemon = True
+            t.start()
+            os.write(2, (b"[health] preemption signal received: draining"
+                         b" + checkpointing inside the grace window\n"))
+
+    @staticmethod
+    def _hard_exit():
+        os.write(2, b"[health] preemption grace expired; exiting "
+                    b"resumable without a fresh checkpoint\n")
+        os._exit(EXIT_PREEMPTED)
+
+    @property
+    def preempt_requested(self):
+        return self._preempt.is_set()
+
+    def request_preemption(self):
+        """Flag a preemption as if SIGTERM arrived (tests; also lets an
+        external agent trigger the graceful path in-process). Does NOT
+        arm the hard-exit timer — the caller owns the deadline."""
+        self._preempt_t = time.monotonic()
+        self._preempt.set()
+
+    # -- the per-batch hook (called by BaseModule.fit) -----------------------
+    def on_batch(self, epoch, nbatch, eval_metric=None, labels=None):
+        """Batch-boundary hook: handles a pending preemption (raises
+        ``SystemExit(EXIT_PREEMPTED)``), then runs the health check at
+        its cadence and rolls back when training is sick."""
+        self._metric = eval_metric
+        if self._preempt.is_set():
+            self._preempt_exit(epoch, nbatch)
+        fused = getattr(self.module, "_fused", None)
+        if fused is not None:
+            if getattr(fused, "sentinel", "off") == "off":
+                return  # no in-graph word; checking would mean a
+                # per-batch device sync — exactly what the fused tier
+                # exists to avoid (arm MXNET_TPU_SENTINEL)
+            if (nbatch + 1) % self.interval:
+                return
+            self._check_sentinel(fused.health_stats())
+        else:
+            self._check_host(labels)
+
+    # -- detection -----------------------------------------------------------
+    def _check_sentinel(self, snap):
+        if not snap:
+            return
+        if snap["consec"] >= self.consec:
+            self.rollback("sentinel: %d consecutive unhealthy steps "
+                          "(nonfinite loss=%d grad=%d param=%d)"
+                          % (snap["consec"], snap["nonfinite_loss"],
+                             snap["nonfinite_grad"],
+                             snap["nonfinite_param"]))
+        elif snap["last_healthy"] and self._spiked(snap["last_loss"]):
+            self.rollback("loss spike: %.4g > %gx EMA %.4g"
+                          % (snap["last_loss"], self.spike, self._ema))
+
+    def _check_host(self, labels):
+        """Per-executor tiers (dist_async server-side optimizer, local):
+        outputs are already host-materialized at batch rate by the host
+        metric path, so a finite-check adds no new sync semantics."""
+        mod = self.module
+        try:
+            out = mod.get_outputs()[0].asnumpy()
+        except Exception:
+            return
+        if not np.isfinite(out).all():
+            self._consec_host += 1
+            profiler.health_record(host_unhealthy=1)
+            if self._consec_host >= self.consec:
+                self.rollback("host check: %d consecutive batches with "
+                              "non-finite outputs" % self._consec_host)
+            return
+        self._consec_host = 0
+        if not self._spike_coordinated:
+            return  # rank-local CE must not strand the rollback barrier
+        loss = self._batch_ce(out, labels)
+        if loss is not None and self._spiked(loss):
+            self.rollback("loss spike: %.4g > %gx EMA %.4g"
+                          % (loss, self.spike, self._ema))
+
+    @staticmethod
+    def _batch_ce(out, labels):
+        """Mean cross-entropy of one batch from host prob outputs, or
+        None when the shapes don't look like (probs, int labels)."""
+        if not labels:
+            return None
+        lbl = labels[0]
+        lbl = lbl.asnumpy() if hasattr(lbl, "asnumpy") else np.asarray(lbl)
+        lbl = lbl.reshape(-1)
+        if out.ndim != 2 or out.shape[0] != lbl.shape[0]:
+            return None
+        idx = lbl.astype(np.int64)
+        if idx.size == 0 or idx.min() < 0 or idx.max() >= out.shape[1]:
+            return None
+        picked = out[np.arange(idx.size), idx]
+        return float(-np.mean(np.log(picked + 1e-12)))
+
+    _SPIKE_WARMUP = 5  # checks before the EMA is trusted
+
+    def _spiked(self, loss):
+        if self.spike <= 0 or not math.isfinite(loss):
+            return False
+        if self._ema is None:
+            self._ema = loss
+            self._warm = 1
+            return False
+        spiked = (self._warm >= self._SPIKE_WARMUP
+                  and loss > self.spike * max(self._ema, 1e-8))
+        if not spiked:
+            self._ema = 0.9 * self._ema + 0.1 * loss
+            self._warm += 1
+        return spiked
+
+    # -- reaction: coordinated rollback --------------------------------------
+    def rollback(self, reason):
+        """Roll the job back to the newest committed checkpoint with LR
+        backoff. Budget-bounded: past MXNET_TPU_GUARD_BUDGET the next
+        trigger fails the job loudly instead of looping — the elastic
+        supervision (launch.py --max-restarts) then resumes it from the
+        same checkpoint with a fresh process."""
+        self.rollbacks += 1
+        profiler.health_record(rollbacks=1)
+        if self.rollbacks > self.budget:
+            raise MXNetError(
+                "health guard: %s, but the rollback budget (%d) is "
+                "exhausted — failing the job (elastic supervision "
+                "resumes from the last checkpoint)" % (reason, self.budget))
+        ck = self.manager.latest() if self.manager is not None else None
+        if ck is None:
+            raise MXNetError(
+                "health guard: %s, and no committed checkpoint exists "
+                "to roll back to (%s)"
+                % (reason, getattr(self.manager, "directory", None)))
+        self.logger.warning(
+            "[health] %s: rolling back to %s (epoch %d), lr backoff x%g "
+            "(rollback %d/%d)", reason, ck.path, ck.epoch, self.backoff,
+            self.rollbacks, self.budget)
+        print("[health] event=rollback reason=%r ckpt=%s epoch=%d "
+              "count=%d" % (reason, ck.path, ck.epoch, self.rollbacks),
+              flush=True)
+        if self.kv is not None and getattr(self.kv, "server_side", False):
+            self._rollback_server(ck)
+        else:
+            self._rollback_local(ck)
+        if self._metric is not None:
+            self._metric.reset()  # drop the poisoned accumulations
+        self._consec_host = 0
+        self._ema = None
+        self._warm = 0
+
+    def _backoff_lr(self, opt):
+        """Scale the imperative optimizer's lr; scheduler-driven lr
+        cannot be backed off (set_learning_rate raises) — warn, don't
+        abort the rollback that is saving the job."""
+        if opt is None:
+            return
+        try:
+            opt.set_learning_rate(opt.lr * self.backoff)
+        except MXNetError as e:
+            self.logger.warning("[health] lr backoff skipped: %s", e)
+
+    def _rollback_local(self, ck):
+        """kvstore='tpu' fused tier and local tiers: weights + aux +
+        optimizer state restored module-side from the checkpoint; on
+        the fused tier the LR backoff rebuilds the compiled step
+        (reset_optimizer) so the new rate is baked into the program."""
+        from .ndarray import ndarray as nd
+
+        mod = self.module
+        arg_ck, aux_ck = ck.split_weights()
+        if not arg_ck:
+            raise MXNetError("health guard: checkpoint %s holds no "
+                             "weights to roll back to" % ck.path)
+        mod.set_params({k: nd.array(v) for k, v in arg_ck.items()},
+                       {k: nd.array(v) for k, v in aux_ck.items()},
+                       allow_missing=False, force_init=True,
+                       allow_extra=True)
+        states = ck.optimizer_states_path()
+        if states is None:
+            shards = ck.optimizer_state_shard_paths()
+            states = shards[0] if len(shards) == 1 else None
+        if states is not None and getattr(mod, "optimizer_initialized",
+                                          False):
+            try:
+                mod.load_optimizer_states(states)
+            except MXNetError as e:
+                # a checkpoint from another tier's format: weights are
+                # restored either way; state restarts cold
+                self.logger.warning(
+                    "[health] optimizer state not restored (%s); "
+                    "momentum restarts from zero", e)
+        opt = getattr(mod, "_optimizer", None)
+        self._backoff_lr(opt)
+        fused = getattr(mod, "_fused", None)
+        if fused is not None and opt is not None:
+            fused.reset_optimizer(opt)
+
+    def _rollback_server(self, ck):
+        """dist_async tier: all ranks agree via named barrier rounds
+        (PR 3 machinery) — the window between the two barriers is
+        quiesced exactly like the elastic checkpoint's commit phase (no
+        rank has a push in flight: barrier() drains the async
+        pipeline) — then every server reloads ITS shard from its own
+        checkpoint directory via the elastic-recovery restore path, and
+        every worker refreshes its executors from the restored weights
+        BEFORE the next forward (a forward on poisoned weights would
+        push poisoned gradients right back)."""
+        kv, mod = self.kv, self.module
+        k = self.rollbacks
+        kv.barrier("health-rb-%d-enter" % k)
+        # EVERY rank drops its 2-bit error-feedback residuals inside
+        # the quiesced window: a NaN-contaminated residual would
+        # quantize that rank's future pushes to all-zero codes forever
+        if hasattr(kv, "reset_gradient_residuals"):
+            kv.reset_gradient_residuals()
+        if kv.rank == 0:
+            info = kv.rollback_servers(lr_scale=self.backoff, gen=k)
+            self.logger.warning(
+                "[health] servers restored %s keys from checkpoint "
+                "epoch %s (lr now %s)", info.get("keys"),
+                info.get("epoch"), info.get("lr"))
+        kv.barrier("health-rb-%d-restored" % k)
+        _arg_ck, aux_ck = ck.split_weights()
+        from .ndarray import ndarray as nd
+
+        for name, v in aux_ck.items():
+            if name in mod._aux_params:
+                nd.array(v).copyto(mod._aux_params[name])
+        names = sorted(mod._arg_params)
+        if names:
+            kv.pull(names, [mod._arg_params[n] for n in names], priority=0)
+        mod._exec_group.set_params(mod._arg_params, mod._aux_params)
+        mod._params_dirty = False
+        # local mirror of the server-side backoff (logs/inspection)
+        self._backoff_lr(getattr(mod, "_optimizer", None))
+
+    # -- reaction: preemption -------------------------------------------------
+    def _preempt_exit(self, epoch, nbatch):
+        """Drain → checkpoint → exit resumable. Runs at a batch
+        boundary (the signal handler only sets a flag: the quiesce
+        choreography cannot run in signal context mid-step)."""
+        profiler.health_record(preemptions=1)
+        mod = self.module
+        fused = getattr(mod, "_fused", None)
+        if fused is not None:
+            try:
+                fused.drain()  # retire the dispatch-ahead pipeline
+            except Exception:
+                pass
+        wrote = False
+        if self.manager is not None:
+            try:
+                wrote = self._write_preemption_checkpoint(epoch, nbatch)
+            except Exception as e:
+                self.logger.warning(
+                    "[health] preemption checkpoint failed (%s); exiting "
+                    "resumable against the previous checkpoint", e)
+        elapsed = 0.0 if self._preempt_t is None \
+            else time.monotonic() - self._preempt_t
+        print("[health] event=preempted epoch=%d nbatch=%d "
+              "checkpoint=%s elapsed=%.1fs exit=%d"
+              % (epoch, nbatch, wrote, elapsed, EXIT_PREEMPTED),
+              flush=True)
+        raise SystemExit(EXIT_PREEMPTED)
+
+    def _write_preemption_checkpoint(self, epoch, nbatch):
+        """One worker's solo resumable snapshot, committed under the
+        epoch it was preempted IN (semantics: 'resume at epoch E', the
+        same contract as the coordinated epoch-end checkpoints — a
+        re-commit of the same epoch replaces it). Deliberately
+        barrier-free: a single preempted worker cannot run the 3-phase
+        choreography (its peers are still training and would never
+        arrive), and on the dist_async tier a snapshot without a global
+        quiesce has exactly the ordering skew the asynchronous tier
+        already accepts. Weights come through ``get_params`` — the
+        batched server pull on dist_async, the drained device fetch on
+        the fused tier."""
+        mgr, mod, kv = self.manager, self.module, self.kv
+        rank = int(getattr(kv, "rank", 0) or 0) if kv is not None else 0
+        epoch = int(epoch)
+        mgr.begin(epoch)
+        mgr.write_worker_state(epoch, rank, {
+            "epoch": epoch, "nbatch": int(nbatch), "preempted": True,
+            "numpy_rng": np.random.get_state()})
+        arg, aux = mod.get_params()
+        weights = {"arg:%s" % k: v.asnumpy() for k, v in arg.items()}
+        weights.update({"aux:%s" % k: v.asnumpy() for k, v in aux.items()})
+        opt_config = None
+        if kv is not None and getattr(kv, "server_side", False):
+            kv.save_optimizer_states(
+                mgr.staged_optimizer_states_path(epoch))
+            opt_config = kv.get_optimizer_config()
+        elif getattr(mod, "optimizer_initialized", False):
+            mod.save_optimizer_states(
+                mgr.staged_optimizer_states_path(epoch))
+        num_workers = getattr(kv, "num_workers", 1) if kv is not None else 1
+        mgr.commit(epoch, weights=weights, optimizer_config=opt_config,
+                   num_workers=num_workers)
+        return True

@@ -256,6 +256,7 @@ class KVStoreServer:
         self._seen = {}
         self._seen_lock = threading.Lock()
         self._pushes_applied = 0
+        self._rollback_gen = -1  # newest applied rollback generation
         if elastic is None:
             elastic = env_nonneg_int("MXNET_MAX_RESTARTS", 0) > 0
         #: elastic mode: a worker dying mid-barrier retracts its own
@@ -375,8 +376,20 @@ class KVStoreServer:
                 # init_optimizer sends the config (module.py:349 has no
                 # rank gate), and replacing the updater would wipe the
                 # accumulated momentum/Adam state mid-training. A
-                # *different* config is a real job misconfiguration.
-                if self._opt_config != (name, kwargs, extras):
+                # *different* config is a real job misconfiguration —
+                # EXCEPT the learning rate, the one hyperparameter that
+                # is legitimately dynamic (the ISSUE 9 health guard
+                # backs it off on rollback): a late-joining or
+                # respawned worker re-sending the ORIGINAL lr must not
+                # abort the job, and the server's current (possibly
+                # backed-off) lr wins.
+                def _sans_lr(cfg):
+                    n, kw, ex = cfg
+                    return (n, {k: v for k, v in kw.items()
+                                if k != "learning_rate"}, ex)
+
+                if _sans_lr(self._opt_config) != _sans_lr(
+                        (name, kwargs, extras)):
                     raise ValueError(
                         "conflicting server optimizer: have %r, got %r"
                         % (self._opt_config, (name, kwargs, extras)))
@@ -553,6 +566,8 @@ class KVStoreServer:
         if op == "barrier":
             self._barrier(conn, name=str(key or ""))
             return None
+        if op == "rollback":
+            return self._rollback(meta)
         if op == "save_opt":
             with self._lock:
                 if self._updater is None:
@@ -684,6 +699,78 @@ class KVStoreServer:
             with self._lock:
                 self._updater.set_states_from_map(mine)
         return restored
+
+    def _rollback(self, meta):
+        """Coordinated health-guard rollback (ISSUE 9): reload THIS
+        server's shard (weights + optimizer state) from the newest
+        committed checkpoint and scale the server-side optimizer's
+        learning rate (``meta["lr_scale"]``). The checkpoint directory
+        comes from the server's OWN ``MXNET_CHECKPOINT_DIR`` — the RPC
+        deliberately carries no path, so wire input can never choose
+        which local file gets unpickled (checkpoint files stay LOCAL
+        trusted artifacts). The restore itself is exactly the elastic
+        respawn path (:meth:`restore_from_checkpoint`), run in place;
+        HealthGuard only issues it inside a quiesced barrier window.
+
+        Idempotence (what makes the op retry-safe): the restore is
+        naturally idempotent, and the lr backoff — which is NOT — is
+        deduped by ``meta["gen"]``, the guard's rollback count: a
+        lost-reply retry carries the same generation and the scale is
+        applied at most once per generation (the push-seqno pattern)."""
+        meta = meta or {}
+        ckpt_dir = os.environ.get("MXNET_CHECKPOINT_DIR")
+        if not ckpt_dir:
+            raise ValueError(
+                "rollback: this server has no MXNET_CHECKPOINT_DIR — "
+                "nothing committed to roll back to")
+        from .checkpoint import CheckpointManager
+
+        ck = CheckpointManager(ckpt_dir).latest()
+        if ck is None:
+            raise ValueError("rollback: no committed checkpoint under %s"
+                             % ckpt_dir)
+        shard_rank = env_nonneg_int("DMLC_SERVER_ID", 0)
+        num_shards = max(env_nonneg_int("DMLC_NUM_SERVER", 1), 1)
+        nkeys = self.restore_from_checkpoint(ck, shard_rank=shard_rank,
+                                             num_shards=num_shards)
+        scale = meta.get("lr_scale")
+        gen = meta.get("gen")
+        new_lr = None
+        if scale is not None:
+            scale = float(scale)
+            if not 0.0 < scale <= 1.0:
+                raise ValueError("rollback: lr_scale=%r must be in "
+                                 "(0, 1]" % (scale,))
+            with self._lock:
+                if gen is not None:
+                    gen = int(gen)
+                    if gen <= self._rollback_gen:
+                        # a retried (or replayed) generation: the
+                        # backoff already landed — re-applying would
+                        # square it
+                        scale = None
+                    else:
+                        self._rollback_gen = gen
+                if scale is not None and self._updater is not None:
+                    opt = self._updater.optimizer
+                    try:
+                        opt.set_learning_rate(opt.lr * scale)
+                        new_lr = opt.lr
+                    except MXNetError as e:  # scheduler-driven lr
+                        print("[lifecycle] rollback lr backoff skipped: "
+                              "%s" % e, flush=True)
+                    if new_lr is not None and self._opt_config is not None:
+                        # keep the recorded config truthful: later
+                        # checkpoints + respawned servers rebuild with
+                        # the backed-off rate
+                        n, kw, ex = self._opt_config
+                        kw = dict(kw)
+                        kw["learning_rate"] = new_lr
+                        self._opt_config = (n, kw, ex)
+        print("[lifecycle] event=rollback role=server rank=%d ckpt=%s "
+              "keys=%d epoch=%d lr=%s"
+              % (shard_rank, ck.path, nkeys, ck.epoch, new_lr), flush=True)
+        return {"keys": int(nkeys), "epoch": int(ck.epoch), "lr": new_lr}
 
     def shutdown(self):
         self._stop.set()
@@ -866,7 +953,11 @@ class ServerKVStore(kvstore.KVStore):
     #: re-sent barrier arrival could double-count this worker.
     _RETRY_SAFE = frozenset((
         "init", "push", "push_multi", "pull", "pull_multi", "num_workers",
-        "save_opt", "load_opt", "set_optimizer", "opt_config"))
+        "save_opt", "load_opt", "set_optimizer", "opt_config",
+        # rollback is generation-deduped server-side (meta["gen"]), so a
+        # lost-reply retry restores again (idempotent) without
+        # re-applying the lr backoff
+        "rollback"))
 
     def __init__(self, uri, kv_type="dist_async", tracker_client=None,
                  pipeline=None):
@@ -1632,6 +1723,40 @@ class ServerKVStore(kvstore.KVStore):
         self.wait_outstanding()
         bt = env_positive_float("MXNET_KVSTORE_BARRIER_TIMEOUT", 120)
         self._rpc_all("barrier", key=name or None, timeout=bt + 30.0)
+
+    def reset_gradient_residuals(self):
+        """Drop this client's 2-bit error-feedback residuals. EVERY
+        rank must call this across a rollback (HealthGuard does,
+        inside the quiesced window): the accumulated error refers to
+        pre-rollback weights, and a NaN-contaminated residual would
+        otherwise quantize that rank's pushes to all-zero codes
+        forever."""
+        self.wait_outstanding()
+        self._residuals = {}
+
+    def rollback_servers(self, lr_scale=None, gen=None):
+        """Tell EVERY server to reload its shard (weights + optimizer
+        state) from the newest committed checkpoint in its own
+        ``MXNET_CHECKPOINT_DIR`` and back off the server-side learning
+        rate — the coordinated-rollback RPC of the ISSUE 9 health
+        guard. Call only inside a quiesced barrier window (HealthGuard
+        does); rank 0 issues it for the job, and every rank separately
+        calls :meth:`reset_gradient_residuals`. ``gen`` (the guard's
+        rollback count) makes the lr backoff retry-safe: the server
+        applies it at most once per generation."""
+        self.reset_gradient_residuals()
+        meta = {}
+        if lr_scale is not None:
+            meta["lr_scale"] = float(lr_scale)
+        if gen is not None:
+            meta["gen"] = int(gen)
+        infos = [i for i in self._rpc_all("rollback", meta=meta) if i]
+        if not infos:
+            raise MXNetError("rollback_servers: no server reported a "
+                             "restore")
+        return {"keys": sum(int(i.get("keys", 0)) for i in infos),
+                "epoch": infos[0].get("epoch"),
+                "lr": infos[0].get("lr")}
 
     def stop_server(self):
         self.wait_outstanding()

@@ -64,7 +64,9 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
     exactly those futures and fetches every weight in per-shard
     multi-key frames instead of a round trip per key."""
     # a worker "step" for deterministic fault injection = one optimizer
-    # round (MXNET_FAULT_SPEC worker:R:crash@step=N, mxnet_tpu/chaos.py)
+    # round (MXNET_FAULT_SPEC worker:R:crash@step=N, mxnet_tpu/chaos.py);
+    # nan_fault is consulted FIRST (it targets the round about to run)
+    poison = chaos.nan_fault()
     chaos.tick_step()
     live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
@@ -72,6 +74,12 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
         if grad_list[0] is None:
             continue
         name = param_names[index]
+        if poison:
+            # ISSUE 9 fault matrix: poison exactly ONE gradient — the
+            # server-side optimizer then spreads the NaN into the
+            # weight, the silent fault the fit health guard rolls back
+            grad_list[0][:] = float("nan")
+            poison = False
         kvstore.push(name, grad_list, priority=-index)
         live.append((index, name, arg_list))
     if live:
@@ -80,12 +88,16 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
+    poison = chaos.nan_fault()
     chaos.tick_step()  # same step definition as the kvstore path above
     live = []
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
+        if poison:
+            grad_list[0][:] = float("nan")  # ISSUE 9: poison ONE grad
+            poison = False
         if kvstore:
             kvstore.push(param_names[i], grad_list, priority=-i)
         live.append((i, arg_list, grad_list))

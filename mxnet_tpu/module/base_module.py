@@ -155,6 +155,20 @@ class BaseModule:
         )
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
 
+        # self-healing guardrail (ISSUE 9): armed when the job has a
+        # coordinated checkpoint directory (MXNET_CHECKPOINT_DIR —
+        # launch.py exports it) and MXNET_TPU_GUARD=1 (default). It
+        # watches health at a bounded cadence, rolls back to the last
+        # committed checkpoint with LR backoff on sustained anomalies,
+        # and turns a SIGTERM into a grace-window checkpoint + a
+        # resumable exit the supervision respawns for free (health.py).
+        from ..health import HealthGuard
+
+        health_guard = HealthGuard.from_env(
+            self, kv=getattr(self, "_kvstore", None), logger=self.logger)
+        if health_guard is not None:
+            health_guard.install_preemption_handler()
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -179,6 +193,13 @@ class BaseModule:
                 except StopIteration:
                     end_of_batch = True
                 self.update_metric(eval_metric, data_batch.label)
+                if health_guard is not None:
+                    # batch-boundary health/preemption hook: may roll
+                    # the module back to the latest checkpoint, or
+                    # raise SystemExit(EXIT_PREEMPTED) after a
+                    # grace-window checkpoint
+                    health_guard.on_batch(epoch, nbatch, eval_metric,
+                                          data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

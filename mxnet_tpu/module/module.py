@@ -347,6 +347,10 @@ class Module(BaseModule):
                     "per-executor update path", kvstore.type, e)
                 self._fused = None
 
+        if self._fused is not None and getattr(self, "_monitor_installed",
+                                               False):
+            self._warn_monitor_on_fused()
+
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
@@ -510,7 +514,24 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor_installed = True
+        if self._fused is not None:
+            self._warn_monitor_on_fused()
         self._exec_group.install_monitor(mon)
+
+    def _warn_monitor_on_fused(self):
+        # loud, not fatal: the job still trains — but the monitor's
+        # callbacks never fire inside the fused program AND its
+        # tic/toc host syncs defeat the stall-free loop; the in-graph
+        # sentinel is the fused-tier tool (see monitor.py docstring)
+        self.logger.warning(
+            "Monitor is installed but this Module trains through the "
+            "fused SPMD step (kvstore='tpu' tier): per-op monitor "
+            "callbacks never run inside the compiled program, and "
+            "Monitor's per-batch host syncs would defeat the "
+            "stall-free fit loop anyway. Use the in-graph sentinel "
+            "(MXNET_TPU_SENTINEL=record|skip|halt) and profiler "
+            "healthStats instead.")
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         assert self.binded

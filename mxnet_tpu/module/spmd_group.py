@@ -237,6 +237,14 @@ class FusedSPMDGroup:
         are outstanding (dispatch-ahead, ISSUE 5)."""
         import jax
 
+        from .. import chaos
+
+        # ISSUE 9 fault matrix: worker:R:nan@step=N poisons this step's
+        # data batch — on the fused tier the gradient lives only inside
+        # the compiled program, so the injection point is its input;
+        # every gradient of the step goes non-finite, which is exactly
+        # the class of silent fault the in-graph sentinel detects
+        poison = chaos.nan_fault()
         arrays = list(zip(self._data_names, data_batch.data))
         labels = getattr(data_batch, "label", None) or []
         arrays += list(zip(self._label_names, labels))
@@ -244,6 +252,8 @@ class FusedSPMDGroup:
         host_rows = []
         for name, arr in arrays:
             value = arr._data() if isinstance(arr, nd.NDArray) else arr
+            if poison and name == self._data_names[0]:
+                value = value * np.float32("nan")
             if not is_preplaced(value, self._batch_sharding):
                 host_rows.append(value.shape[0])
             values.append((name, value))
@@ -537,3 +547,52 @@ class FusedSPMDGroup:
                 "type it was saved under")
         self._replace(opt_state=data["opt_state"], step=data["step"])
         self._step_no = data["step"]
+
+    # -- self-healing (ISSUE 9) ----------------------------------------------
+    @property
+    def sentinel(self):
+        return self._ts.sentinel
+
+    def health_stats(self):
+        """Drain the in-graph sentinel's device counters (None when the
+        sentinel is off). ONE blocking read of replicated scalars —
+        the HealthGuard amortizes it over MXNET_TPU_GUARD_INTERVAL
+        batches; the counters themselves accumulate inside the compiled
+        step, so the steady-state loop stays sync-free. Publishes the
+        snapshot to the profiler healthStats gauge."""
+        snap = self._ts.health_stats(self._carry)
+        if snap is not None:
+            profiler.health_sentinel(snap)
+        return snap
+
+    def reset_optimizer(self, optimizer):
+        """Rebuild the compiled step around the (re-tuned) imperative
+        optimizer — the HealthGuard LR-backoff path. Params/aux stay
+        device-resident; optimizer state round-trips through the
+        logical layout into a fresh TrainStep (a recompile: rollback
+        is exceptional, correctness beats a warm jit cache). Sentinel
+        counters restart from zero — a rollback must not instantly
+        re-trigger on the pre-rollback consec count."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.spmd import replicated
+
+        self.drain()
+        params, opt_state, aux, step_no = self._carry
+        host_opt = self._fetch_host(opt_state)
+        logical = self._ts.logical_opt_state(host_opt, params)
+        self._fopt = functional_from_optimizer(
+            optimizer, list(self.param_names))
+        self._ts = TrainStep(
+            self._ts.symbol, self._fopt, mesh=self.mesh,
+            data_axes=self._data_axes,
+            data_names=tuple(self._data_names),
+            label_names=tuple(self._label_names),
+            compute_dtype=None, normalize_grads=False, return_outputs=True,
+            metric_stats=self._device_metrics, zero=self.zero,
+        )
+        carry = self._ts.place(params, logical, aux)
+        step = jax.device_put(
+            jnp.asarray(int(self._fetch_host(step_no)), jnp.int32),
+            replicated(self.mesh))
+        self._carry = (carry[0], carry[1], carry[2], step)

@@ -2,6 +2,22 @@
 
 Reference counterpart: ``python/mxnet/monitor.py`` (143 LoC) using the
 executor monitor callback (MXExecutorSetMonitorCallback).
+
+.. warning:: Monitor is a HOST-side inspector: every ``tic``/``toc``
+   waits on every executor array — one blocking device sync per
+   monitored batch, which is exactly the per-batch host cost the fused
+   ``kvstore='tpu'`` tier (PR 5) eliminated. Worse, on a fused-group
+   Module the per-executor callbacks never run at all (the whole step
+   is one compiled program), so an installed Monitor silently reports
+   nothing. For the fused tier use the IN-GRAPH anomaly sentinel
+   instead: ``MXNET_TPU_SENTINEL=record|skip|halt`` computes the
+   health word (finite loss / global grad norm / updated params)
+   inside the compiled step with device-resident counters (zero
+   steady-state host syncs) and publishes them through
+   ``profiler.health_stats()`` / the ``healthStats`` key of
+   ``dump_profile`` — see the README "Self-healing training" section.
+   ``Module.init_optimizer`` warns loudly when a Monitor is installed
+   on a Module whose kvstore engaged the fused group.
 """
 from __future__ import annotations
 

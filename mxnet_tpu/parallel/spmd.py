@@ -282,6 +282,24 @@ class TrainStep:
     local tier). The residual is transient — it resets on
     checkpoint restore, matching the server tier's residuals.
 
+    ``sentinel`` (default: the ``MXNET_TPU_SENTINEL`` knob) arms the
+    IN-GRAPH anomaly sentinel (ISSUE 9): every step computes a health
+    word INSIDE the compiled program — finite loss, finite global
+    gradient norm (the grads here are already the mesh-global psum'd
+    sums, so the word is identical on every device/host by
+    construction), and all-finite updated params — and folds it into
+    device-resident counters riding the carry's opt_state under a
+    reserved key (the PR 5 device-accumulator pattern: zero per-batch
+    host syncs in ``record``/``skip``). ``skip`` additionally turns an
+    unhealthy step into a no-op: the pre-update params, optimizer
+    state and aux are selected back via ``jnp.where`` (bit-identical
+    params, step counter not advanced) and the skip is counted.
+    ``halt`` reads the health word on host after EVERY step (the one
+    per-batch-sync mode, counted in ``host_syncs``) and raises on the
+    first unhealthy step. The counters are transient like the 2-bit
+    wire residual: dropped from checkpoints, fresh zeros on restore.
+    Drain them with :meth:`health_stats`.
+
     ``metric_stats=True`` (requires ``return_outputs=True``) additionally
     returns a dict of replicated per-batch metric statistics computed
     INSIDE the compiled program — ``n`` (rows), ``sum_loss`` (loss·n),
@@ -298,7 +316,7 @@ class TrainStep:
                  data_names=("data",), compute_dtype=None, loss_fn=None,
                  zero=None, remat=False, normalize_grads=True,
                  return_outputs=False, metric_stats=False, zero_wire=None,
-                 zero_min_size=None):
+                 zero_min_size=None, sentinel=None):
         from .. import config
         from ..executor import _graph_closure
 
@@ -322,6 +340,15 @@ class TrainStep:
         if zero_min_size is None:
             zero_min_size = config.get_nonneg_int("MXNET_TPU_ZERO_MIN_SIZE")
         self.zero_min_size = int(zero_min_size)
+        # ISSUE 9: in-graph anomaly sentinel — explicit arg wins, else
+        # the strictly-validated knob (nonsense raises at construction)
+        if sentinel is None:
+            sentinel = config.get_choice("MXNET_TPU_SENTINEL",
+                                         ("off", "record", "skip", "halt"))
+        elif sentinel not in ("off", "record", "skip", "halt"):
+            raise MXNetError("TrainStep: sentinel=%r must be "
+                             "off|record|skip|halt" % (sentinel,))
+        self.sentinel = sentinel
         self.optimizer = (
             optimizer if isinstance(optimizer, FunctionalOptimizer)
             else functional_optimizer(**optimizer) if isinstance(optimizer, dict)
@@ -436,6 +463,32 @@ class TrainStep:
         return plan
 
     _ZERO_RES = "__zero_wire_residual__"
+    _SENT = "__sentinel_state__"
+
+    @staticmethod
+    def _sentinel_init():
+        """Fresh device-resident sentinel counters (replicated int32/
+        float32 scalars riding opt_state under the reserved key)."""
+        z = _np.int32(0)
+        return {"healthy": z, "unhealthy": z, "skipped": z, "consec": z,
+                "nonfinite_loss": z, "nonfinite_grad": z,
+                "nonfinite_param": z, "last_healthy": _np.int32(1),
+                "last_loss": _np.float32(0.0)}
+
+    def _ensure_sentinel(self, opt_state):
+        """Reconcile the reserved sentinel-counter key with the mode:
+        created when armed and missing (idempotent — live counters on
+        a re-placed carry survive), dropped when off."""
+        if self.sentinel == "off":
+            if self._SENT in opt_state:
+                opt_state = {k: v for k, v in opt_state.items()
+                             if k != self._SENT}
+            return opt_state
+        if self._SENT in opt_state:
+            return opt_state
+        out = dict(opt_state)
+        out[self._SENT] = self._sentinel_init()
+        return out
 
     @staticmethod
     def _zsplit_np(x, n, chunk):
@@ -492,7 +545,7 @@ class TrainStep:
         plan = self.zero_plan(params, param_rules)
         out = {}
         for k, v in opt_state.items():
-            if k == self._ZERO_RES:
+            if k in (self._ZERO_RES, self._SENT):
                 continue
             if k not in plan:
                 out[k] = v
@@ -671,6 +724,30 @@ class TrainStep:
                     stats["sum_ce"] = -jnp.sum(jnp.log(picked + 1e-12))
             return stats
 
+        sentinel = self.sentinel
+        sent_key = self._SENT
+
+        def health_word(loss, grads, new_params):
+            """(healthy, finite_loss, finite_grad, params_ok) — all
+            replicated scalars. The grads are the mesh-global psum'd
+            sums and params are replicated, so every device (and every
+            host in a multi-process mesh) computes the identical word;
+            no extra collective is needed beyond the psum the gradients
+            already paid for."""
+            finite_loss = jnp.isfinite(loss.astype(jnp.float32))
+            gsq = jnp.float32(0.0)
+            for g in grads.values():
+                gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+            finite_grad = jnp.isfinite(gsq)
+            params_ok = jnp.bool_(True)
+            for v in new_params.values():
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    params_ok = jnp.logical_and(
+                        params_ok, jnp.all(jnp.isfinite(v)))
+            healthy = jnp.logical_and(
+                jnp.logical_and(finite_loss, finite_grad), params_ok)
+            return healthy, finite_loss, finite_grad, params_ok
+
         def step(carry, batch, key):
             params_c, opt_state_c, aux_c, step_no = carry
             if cdtype is not None:
@@ -684,13 +761,52 @@ class TrainStep:
                 # Module convention: rescale_grad = 1/global_batch (model.py)
                 bsz = batch[data_names[0]].shape[0]
                 grads = {k: g / bsz for k, g in grads.items()}
+            sent = opt_state_c.get(sent_key) if sentinel != "off" else None
+            core_opt = opt_state_c if sent is None else \
+                {k: v for k, v in opt_state_c.items() if k != sent_key}
             new_params, new_opt = apply_update(params_c, grads,
-                                               opt_state_c, step_no)
+                                               core_opt, step_no)
             new_aux = dict(aux_c)
             for k, v in aux_updates.items():
                 if k in new_aux:
                     new_aux[k] = v.astype(new_aux[k].dtype)
-            new_carry = (new_params, new_opt, new_aux, step_no + 1)
+            next_step = step_no + 1
+            if sent is not None:
+                healthy, f_loss, f_grad, p_ok = health_word(
+                    loss, grads, new_params)
+                h = healthy.astype(jnp.int32)
+                skipped_inc = jnp.int32(0)
+                if sentinel == "skip":
+                    # unhealthy step becomes a NO-OP: pre-update
+                    # params/opt-state/aux selected back (bit-identical
+                    # params), the step counter does not advance, and
+                    # the skip is counted
+                    pick = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                        lambda n, o: jnp.where(healthy, n, o), new, old)
+                    new_params = pick(new_params, params_c)
+                    new_opt = pick(new_opt, core_opt)
+                    new_aux = pick(new_aux, aux_c)
+                    next_step = step_no + h
+                    skipped_inc = 1 - h
+                one = jnp.int32(1)
+                new_opt = dict(new_opt)
+                new_opt[sent_key] = {
+                    "healthy": sent["healthy"] + h,
+                    "unhealthy": sent["unhealthy"] + (one - h),
+                    "skipped": sent["skipped"] + skipped_inc,
+                    # consecutive-unhealthy run length: resets on a
+                    # healthy step (the guard's rollback trigger)
+                    "consec": (sent["consec"] + (one - h)) * (one - h),
+                    "nonfinite_loss": sent["nonfinite_loss"]
+                    + (one - f_loss.astype(jnp.int32)),
+                    "nonfinite_grad": sent["nonfinite_grad"]
+                    + (one - f_grad.astype(jnp.int32)),
+                    "nonfinite_param": sent["nonfinite_param"]
+                    + (one - p_ok.astype(jnp.int32)),
+                    "last_healthy": h,
+                    "last_loss": loss.astype(jnp.float32),
+                }
+            new_carry = (new_params, new_opt, new_aux, next_step)
             if self.return_outputs:
                 if want_stats:
                     return new_carry, (loss, tuple(outs),
@@ -705,6 +821,7 @@ class TrainStep:
         # sure a logical-layout opt_state handed to a raw compile() call
         # yields the same tree (idempotent for the placed carry)
         opt_state = self._opt_state_to_zero(opt_state, plan)
+        opt_state = self._ensure_sentinel(opt_state)
         ps, opt_s, aux_s = self.shardings(params, opt_state, aux, param_rules)
         rep = replicated(mesh)
         batch_s = {
@@ -747,11 +864,12 @@ class TrainStep:
             self._step_fn = None
         step_no = jnp.zeros((), jnp.int32)
         if self.mesh is None:
-            carry = (params, opt_state, aux, step_no)
+            carry = (params, self._ensure_sentinel(opt_state), aux, step_no)
             self.record_memory_stats(carry)
             return carry
         opt_state = self._opt_state_to_zero(
             opt_state, self.zero_plan(params, self.param_rules))
+        opt_state = self._ensure_sentinel(opt_state)
         ps, opt_s, aux_s = self.shardings(params, opt_state, aux, self.param_rules)
         params = {k: jax.device_put(v, ps[k]) for k, v in params.items()}
         opt_state = (
@@ -830,13 +948,54 @@ class TrainStep:
 
         profiler.memory_record(**self.memory_stats(carry))
 
+    # -- sentinel (ISSUE 9) --------------------------------------------------
+    def health_stats(self, carry):
+        """Drain the sentinel's device counters from a carry: one
+        blocking device read of the replicated scalars (legal on every
+        tier — fully-replicated arrays read their local shard). None
+        when the sentinel is off."""
+        sent = carry[1].get(self._SENT)
+        if sent is None:
+            return None
+
+        def fetch(x):
+            if getattr(x, "is_fully_addressable", True):
+                return jax.device_get(x)
+            return _np.asarray(x.addressable_data(0))
+
+        vals = {k: fetch(v) for k, v in sent.items()}
+        return {k: (float(v) if k == "last_loss" else int(v))
+                for k, v in vals.items()}
+
+    def _halt_check(self, new_carry):
+        """halt mode: read the health word after every step (the one
+        per-batch host sync, recorded honestly) and raise on the first
+        unhealthy step."""
+        from .. import profiler
+
+        profiler.h2d_record(host_syncs=1)
+        snap = self.health_stats(new_carry)
+        if snap and not snap["last_healthy"]:
+            profiler.health_sentinel(snap)
+            raise MXNetError(
+                "sentinel halt: unhealthy training step detected "
+                "(nonfinite_loss=%d nonfinite_grad=%d nonfinite_param=%d "
+                "unhealthy=%d of %d steps, last_loss=%r)"
+                % (snap["nonfinite_loss"], snap["nonfinite_grad"],
+                   snap["nonfinite_param"], snap["unhealthy"],
+                   snap["healthy"] + snap["unhealthy"],
+                   snap["last_loss"]))
+
     def __call__(self, carry, batch, key=None):
         if key is None:
             from .. import random as _rnd
 
             key = _rnd.next_key()
         fn = self.compile(*carry[:3])
-        return fn(carry, batch, key)
+        result = fn(carry, batch, key)
+        if self.sentinel == "halt":
+            self._halt_check(result[0])
+        return result
 
     def _bind_fused_scope(self, fn):
         """Bind the trace-time SPMD scope for Pallas-fused ops to the

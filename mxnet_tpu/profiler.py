@@ -98,6 +98,9 @@ def dump_profile():
     mem = memory_stats()
     if mem:
         payload["memoryStats"] = mem
+    health = health_stats()
+    if health:
+        payload["healthStats"] = health
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -235,8 +238,10 @@ _SERVE_LAT_CAP = 8192  # newest-N latency reservoir per model
 
 
 def serving_record(model, requests=0, batches=0, rows=0, capacity=0,
-                   errors=0, queue_depth=None, latencies=None):
-    """Accumulate serving counters for one model (thread-safe)."""
+                   errors=0, shed=0, queue_depth=None, latencies=None):
+    """Accumulate serving counters for one model (thread-safe).
+    ``shed`` counts deadline-expired requests dropped at dequeue
+    (ISSUE 9 overload shedding) — they never occupy a batch slot."""
     with _SERVE_LOCK:
         s = _SERVE.get(model)
         if s is None:
@@ -244,13 +249,14 @@ def serving_record(model, requests=0, batches=0, rows=0, capacity=0,
 
             s = _SERVE[model] = {
                 "requests": 0, "batches": 0, "rows": 0, "capacity": 0,
-                "errors": 0, "max_queue_depth": 0,
+                "errors": 0, "shed": 0, "max_queue_depth": 0,
                 "lat": deque(maxlen=_SERVE_LAT_CAP)}
         s["requests"] += requests
         s["batches"] += batches
         s["rows"] += rows
         s["capacity"] += capacity
         s["errors"] += errors
+        s["shed"] += shed
         if queue_depth is not None and queue_depth > s["max_queue_depth"]:
             s["max_queue_depth"] = queue_depth
         if latencies:
@@ -322,6 +328,55 @@ def memory_stats(reset=False):
 def memory_reset():
     with _MEM_LOCK:
         _MEM.clear()
+
+
+# ---------------------------------------------------------------------------
+# self-healing observability (ISSUE 9): reaction-side EVENT counters
+# (rollbacks, preemptions, host-tier unhealthy checks — accumulated by
+# health_record) plus the latest drained snapshot of the in-graph
+# sentinel's device counters (a GAUGE like memoryStats: the counters
+# themselves accumulate on device inside the compiled step, so the
+# newest drain IS the cumulative truth). Rides dump_profile as
+# healthStats.
+# ---------------------------------------------------------------------------
+_HEALTH_LOCK = threading.Lock()
+_HEALTH_EVENTS = {}
+_HEALTH_SENTINEL = {}
+
+
+def health_record(**adds):
+    """Accumulate integer reaction-side counters (rollbacks=1, ...)."""
+    with _HEALTH_LOCK:
+        for k, v in adds.items():
+            _HEALTH_EVENTS[k] = _HEALTH_EVENTS.get(k, 0) + int(v)
+
+
+def health_sentinel(snapshot):
+    """Replace the sentinel gauge with the newest drained device
+    counters (TrainStep/FusedSPMDGroup health_stats)."""
+    with _HEALTH_LOCK:
+        _HEALTH_SENTINEL.clear()
+        _HEALTH_SENTINEL.update(snapshot or {})
+
+
+def health_stats(reset=False):
+    """{event counters..., "sentinel": latest device snapshot}; empty
+    dict when neither side ever recorded."""
+    with _HEALTH_LOCK:
+        snap = dict(_HEALTH_EVENTS)
+        sent = dict(_HEALTH_SENTINEL)
+        if reset:
+            _HEALTH_EVENTS.clear()
+            _HEALTH_SENTINEL.clear()
+    if sent:
+        snap["sentinel"] = sent
+    return snap
+
+
+def health_reset():
+    with _HEALTH_LOCK:
+        _HEALTH_EVENTS.clear()
+        _HEALTH_SENTINEL.clear()
 
 
 def pause():

@@ -18,4 +18,4 @@ from .predictor import (  # noqa: F401
     env_batch_ladder,
     validate_ladder,
 )
-from .broker import ModelServer  # noqa: F401
+from .broker import DeadlineExceeded, ModelServer  # noqa: F401

@@ -45,14 +45,22 @@ from .predictor import (
 )
 
 
-class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_submit")
+class DeadlineExceeded(ServingError):
+    """A request's deadline expired before it was dispatched: it was
+    SHED at dequeue (ISSUE 9 overload shedding) instead of occupying a
+    batch slot its client had already given up on. Its future fails
+    fast with this error."""
 
-    def __init__(self, inputs, rows):
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t_submit", "deadline")
+
+    def __init__(self, inputs, rows, deadline=None):
         self.inputs = inputs
         self.rows = rows
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = deadline  # absolute time.monotonic(), or None
 
 
 class _ModelWorker:
@@ -104,15 +112,25 @@ class _ModelWorker:
     # -- worker side ---------------------------------------------------------
     def _drain_locked(self):
         """Pop the largest ready batch: requests in FIFO order while the
-        running row total still fits the biggest bucket."""
+        running row total still fits the biggest bucket. Requests whose
+        deadline already expired are SHED here — at dequeue, before
+        they can occupy a batch slot (their clients have given up; an
+        overloaded server must spend its forwards on requests that are
+        still wanted). Returns (reqs, rows, shed); reqs may be empty
+        when everything queued had expired."""
         cap = self.predictor.max_bucket
-        reqs = [self._q.popleft()]
-        total = reqs[0].rows
-        while self._q and total + self._q[0].rows <= cap:
-            r = self._q.popleft()
-            reqs.append(r)
+        now = time.monotonic()
+        shed, reqs, total = [], [], 0
+        while self._q:
+            r = self._q[0]
+            if r.deadline is not None and now > r.deadline:
+                shed.append(self._q.popleft())
+                continue
+            if reqs and total + r.rows > cap:
+                break
+            reqs.append(self._q.popleft())
             total += r.rows
-        return reqs, total
+        return reqs, total, shed
 
     def _run(self):
         try:
@@ -122,9 +140,23 @@ class _ModelWorker:
                         self._cond.wait()
                     if self._stopped:
                         return
-                    reqs, rows = self._drain_locked()
-                    self._busy = True
+                    reqs, rows, shed = self._drain_locked()
+                    if reqs:
+                        self._busy = True
                     self._cond.notify_all()  # queue space freed
+                if shed:
+                    # futures fail OUTSIDE the lock: done-callbacks run
+                    # inline on set_exception and must not deadlock a
+                    # client that re-submits from one
+                    exc = DeadlineExceeded(
+                        "model %r: deadline expired before dispatch "
+                        "(shed at dequeue)" % self.name)
+                    for r in shed:
+                        if not r.future.done():
+                            r.future.set_exception(exc)
+                    profiler.serving_record(self.name, shed=len(shed))
+                if not reqs:
+                    continue
                 try:
                     self._execute(reqs, rows)
                 except BaseException as e:  # bad batch — fail ITS futures,
@@ -288,17 +320,28 @@ class ModelServer:
             raise ServingError("ModelServer is closed")
 
     # -- request surface -----------------------------------------------------
-    def submit(self, name, inputs, timeout=None):
+    def submit(self, name, inputs, timeout=None, deadline=None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the list of output arrays (request row count).
         Blocks for queue space up to ``timeout`` (backpressure), then
-        raises :class:`ServingError`."""
+        raises :class:`ServingError`. ``deadline`` (seconds from now,
+        > 0) marks the request sheddable: if it is still queued when
+        the deadline passes, the worker drops it at dequeue and its
+        future fails fast with :class:`DeadlineExceeded` instead of
+        occupying a batch slot — overload protection for clients that
+        time out anyway (counted as ``shed`` in serving_stats)."""
         self._check_open()
         worker = self._worker(name)
         pred = worker.predictor
         inputs, rows = pred._normalize(inputs)
         pred.pick_bucket(rows)  # reject oversized requests in the caller
-        req = _Request(inputs, rows)
+        if deadline is not None:
+            deadline = float(deadline)
+            if not deadline > 0:
+                raise ServingError("submit: deadline must be > 0 "
+                                   "seconds, got %r" % deadline)
+            deadline = time.monotonic() + deadline
+        req = _Request(inputs, rows, deadline=deadline)
         depth = worker.enqueue(
             req, self._submit_timeout if timeout is None else timeout)
         profiler.serving_record(name, requests=1, queue_depth=depth)

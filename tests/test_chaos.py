@@ -38,10 +38,23 @@ def test_parses_the_issue_spec_verbatim():
     "rpc:drop@op=push,phase=reply,side=server",  # phase is client-only
     "heartbeat:stall@p=0.5",        # stall without after
     "rpc:drop@op",                  # k without =v
+    # ISSUE 9 fault matrix
+    "worker:0:nan@restart=1",       # nan without step
+    "worker:0:preempt@",            # preempt without step
+    "server:0:nan@step=1",          # nan is worker-only (one grad)
+    "rpc:nan@step=1",               # nan is not an rpc action
 ])
 def test_malformed_specs_raise(bad):
     with pytest.raises(FaultSpecError):
         parse_spec(bad)
+
+
+def test_parses_the_issue9_fault_matrix():
+    rules = parse_spec("worker:0:nan@step=5;worker:1:preempt@step=7;"
+                       "server:0:preempt@step=9")
+    assert [(r.target, r.rank, r.action) for r in rules] == [
+        ("worker", 0, "nan"), ("worker", 1, "preempt"),
+        ("server", 0, "preempt")]
 
 
 def test_crash_fires_at_exact_step_once():

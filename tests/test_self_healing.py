@@ -1,0 +1,435 @@
+"""Self-healing training (ISSUE 9): in-graph sentinel, rollback guard,
+preemption-aware exit, server rollback RPC.
+
+Default-tier units — subprocess-free, tiny MLPs, CPU devices. The
+launch.py end-to-end runs (nan heal, preemption resume) live in
+test_dist_async.py as slow-tier tests.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, nd, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.health import EXIT_PREEMPTED, HealthGuard
+from mxnet_tpu.parallel.spmd import TrainStep, functional_optimizer
+
+RNG = np.random.RandomState(0)
+DIM, CLASSES, BATCH = 16, 10, 32
+
+
+@pytest.fixture(autouse=True)
+def _reset_health():
+    profiler.health_reset()
+    chaos.reset_engine()
+    yield
+    profiler.health_reset()
+    chaos.reset_engine()
+
+
+def _sym():
+    data = mx.sym.var("data")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=CLASSES, name="fc"),
+        name="softmax")
+
+
+def _batch(poison=False):
+    x = RNG.randn(BATCH, DIM).astype(np.float32)
+    if poison:
+        x = x * np.float32("nan")
+    y = RNG.randint(0, CLASSES, (BATCH,)).astype(np.float32)
+    return {"data": x, "softmax_label": y}
+
+
+def _train_step(sentinel, **kw):
+    return TrainStep(_sym(),
+                     functional_optimizer("sgd", learning_rate=0.1,
+                                          momentum=0.9),
+                     sentinel=sentinel, **kw)
+
+
+def _init(ts):
+    params, opt, aux = ts.init_params(
+        {"data": (BATCH, DIM), "softmax_label": (BATCH,)})
+    return ts.place(params, opt, aux)
+
+
+# ---------------------------------------------------------------------------
+# in-graph sentinel (tentpole layer 1)
+# ---------------------------------------------------------------------------
+def test_sentinel_knob_validation(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SENTINEL", "sometimes")
+    with pytest.raises(MXNetError, match="MXNET_TPU_SENTINEL"):
+        _train_step(None)
+    with pytest.raises(MXNetError, match="off|record|skip|halt"):
+        _train_step("bogus")
+
+
+def test_sentinel_off_keeps_opt_state_clean():
+    ts = _train_step("off")
+    carry = _init(ts)
+    assert TrainStep._SENT not in carry[1]
+    assert ts.health_stats(carry) is None
+
+
+def test_sentinel_record_counts_without_protecting():
+    import jax
+
+    ts = _train_step("record")
+    carry = _init(ts)
+    carry, _ = ts(carry, _batch())
+    snap = ts.health_stats(carry)
+    assert snap["healthy"] == 1 and snap["unhealthy"] == 0
+    assert snap["last_healthy"] == 1 and np.isfinite(snap["last_loss"])
+    carry, _ = ts(carry, _batch(poison=True))
+    snap = ts.health_stats(carry)
+    assert (snap["unhealthy"], snap["consec"], snap["skipped"]) == (1, 1, 0)
+    assert snap["nonfinite_loss"] == snap["nonfinite_grad"] == 1
+    # record mode does NOT protect: the poisoned update landed
+    params = jax.device_get(carry[0])
+    assert not all(np.isfinite(v).all() for v in params.values())
+
+
+def test_sentinel_skip_is_a_bit_identical_noop():
+    import jax
+
+    ts = _train_step("skip")
+    carry = _init(ts)
+    carry, _ = ts(carry, _batch())
+    before = jax.device_get((carry[0], {k: v for k, v in carry[1].items()
+                                        if k != TrainStep._SENT}))
+    step_before = int(jax.device_get(carry[3]))
+    carry, _ = ts(carry, _batch(poison=True))
+    after = jax.device_get((carry[0], {k: v for k, v in carry[1].items()
+                                       if k != TrainStep._SENT}))
+    flat_b = jax.tree_util.tree_leaves(before)
+    flat_a = jax.tree_util.tree_leaves(after)
+    assert all(np.array_equal(a, b) for a, b in zip(flat_a, flat_b))
+    # the skipped step does not advance the optimizer's step counter
+    assert int(jax.device_get(carry[3])) == step_before
+    snap = ts.health_stats(carry)
+    assert snap["skipped"] == 1 and snap["consec"] == 1
+    # healthy step afterwards: consec resets, training moves again
+    carry, _ = ts(carry, _batch())
+    snap = ts.health_stats(carry)
+    assert snap["consec"] == 0 and snap["healthy"] == 2
+    params = jax.device_get(carry[0])
+    assert all(np.isfinite(v).all() for v in params.values())
+
+
+def test_sentinel_halt_raises_on_first_unhealthy_step():
+    ts = _train_step("halt")
+    carry = _init(ts)
+    carry, _ = ts(carry, _batch())
+    with pytest.raises(MXNetError, match="sentinel halt"):
+        ts(carry, _batch(poison=True))
+
+
+def test_sentinel_counters_are_transient_in_logical_state():
+    import jax
+
+    ts = _train_step("skip")
+    carry = _init(ts)
+    carry, _ = ts(carry, _batch(poison=True))
+    host = jax.device_get(carry[1])
+    logical = ts.logical_opt_state(host, carry[0])
+    assert TrainStep._SENT not in logical
+    # re-placing the logical state starts the counters fresh
+    carry2 = ts.place(jax.device_get(carry[0]), logical,
+                      jax.device_get(carry[2]))
+    snap = ts.health_stats(carry2)
+    assert snap["unhealthy"] == 0 and snap["consec"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos fault matrix (tentpole layer 4) — engine-level semantics; the
+# grammar units live in test_chaos.py
+# ---------------------------------------------------------------------------
+def test_chaos_nan_fires_once_for_the_upcoming_round():
+    eng = chaos.ChaosEngine("worker:0:nan@step=3", role="worker", rank=0,
+                            restart=0)
+    fired = []
+    for _round in range(5):
+        fired.append(eng.nan())   # callers poison BEFORE tick_step()
+        eng.step()
+    assert fired == [False, False, True, False, False]
+
+
+def test_chaos_preempt_sigterms_self_at_step():
+    eng = chaos.ChaosEngine("worker:0:preempt@step=2", role="worker",
+                            rank=0, restart=0)
+    kills = []
+    eng._kill = lambda: kills.append(True)
+    eng.step()
+    assert not kills
+    eng.step()
+    assert kills == [True]
+    eng.step()   # fires once
+    assert kills == [True]
+
+
+def test_chaos_preempt_restart_gated():
+    eng = chaos.ChaosEngine("worker:0:preempt@step=1", role="worker",
+                            rank=0, restart=1)
+    kills = []
+    eng._kill = lambda: kills.append(True)
+    eng.step()
+    assert not kills  # default restart=0: the respawn must not re-fire
+
+
+# ---------------------------------------------------------------------------
+# fused-tier healing end to end: chaos nan -> skip -> convergence
+# ---------------------------------------------------------------------------
+def _fit_module(monkeypatch, sentinel="skip", fault=None, num_epoch=2):
+    monkeypatch.setenv("MXNET_TPU_SENTINEL", sentinel)
+    if fault:
+        monkeypatch.setenv("MXNET_FAULT_SPEC", fault)
+    chaos.reset_engine()
+    n = 256
+    x = RNG.randn(n, DIM).astype(np.float32)
+    y = RNG.randint(0, CLASSES, (n,))
+    x[np.arange(n), y] += 3.0
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=BATCH,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_sym(), context=mx.cpu(0))
+    mod.fit(it, num_epoch=num_epoch, kvstore="tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    eval_it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=BATCH,
+                                label_name="softmax_label")
+    acc = dict(mod.score(eval_it, mx.metric.Accuracy()))["accuracy"]
+    return mod, acc
+
+
+def test_fused_nan_injection_heals_via_skip(monkeypatch):
+    """THE fused-tier acceptance path: a chaos-poisoned step is skipped
+    in-graph (no rollback needed), the skip is counted in healthStats,
+    and training converges anyway."""
+    mod, acc = _fit_module(monkeypatch, sentinel="skip",
+                           fault="worker:0:nan@step=3")
+    snap = mod._fused.health_stats()
+    assert snap["skipped"] == 1 and snap["unhealthy"] == 1
+    assert snap["nonfinite_grad"] == 1
+    assert snap["consec"] == 0           # healed: healthy steps resumed
+    assert acc > 0.7, "training did not converge after the skip"
+    assert profiler.health_stats()["sentinel"]["skipped"] == 1
+
+
+def test_fused_halt_mode_fails_fast(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SENTINEL", "halt")
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "worker:0:nan@step=2")
+    chaos.reset_engine()
+    n = 128
+    x = RNG.randn(n, DIM).astype(np.float32)
+    y = RNG.randint(0, CLASSES, (n,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_sym(), context=mx.cpu(0))
+    with pytest.raises(MXNetError, match="sentinel halt"):
+        mod.fit(it, num_epoch=1, kvstore="tpu", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.init.Xavier())
+
+
+# ---------------------------------------------------------------------------
+# HealthGuard: rollback with LR backoff, budget, preemption (layer 2/3)
+# ---------------------------------------------------------------------------
+def _checkpointed_module(monkeypatch, tmp_path):
+    """A briefly-trained fused module + a committed checkpoint of it."""
+    mod, _acc = _fit_module(monkeypatch, sentinel="record", num_epoch=1)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    arg, aux = mod.get_params()
+    weights = {"arg:%s" % k: v.asnumpy() for k, v in arg.items()}
+    weights.update({"aux:%s" % k: v.asnumpy() for k, v in aux.items()})
+    mgr.begin(1)
+    mgr.write_worker_state(1, 0, {"epoch": 1})
+    mod.save_optimizer_states(mgr.staged_optimizer_states_path(1))
+    mgr.commit(1, weights=weights)
+    return mod, mgr, arg
+
+
+def _poison_module(mod):
+    x = RNG.randn(BATCH, DIM).astype(np.float32) * np.float32("nan")
+    y = RNG.randint(0, CLASSES, (BATCH,)).astype(np.float32)
+    bad = mx.io.DataBatch(data=[nd.array(x)], label=[nd.array(y)])
+    mod.forward_backward(bad)
+    mod.update()
+
+
+def test_guard_rolls_back_with_lr_backoff(monkeypatch, tmp_path):
+    mod, mgr, arg0 = _checkpointed_module(monkeypatch, tmp_path)
+    _poison_module(mod)  # record mode lets the NaN update land
+    guard = HealthGuard(mod, manager=mgr, consec=1, interval=1,
+                        budget=2, spike=0)
+    lr0 = mod._optimizer.lr
+    guard.on_batch(1, 0)
+    assert guard.rollbacks == 1
+    arg1, _aux1 = mod.get_params()
+    for k in arg0:
+        assert np.allclose(arg1[k].asnumpy(), arg0[k].asnumpy()), k
+    assert mod._optimizer.lr == pytest.approx(lr0 * 0.5)
+    assert profiler.health_stats()["rollbacks"] == 1
+    # the rebuilt step trains healthily and the counters restarted
+    x = RNG.randn(BATCH, DIM).astype(np.float32)
+    y = RNG.randint(0, CLASSES, (BATCH,)).astype(np.float32)
+    mod.forward_backward(mx.io.DataBatch(data=[nd.array(x)],
+                                         label=[nd.array(y)]))
+    mod.update()
+    snap = mod._fused.health_stats()
+    assert snap["unhealthy"] == 0 and snap["healthy"] == 1
+
+
+def test_guard_budget_exhaustion_fails_loudly(monkeypatch, tmp_path):
+    mod, mgr, _arg0 = _checkpointed_module(monkeypatch, tmp_path)
+    _poison_module(mod)
+    guard = HealthGuard(mod, manager=mgr, consec=1, interval=1,
+                        budget=0, spike=0)
+    with pytest.raises(MXNetError, match="rollback budget"):
+        guard.on_batch(1, 0)
+
+
+def test_guard_without_checkpoint_raises_not_loops(monkeypatch, tmp_path):
+    mod, _acc = _fit_module(monkeypatch, sentinel="record", num_epoch=1)
+    _poison_module(mod)
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    guard = HealthGuard(mod, manager=mgr, consec=1, interval=1,
+                        budget=2, spike=0)
+    with pytest.raises(MXNetError, match="no committed checkpoint"):
+        guard.on_batch(1, 0)
+
+
+def test_guard_spike_detection(monkeypatch, tmp_path):
+    mod, mgr, _arg0 = _checkpointed_module(monkeypatch, tmp_path)
+    guard = HealthGuard(mod, manager=mgr, consec=100, interval=1,
+                        budget=2, spike=5.0)
+    for _ in range(guard._SPIKE_WARMUP):
+        assert not guard._spiked(1.0)
+    assert guard._spiked(50.0)          # 50 > 5 * EMA(1.0)
+    assert not guard._spiked(1.2)       # normal fluctuation
+
+
+def test_guard_preemption_checkpoint_and_exit(monkeypatch, tmp_path):
+    mod, mgr, _arg0 = _checkpointed_module(monkeypatch, tmp_path)
+    guard = HealthGuard(mod, manager=mgr)
+    guard.request_preemption()
+    with pytest.raises(SystemExit) as exc:
+        guard.on_batch(3, 5)
+    assert exc.value.code == EXIT_PREEMPTED
+    ck = mgr.latest()
+    assert ck.epoch == 3
+    state = ck.worker_state(0)
+    assert state["preempted"] is True and state["nbatch"] == 5
+    arg, _aux = ck.split_weights()
+    assert arg and all(np.isfinite(v).all() for v in arg.values())
+    assert profiler.health_stats()["preemptions"] == 1
+
+
+def test_guard_from_env_arming(monkeypatch, tmp_path):
+    mod = object.__new__(mx.mod.Module)  # never touched when disarmed
+    monkeypatch.delenv("MXNET_CHECKPOINT_DIR", raising=False)
+    assert HealthGuard.from_env(mod) is None
+    monkeypatch.setenv("MXNET_CHECKPOINT_DIR", str(tmp_path / "c"))
+    guard = HealthGuard.from_env(mod)
+    assert guard is not None and guard.manager is not None
+    monkeypatch.setenv("MXNET_TPU_GUARD", "0")
+    assert HealthGuard.from_env(mod) is None
+    monkeypatch.setenv("MXNET_TPU_GUARD", "banana")
+    with pytest.raises(MXNetError, match="MXNET_TPU_GUARD"):
+        HealthGuard.from_env(mod)
+
+
+def test_guard_knob_validation(monkeypatch, tmp_path):
+    mod = object.__new__(mx.mod.Module)
+    for knob, bad in [("MXNET_TPU_GUARD_CONSEC", "0"),
+                      ("MXNET_TPU_GUARD_SPIKE", "-1"),
+                      ("MXNET_TPU_GUARD_BACKOFF", "2.0"),
+                      ("MXNET_TPU_GUARD_BUDGET", "-3"),
+                      ("MXNET_TPU_GUARD_INTERVAL", "zero"),
+                      ("MXNET_PREEMPT_GRACE", "0")]:
+        monkeypatch.setenv(knob, bad)
+        with pytest.raises(MXNetError):
+            HealthGuard(mod, manager=CheckpointManager(str(tmp_path)))
+        monkeypatch.delenv(knob)
+
+
+# ---------------------------------------------------------------------------
+# server rollback RPC (tentpole layer 2, dist_async side)
+# ---------------------------------------------------------------------------
+def test_server_rollback_restores_shard_and_backs_off_lr(monkeypatch):
+    from mxnet_tpu.kvstore_server import KVStoreServer, ServerKVStore
+
+    tmp = tempfile.mkdtemp(prefix="rb_test_")
+    monkeypatch.setenv("MXNET_CHECKPOINT_DIR", tmp)
+    srv = KVStoreServer(num_workers=1)
+    srv.serve_in_background()
+    kv = ServerKVStore(srv.addr)
+    try:
+        kv.init("w", np.arange(20, dtype=np.float32))
+        kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+        kv.push("w", np.ones(20, np.float32))
+        good = np.empty(20, np.float32)
+        kv.pull("w", out=good)
+
+        mgr = CheckpointManager(tmp)
+        mgr.begin(1)
+        mgr.write_worker_state(1, 0, {"epoch": 1})
+        kv.save_optimizer_states(mgr.staged_optimizer_states_path(1))
+        mgr.commit(1, weights={"arg:w": good.copy()},
+                   optimizer_config=kv.get_optimizer_config(),
+                   num_workers=1)
+
+        kv.push("w", np.full(20, np.nan, np.float32))   # the silent fault
+        poisoned = np.empty(20, np.float32)
+        kv.pull("w", out=poisoned)
+        assert not np.isfinite(poisoned).all()
+
+        info = kv.rollback_servers(lr_scale=0.5, gen=1)
+        assert info["keys"] == 1 and info["epoch"] == 1
+        assert info["lr"] == pytest.approx(0.05)
+        # a retried/replayed generation restores again (idempotent) but
+        # does NOT re-apply the backoff — this is what makes the op
+        # safe on the bounded-retry RPC path
+        kv.rollback_servers(lr_scale=0.5, gen=1)
+        assert kv.get_optimizer_config()[1]["learning_rate"] == \
+            pytest.approx(0.05)
+        restored = np.empty(20, np.float32)
+        kv.pull("w", out=restored)
+        assert np.array_equal(restored, good)
+        # the recorded config reflects the backed-off lr (a respawned
+        # server rebuilds with it) ...
+        assert kv.get_optimizer_config()[1]["learning_rate"] == \
+            pytest.approx(0.05)
+        # ... while a worker re-sending the ORIGINAL config is still
+        # accepted (learning_rate is the one dynamic hyperparameter)
+        kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+        # and a genuinely different config still conflicts loudly
+        with pytest.raises(MXNetError, match="conflicting"):
+            kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.5)
+        # a NEW generation backs off again; a raising scale is rejected
+        assert kv.rollback_servers(lr_scale=0.5, gen=2)["lr"] == \
+            pytest.approx(0.025)
+        with pytest.raises(MXNetError, match="lr_scale"):
+            kv.rollback_servers(lr_scale=1.5, gen=3)
+    finally:
+        kv.stop_server()
+        kv.close()
+
+
+def test_server_rollback_without_checkpoint_dir_errors(monkeypatch):
+    from mxnet_tpu.kvstore_server import KVStoreServer, ServerKVStore
+
+    monkeypatch.delenv("MXNET_CHECKPOINT_DIR", raising=False)
+    srv = KVStoreServer(num_workers=1)
+    srv.serve_in_background()
+    kv = ServerKVStore(srv.addr)
+    try:
+        with pytest.raises(MXNetError, match="MXNET_CHECKPOINT_DIR"):
+            kv.rollback_servers(lr_scale=0.5)
+    finally:
+        kv.stop_server()
+        kv.close()

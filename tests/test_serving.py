@@ -388,6 +388,58 @@ def test_backpressure_bound():
             f.result(timeout=30)
 
 
+def test_deadline_requests_are_shed_at_dequeue():
+    """ISSUE 9 overload shedding: a request whose deadline expires
+    while queued is dropped at dequeue — its future fails fast with
+    DeadlineExceeded, it never occupies a batch slot, and requests
+    without (or within) deadlines are served normally."""
+    from mxnet_tpu.serving import DeadlineExceeded
+
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        srv.predict("m", np.zeros((1, DIM), np.float32))  # warmup
+        worker = srv._workers["m"]
+        x = np.zeros((1, DIM), np.float32)
+        served_rows = []
+        worker._batch_hook = lambda reqs: served_rows.append(len(reqs))
+        with worker._exec_lock:  # wedge the worker mid-batch
+            f0 = srv.submit("m", x)
+            assert _wait_until(lambda: worker._busy)
+            f_shed = srv.submit("m", x, deadline=0.05)   # will expire
+            f_live = srv.submit("m", x)                  # no deadline
+            time.sleep(0.2)      # the deadline passes while queued
+        with pytest.raises(DeadlineExceeded, match="shed at dequeue"):
+            f_shed.result(timeout=30)
+        assert f0.result(timeout=30)[0].shape == (1, CLASSES)
+        assert f_live.result(timeout=30)[0].shape == (1, CLASSES)
+        stats = srv.stats()["m"]
+        assert stats["shed"] == 1
+        assert stats["errors"] == 0      # shed is not an error
+        # the expired request never reached a batch: only the wedge
+        # batch (1 req) and the post-wedge batch (1 req) executed
+        assert sum(served_rows) == 2
+
+
+def test_deadline_validation_and_fast_path():
+    from mxnet_tpu.serving import ServingError as SErr
+
+    sym, args = _mlp()
+    with ModelServer(ladder=(1, 4)) as srv:
+        srv.add_model("m", symbol=sym, arg_params=args,
+                      data_shapes={"data": (1, DIM)})
+        x = np.zeros((1, DIM), np.float32)
+        with pytest.raises(SErr, match="deadline"):
+            srv.submit("m", x, deadline=0)
+        with pytest.raises(SErr, match="deadline"):
+            srv.submit("m", x, deadline=-1.0)
+        # a generous deadline on an idle server: served, not shed
+        res = srv.submit("m", x, deadline=30.0).result(timeout=30)
+        assert res[0].shape == (1, CLASSES)
+        assert srv.stats()["m"].get("shed", 0) == 0
+
+
 def test_batch_error_fails_its_futures_only():
     sym, args = _mlp()
     with ModelServer(ladder=(1, 4)) as srv:

@@ -43,6 +43,11 @@ def main():
                         "step (ISSUE 7) and report its img/s and "
                         "measured per-device optimizer-state bytes "
                         "next to the replicated baseline")
+    p.add_argument("--sentinel", action="store_true",
+                   help="also measure the in-graph anomaly sentinel "
+                        "(ISSUE 9, MXNET_TPU_SENTINEL=skip) and report "
+                        "its img/s next to the sentinel-off rate — the "
+                        "tracked overhead number (acceptance <= 2%%)")
     p.add_argument("--fit-loop", action="store_true",
                    help="also run Module.fit() behind the async input "
                         "pipeline (DeviceQueueIter + device metrics) and "
@@ -142,6 +147,37 @@ def main():
         }
         del carry_z
 
+    # -- sentinel variant (ISSUE 9): same graph, in-graph health word ----
+    sentinel_rec = None
+    if args.sentinel:
+        ts_s = TrainStep(
+            sym, functional_optimizer("sgd", learning_rate=0.1,
+                                      momentum=0.9),
+            mesh=make_mesh({"dp": n_dev}), sentinel="skip",
+            compute_dtype="bfloat16" if jax.default_backend() == "tpu"
+            else None,
+        )
+        p_s, s_s, a_s = ts_s.init_params(
+            {"data": (batch, 3, ds, ds), "softmax_label": (batch,)},
+            initializer=mx.initializer.Xavier())
+        carry_s = ts_s.place(p_s, s_s, a_s)
+        carry_s, loss_s = ts_s(carry_s, syn, key)   # compile
+        jax.block_until_ready(loss_s)
+        t0 = time.perf_counter()
+        for _ in range(n_syn):
+            carry_s, loss_s = ts_s(carry_s, syn, key)
+        jax.block_until_ready(loss_s)
+        sentinel_img_s = batch * n_syn / (time.perf_counter() - t0)
+        health = ts_s.health_stats(carry_s)
+        sentinel_rec = {
+            "img_s": round(sentinel_img_s, 2),
+            "vs_off": round(sentinel_img_s / synthetic_img_s, 4),
+            "mode": "skip",
+            "healthy_steps": health["healthy"],
+            "unhealthy_steps": health["unhealthy"],
+        }
+        del carry_s
+
     # -- decode-only ------------------------------------------------------
     it = make_iter()
     n_batches = 0
@@ -217,6 +253,8 @@ def main():
         rec["fit_preplaced"] = fit_pipe.get("preplaced", 0)
     if zero_rec is not None:
         rec["zero"] = zero_rec
+    if sentinel_rec is not None:
+        rec["sentinel"] = sentinel_rec
     # kvstore data-plane counters (raw vs wire bytes, RPC latency) ride
     # along when this process did distributed push/pull — the ISSUE 4
     # observability surface, empty on the single-chip path

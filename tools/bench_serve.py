@@ -55,24 +55,32 @@ def build_model(dim, hidden, layers, classes, seed=0):
     return net, args
 
 
-def _client(server, stop_at, think_s, dim, rows, seed, out):
-    """One closed-loop client: think (Exp), submit, wait, record."""
+def _client(server, stop_at, think_s, dim, rows, seed, out,
+            deadline_s=None):
+    """One closed-loop client: think (Exp), submit, wait, record. With
+    ``deadline_s`` the request is sheddable (ISSUE 9 overload
+    shedding): a DeadlineExceeded is counted as shed — and its
+    fail-fast latency recorded separately — not as an error."""
     import numpy as np
+
+    from mxnet_tpu.serving import DeadlineExceeded
 
     rng = random.Random(seed)
     nrng = np.random.RandomState(seed)
     x = nrng.randn(rows, dim).astype(np.float32)
-    lat, errors = [], 0
+    lat, shed_lat, errors = [], [], 0
     while time.perf_counter() < stop_at:
         if think_s > 0:
             time.sleep(rng.expovariate(1.0 / think_s))
         t0 = time.perf_counter()
         try:
-            server.submit("model", x).result(timeout=60)
+            server.submit("model", x, deadline=deadline_s).result(timeout=60)
             lat.append(time.perf_counter() - t0)
+        except DeadlineExceeded:
+            shed_lat.append(time.perf_counter() - t0)
         except Exception:
             errors += 1
-    out.append((lat, errors))
+    out.append((lat, errors, shed_lat))
 
 
 def _pctl(sorted_vals, q):
@@ -80,13 +88,14 @@ def _pctl(sorted_vals, q):
 
 
 def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
-             rows, swap_prefix=None):
+             rows, swap_prefix=None, deadline_ms=None):
     """Measure one serving configuration; returns a result dict."""
     from mxnet_tpu import profiler
     from mxnet_tpu.serving import ModelServer
 
     profiler.serving_reset()
     results = []
+    deadline_s = None if deadline_ms is None else deadline_ms / 1e3
     with ModelServer(ladder=ladder, queue_depth=4 * clients + 8,
                      submit_timeout=60) as server:
         server.add_model("model", symbol=symbol, arg_params=args_np,
@@ -98,7 +107,7 @@ def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
         threads = [threading.Thread(
             target=_client,
             args=(server, stop_at, think_ms / 1e3, dim, rows, 1000 + i,
-                  results))
+                  results, deadline_s))
             for i in range(clients)]
         for t in threads:
             t.start()
@@ -113,8 +122,9 @@ def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
-    lats = sorted(x for lat, _ in results for x in lat)
-    errors = sum(e for _, e in results)
+    lats = sorted(x for lat, _e, _s in results for x in lat)
+    errors = sum(e for _l, e, _s in results)
+    shed_lats = sorted(x for _l, _e, s in results for x in s)
     stats = profiler.serving_stats(reset=True).get("model", {})
     rec = {
         "req_s": round(len(lats) / wall, 1),
@@ -126,6 +136,13 @@ def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
         "avg_batch_rows": stats.get("avg_batch_rows"),
         "max_queue_depth": stats.get("max_queue_depth"),
     }
+    if deadline_ms is not None:
+        # shed requests failed FAST (at dequeue) — their p99 is the
+        # overload-protection evidence next to the served p99
+        rec["deadline_ms"] = deadline_ms
+        rec["shed"] = stats.get("shed", 0)
+        rec["shed_p99_ms"] = round(_pctl(shed_lats, 0.99) * 1e3, 2) \
+            if shed_lats else None
     if swapped is not None:
         # a request neither answered nor errored would still hold a
         # client thread; all joined above, so dropped == 0 by
@@ -137,8 +154,9 @@ def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
 
 
 def measure(clients=32, seconds=6.0, think_ms=1.0, dim=128, hidden=256,
-            layers=4, classes=32, rows=1, ladder=None):
-    """Run both configurations; returns the combined record."""
+            layers=4, classes=32, rows=1, ladder=None, deadline_ms=25.0):
+    """Run both configurations plus the overload-shedding case;
+    returns the combined record."""
     import jax
     import numpy as np
 
@@ -159,6 +177,14 @@ def measure(clients=32, seconds=6.0, think_ms=1.0, dim=128, hidden=256,
                    dim, rows)
     dyn = run_mode(symbol, args_np, ladder, clients, seconds, think_ms,
                    dim, rows, swap_prefix=prefix)
+    # overload: double the clients, zero think time, per-request
+    # deadlines — expired requests are shed at dequeue instead of
+    # occupying batch slots (ISSUE 9 overload protection)
+    over = None
+    if deadline_ms and deadline_ms > 0:
+        over = run_mode(symbol, args_np, ladder, clients * 2,
+                        max(2.0, seconds / 2.0), 0.0, dim, rows,
+                        deadline_ms=deadline_ms)
     rec = {
         "metric": "serving_throughput",
         "value": dyn["req_s"],
@@ -175,6 +201,8 @@ def measure(clients=32, seconds=6.0, think_ms=1.0, dim=128, hidden=256,
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
     }
+    if over is not None:
+        rec["overload"] = over
     return rec
 
 
@@ -196,10 +224,14 @@ def main():
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--rows", type=int, default=1,
                     help="rows per request")
+    ap.add_argument("--deadline-ms", type=float, default=25.0,
+                    help="per-request deadline for the overload "
+                         "measurement (0 disables it)")
     args = ap.parse_args()
     rec = measure(clients=args.clients, seconds=args.seconds,
                   think_ms=args.think_ms, dim=args.dim,
-                  hidden=args.hidden, layers=args.layers, rows=args.rows)
+                  hidden=args.hidden, layers=args.layers, rows=args.rows,
+                  deadline_ms=args.deadline_ms)
     print(json.dumps(rec))
 
 

@@ -1,28 +1,41 @@
 #!/usr/bin/env python
-"""Elastic-recovery smoke: a launch.py job must survive an injected
-crash and finish training.
+"""Fault-matrix smoke: a launch.py job must survive injected faults
+and finish training.
 
 Runs ``launch.py -n 2 -s 1 --max-restarts 1 --kv-store dist_async``
 over the tiny synthetic trainer (examples/distributed/dist_sync.py)
-with a deterministic ``MXNET_FAULT_SPEC`` crash (mxnet_tpu/chaos.py),
-then exits nonzero unless
+with a deterministic ``MXNET_FAULT_SPEC`` (mxnet_tpu/chaos.py), then
+exits nonzero unless the reaction path the fault targets actually ran:
 
-- the job's exit code is 0,
-- the injected crash actually fired (``[chaos]``) AND a respawn
-  happened (``respawning``) — a spec that never triggers would
-  green-light a recovery path that was never exercised,
-- the respawned node either resumed from a checkpoint (worker) or
-  restored its shard (server),
-- every worker reports a decreasing loss.
+- ``crash`` rules (the PR 3 loud-fault path): the injected crash fired
+  (``[chaos]``), a respawn happened, and the respawned node restored
+  from a checkpoint (worker) or its shard (server);
+- ``nan`` rules (ISSUE 9 silent-fault path): the poisoned gradient
+  fired and the fit health guard rolled the job back to the last
+  committed checkpoint (``event=rollback``) — no respawn needed, the
+  processes heal in place;
+- ``preempt`` rules (ISSUE 9 preemption path): the self-SIGTERM fired,
+  the worker checkpointed inside its grace window and exited resumable
+  (``event=preempted``), launch.py respawned it WITHOUT burning the
+  restart budget (``respawning free`` + ``restarts=0`` in the exit
+  summary), and the respawn resumed from the preemption checkpoint
+  (``preempted=True``).
 
-CI wiring: tests/test_dist_async.py runs this script as a
-``slow``-marked test, keeping the default tier within its wall-time
-gate while the nightly tier exercises the full recovery loop twice
-(worker crash here, server crash in the default-tier e2e).
+Every case additionally requires exit code 0 and a decreasing loss on
+every worker — a recovery that finishes with garbage weights is not a
+recovery.
+
+CI wiring: tests/test_dist_async.py runs the default (worker-crash)
+case as a ``slow``-marked test; the nan/preempt cases have their own
+slow-tier tests. ``--matrix`` sweeps all four kinds in one invocation
+for manual/nightly use.
 
 Usage:
     python tools/chaos_check.py                      # worker crash
     python tools/chaos_check.py --spec 'server:0:crash@step=130'
+    python tools/chaos_check.py --spec 'worker:0:nan@step=16'
+    python tools/chaos_check.py --spec 'worker:1:preempt@step=16'
+    python tools/chaos_check.py --matrix             # all of the above
 """
 import argparse
 import os
@@ -32,23 +45,31 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+MATRIX = [
+    "worker:1:crash@step=18",
+    "server:0:crash@step=130",
+    "worker:0:nan@step=16",
+    "worker:1:preempt@step=16",
+]
 
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--spec", default="worker:1:crash@step=18",
-                    help="MXNET_FAULT_SPEC to inject "
-                         "(default: kill worker 1 mid-epoch)")
-    ap.add_argument("-n", "--num-workers", type=int, default=2)
-    ap.add_argument("-s", "--num-servers", type=int, default=1)
-    ap.add_argument("--max-restarts", type=int, default=1)
-    ap.add_argument("--timeout", type=int, default=55,
-                    help="launch.py watchdog (seconds)")
-    args = ap.parse_args()
 
+def _kind(spec):
+    m = re.search(r":(crash|nan|preempt)@", spec)
+    return m.group(1) if m else "crash"
+
+
+def run_case(args, spec):
     from mxnet_tpu.test_utils import clean_dist_env
 
+    kind = _kind(spec)
     env = clean_dist_env(repo_root=ROOT)
-    env["MXNET_FAULT_SPEC"] = args.spec
+    env["MXNET_FAULT_SPEC"] = spec
+    if kind == "nan":
+        # trigger the rollback promptly (well before the epoch ends, so
+        # both workers' guards meet in the same barrier round) and keep
+        # spike detection out of the determinism picture
+        env["MXNET_TPU_GUARD_CONSEC"] = "2"
+        env["MXNET_TPU_GUARD_SPIKE"] = "0"
 
     cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
            "-n", str(args.num_workers), "-s", str(args.num_servers),
@@ -58,8 +79,8 @@ def main():
            os.path.join(ROOT, "examples", "distributed", "dist_sync.py"),
            "--kv-store", "dist_async", "--num-epochs", "3",
            "--num-samples", "1200", "--batch-size", "100"]
-    print("chaos_check: %s  (MXNET_FAULT_SPEC=%s)"
-          % (" ".join(cmd), args.spec), flush=True)
+    print("chaos_check[%s]: %s  (MXNET_FAULT_SPEC=%s)"
+          % (kind, " ".join(cmd), spec), flush=True)
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
                           timeout=args.timeout + 30)
     out = proc.stdout + proc.stderr
@@ -71,11 +92,27 @@ def main():
     if "[chaos]" not in out:
         failures.append("fault spec never fired (no [chaos] line) — "
                         "nothing was actually tested")
-    if "respawning" not in out:
-        failures.append("no respawn observed")
-    if not ("resuming from checkpoint" in out
-            or "event=restored-from" in out):
-        failures.append("respawned node never restored from a checkpoint")
+    if kind == "crash":
+        if "respawning" not in out:
+            failures.append("no respawn observed")
+        if not ("resuming from checkpoint" in out
+                or "event=restored-from" in out):
+            failures.append("respawned node never restored from a "
+                            "checkpoint")
+    elif kind == "nan":
+        if "event=rollback" not in out:
+            failures.append("health guard never rolled back "
+                            "(no event=rollback line)")
+    elif kind == "preempt":
+        if "event=preempted" not in out:
+            failures.append("preempted worker never ran the "
+                            "grace-window exit (no event=preempted)")
+        if "respawning free" not in out:
+            failures.append("launch.py burned the restart budget on a "
+                            "preemption (no 'respawning free')")
+        if "preempted=True" not in out:
+            failures.append("respawn did not resume from the "
+                            "preemption checkpoint")
     losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
     if len(losses) != args.num_workers:
         failures.append("expected %d worker loss reports, got %d"
@@ -86,12 +123,36 @@ def main():
                             % (rank, loss0, loss1))
 
     if failures:
-        print("chaos_check: FAIL\n  - " + "\n  - ".join(failures),
-              file=sys.stderr)
+        print("chaos_check[%s]: FAIL\n  - %s"
+              % (kind, "\n  - ".join(failures)), file=sys.stderr)
         return 1
-    print("chaos_check: OK — job recovered from %r and converged"
-          % args.spec)
+    print("chaos_check[%s]: OK — job survived %r and converged"
+          % (kind, spec))
     return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--spec", default="worker:1:crash@step=18",
+                    help="MXNET_FAULT_SPEC to inject "
+                         "(default: kill worker 1 mid-epoch)")
+    ap.add_argument("--matrix", action="store_true",
+                    help="run the full fault matrix (crash, nan, "
+                         "preempt) instead of a single --spec")
+    ap.add_argument("-n", "--num-workers", type=int, default=2)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--timeout", type=int, default=55,
+                    help="launch.py watchdog per case (seconds)")
+    args = ap.parse_args()
+
+    specs = MATRIX if args.matrix else [args.spec]
+    rc = 0
+    for spec in specs:
+        rc |= run_case(args, spec)
+    if args.matrix:
+        print("chaos_check: matrix %s" % ("FAIL" if rc else "OK"))
+    return rc
 
 
 if __name__ == "__main__":

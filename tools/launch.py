@@ -55,6 +55,21 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# mirrors mxnet_tpu.health.EXIT_PREEMPTED (launch.py stays stdlib-only):
+# a worker that caught SIGTERM, drained, and checkpointed inside its
+# MXNET_PREEMPT_GRACE window exits with this status — supervision
+# respawns it WITHOUT burning the restart budget (the budget guards
+# against crash loops; a preempted node did nothing wrong). Note the
+# tracker's own takeover counter still ticks per respawn — the
+# launch-side budget is the user-facing one.
+EXIT_PREEMPTED = 75
+# Free respawns are still BOUNDED per node: a process that reports
+# "preempted" on every incarnation (a re-preempting scheduler, or a
+# program that happens to exit 75) must not spin the supervisor
+# forever — past this many, exit 75 is treated like any other nonzero
+# status and burns the normal restart budget.
+MAX_FREE_RESTARTS = 16
+
 
 def _free_port():
     s = socket.socket()
@@ -183,17 +198,22 @@ class _Node:
         self.cmd = cmd
         self.env_fn = env_fn     # restart_count -> env dict
         self.proc = None
-        self.restarts = 0
+        self.restarts = 0        # budget-burning respawns
+        self.free_restarts = 0   # preemption respawns (budget untouched)
         self.exit_history = []   # every observed exit code, in order
         self.finished = False    # exited 0 (terminal success)
         self.failed = False      # budget exhausted (terminal failure)
 
     def spawn(self):
-        self.proc = subprocess.Popen(self.cmd, env=self.env_fn(self.restarts))
+        # DMLC_RESTART_COUNT counts EVERY incarnation (chaos rules and
+        # checkpoint resume key on it), free or not
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env_fn(self.restarts + self.free_restarts))
 
     def __str__(self):
         rcs = ",".join(str(rc) for rc in self.exit_history) or "-"
-        return "%-10s rc=%s restarts=%d" % (self.name, rcs, self.restarts)
+        return "%-10s rc=%s restarts=%d free=%d" % (
+            self.name, rcs, self.restarts, self.free_restarts)
 
 
 def _print_exit_summary(nodes, out=None):
@@ -253,6 +273,19 @@ def _spawn_topology(args, coord):
                 node.exit_history.append(code)
                 if code == 0:
                     node.finished = True
+                    continue
+                if code == EXIT_PREEMPTED and args.max_restarts \
+                        and node.role != "scheduler" \
+                        and node.free_restarts < MAX_FREE_RESTARTS:
+                    # preemption-aware exit (ISSUE 9): resumable status
+                    # from the grace-window checkpoint path — respawn
+                    # for free
+                    node.free_restarts += 1
+                    print("launch.py: %s preempted (exit %d); respawning"
+                          " free (restart budget untouched: %d/%d used)"
+                          % (node.name, code, node.restarts,
+                             args.max_restarts), file=sys.stderr)
+                    node.spawn()
                     continue
                 if node.role != "scheduler" \
                         and node.restarts < args.max_restarts:
