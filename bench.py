@@ -70,6 +70,8 @@ def _measure(variant):
         return _measure_fit(n_dev)
     if variant == "serve":
         return _measure_serve()
+    if variant == "tune":
+        return _measure_tune()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
@@ -233,6 +235,57 @@ def _measure_serve():
         print(json.dumps({"error": "serve: %s" % str(e)[:500]}))
 
 
+def _measure_tune():
+    """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
+    space at the bench shapes (tools/tune_kernels.py) and record the
+    winner vs the default schedule per kernel in one JSON line — the
+    measurement ROADMAP item 1 needs to populate BENCH_r06 and decide
+    the fused-default flip by search instead of by hand. Winners land
+    in the on-disk schedule table, so subsequent fused runs with
+    MXNET_TPU_TUNE=1 pick them up at trace time."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "tune_kernels.py")],
+            capture_output=True, text=True,
+            timeout=max(60, CHILD_TOTAL_TIMEOUT - 120))
+        rec = None
+        for ln in reversed((proc.stdout or "").splitlines()):
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                parsed = json.loads(ln)
+            except ValueError:
+                continue
+            if "tune" in parsed:
+                rec = parsed
+                break
+        if rec is None:
+            print(json.dumps({"error": "tune: no report (rc=%s) %s"
+                              % (proc.returncode,
+                                 (proc.stderr or "").strip()[-300:])}))
+            return
+        tuned = {}
+        for key, r in rec["tune"].items():
+            w = r.get("winner") or {}
+            tuned[key] = {
+                "cache_hit": r.get("cache_hit", False),
+                "schedule": w.get("schedule"),
+                "ms_per_iter": w.get("ms_per_iter"),
+                "default_ms_per_iter": w.get("default_ms_per_iter"),
+                "speedup_vs_default": w.get("speedup_vs_default"),
+                "n_timed": r.get("n_timed"),
+                "n_pruned": r.get("n_pruned"),
+            }
+        print(json.dumps({"variant": "tune", "tuned": tuned,
+                          "backend": rec.get("backend"),
+                          "table": rec.get("table")}))
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print(json.dumps({"error": "tune: %s" % str(e)[:300]}))
+
+
 def _report(results, kernels=None):
     imgs = {k: v for k, v in results.items() if "img_s" in v}
     if imgs:
@@ -254,6 +307,9 @@ def _report(results, kernels=None):
     if "serve" in results:
         rec["serve"] = {k: v for k, v in results["serve"].items()
                         if k != "variant"}
+    if "tune" in results:
+        rec["tune"] = {k: v for k, v in results["tune"].items()
+                       if k != "variant"}
     if "zero" in results and "opt_bytes_per_dev" in results["zero"]:
         rec["zero_mem"] = {
             k: results["zero"][k]
@@ -312,8 +368,8 @@ def main():
     # after EVERY success: the driver reads the LAST json line, so even
     # if it kills this process mid-attempt the round still lands a
     # number.
-    for variant in ("unfused", "fused", "fit", "zero", "serve",
-                    "unfused", "fused", "fit", "zero", "serve"):
+    for variant in ("unfused", "fused", "fit", "zero", "serve", "tune",
+                    "unfused", "fused", "fit", "zero", "serve", "tune"):
         if variant in results:
             continue
         if time.time() > deadline - 60:
@@ -336,9 +392,10 @@ def main():
                 except ValueError:
                     continue  # stray brace-looking log line
                 if "img_s" in parsed or "req_s" in parsed \
-                        or "error" in parsed:
+                        or "tuned" in parsed or "error" in parsed:
                     line = parsed
-            if line and ("img_s" in line or "req_s" in line):
+            if line and ("img_s" in line or "req_s" in line
+                         or "tuned" in line):
                 results[variant] = line
                 _report(results)
             else:

@@ -262,10 +262,26 @@ KNOBS = {
         "", "honored",
         "address a multi-host server publishes to the tracker "
         "(kvstore_server.py)"),
+    # --- Pallas schedule autotuner (ISSUE 10) ---
+    "MXNET_TPU_TUNE": (
+        "1", "honored",
+        "consult the on-disk schedule table for searched Pallas kernel "
+        "schedules at trace time (kernels consult tune.schedule_for "
+        "with the hand defaults as fallback — an empty table is "
+        "bit-identical to the pre-autotuner behavior); 0 pins the hand "
+        "defaults (tune/table.py)"),
+    "MXNET_TPU_TUNE_TABLE": (
+        "", "honored",
+        "schedule-table path override (default ~/.cache/mxnet_tpu/"
+        "schedule_table.json); written atomically by "
+        "tools/tune_kernels.py, keyed (kernel, shape, dtype, backend) "
+        "(tune/table.py)"),
     # --- misc registered per the drift audit ---
     "MXNET_TPU_FUSED_ROW_TILE": (
         "", "honored",
-        "fused Pallas kernel row-tile override (kernels/fused_block.py)"),
+        "fused Pallas kernel row-tile override; strict-parsed (a "
+        "malformed value raises with the knob name) and cached per "
+        "value (kernels/fused_block.py)"),
     "MXNET_GLUON_REPO": (
         "", "honored",
         "gluon model-zoo repo URL or local directory "

@@ -273,23 +273,51 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, kv_len, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def effective_blocks(block_q, block_k, seq_q, seq_k):
+    """The block sizes a (block_q, block_k) request actually runs with:
+    clamped to the sequence length and rounded up to the 16-row Mosaic
+    tile. One definition shared with the schedule search
+    (tune/search.py), so candidate dedup matches the kernel exactly."""
+    return (_round_up(min(block_q, max(seq_q, 1)), 16),
+            _round_up(min(block_k, max(seq_k, 1)), 16))
+
+
+# hand default block size (MXU-native); the schedule table can override
+# per (shape, dtype, backend) when block_q/block_k are left None
+DEFAULT_BLOCK = 128
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=None,
+                    block_k=None, interpret=None):
     """Fused attention, (B, H, S, D) layout. Differentiable (custom VJP).
 
     Sequence lengths are padded to the block size internally (padding keys
-    are masked out); pass ``block_q/block_k`` tuned to the model (128 is
-    MXU-native) and ``interpret=True`` to force interpreter mode off-TPU.
+    are masked out). ``block_q``/``block_k`` are per-call schedule
+    parameters (ISSUE 10): left None, the on-disk schedule table is
+    consulted at trace time for this (shape, dtype, backend) — key
+    ``flash_attention`` — falling back to the MXU-native 128; an
+    explicit value pins the block (bench sweeps, the tuner's own timing
+    path skips the consult). ``interpret=True`` forces interpreter mode
+    off-TPU.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if block_q is None or block_k is None:
+        from ..tune import schedule_for
+
+        sched = schedule_for("flash_attention",
+                             (b, h, sq, sk, d, int(bool(causal))),
+                             str(q.dtype)) or {}
+        if block_q is None:
+            block_q = sched.get("block_q", DEFAULT_BLOCK)
+        if block_k is None:
+            block_k = sched.get("block_k", DEFAULT_BLOCK)
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     interp = _need_interpret(interpret)
     # Mosaic tiles refs as (8k, 128k) for fp32 / (16k, 128k) for bf16:
     # clamp to the sequence length but keep blocks tile-aligned (seq is
     # padded up to the block below, padded keys masked via kv_len).
-    block_q = _round_up(min(block_q, max(sq, 1)), 16)
-    block_k = _round_up(min(block_k, max(sk, 1)), 16)
+    block_q, block_k = effective_blocks(block_q, block_k, sq, sk)
 
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k

@@ -59,7 +59,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 import threading
 
 import jax
@@ -69,6 +68,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from .. import config as _config
 from ..util import shard_map as _shard_map
 
 # One MXU call must see at least this many multiply-accumulates
@@ -87,6 +87,11 @@ _VMEM_BLOCK_ELEMS = 1 << 20
 # set_row_tile() for sweeps (tools/bench_kernel.py --row-tile).
 ROW_TILE = None
 
+# parsed MXNET_TPU_FUSED_ROW_TILE, keyed by the raw env string so a
+# changed env var between calls still takes effect but the strict
+# parse runs once per value, not per kernel invocation
+_ROW_TILE_ENV_CACHE = None
+
 
 def set_row_tile(v):
     """Set the module-wide row-tile knob (None restores the default)."""
@@ -95,12 +100,21 @@ def set_row_tile(v):
 
 
 def _row_tile_default():
+    global _ROW_TILE_ENV_CACHE
     if ROW_TILE is not None:
         return max(1, int(ROW_TILE))
-    try:
-        return max(1, int(os.environ.get("MXNET_TPU_FUSED_ROW_TILE", "16")))
-    except ValueError:
-        return 16
+    raw = _config.get("MXNET_TPU_FUSED_ROW_TILE")
+    if _ROW_TILE_ENV_CACHE is not None and _ROW_TILE_ENV_CACHE[0] == raw:
+        return _ROW_TILE_ENV_CACHE[1]
+    if raw in (None, ""):
+        val = 16
+    else:
+        # strict parse: a malformed knob is a job misconfiguration —
+        # fail loudly with the knob name, never train on a silently
+        # substituted default (the pre-ISSUE-10 read swallowed it)
+        val = _config.get_positive_int("MXNET_TPU_FUSED_ROW_TILE")
+    _ROW_TILE_ENV_CACHE = (raw, val)
+    return val
 
 
 def _need_interpret(interpret):
@@ -288,25 +302,42 @@ def _mask_halo_rows(hv, i, top_bad, bottom_bad):
 # ---------------------------------------------------------------------------
 # blocking plans: one source of truth for kernels, tests, and benchmarks
 # ---------------------------------------------------------------------------
-def _plan_conv(n, ho, wo, ci, co, k, stride, row_tile=None):
+def _per_img_conv(th, wo, ci, bco, k, stride):
+    """Dominant per-image per-block element count of the conv_fwd/wgrad
+    geometry (the VMEM budget term of the batch-fold chooser)."""
+    rows_in = stride * th
+    wd = wo * stride
+    return max((rows_in + (2 if k == 3 else 0)) * wd * ci, th * wo * bco)
+
+
+def _per_img_dgrad(th_in, th_g, wd, bci, co, k, stride):
+    """Dominant per-image per-block element count of conv_dgrad."""
+    wo = wd // stride
+    return max(th_in * wd * bci, (th_g + 2) * wo * co)
+
+
+def _plan_conv(n, ho, wo, ci, co, k, stride, row_tile=None,
+               chan_block=None, batch_fold=None):
     """Grid plan shared by conv_fwd and conv_wgrad (same geometry):
-    (th, ht, rows_in, nb, nbb, bco, cb)."""
+    (th, ht, rows_in, nb, nbb, bco, cb). ``chan_block``/``batch_fold``
+    force a searched schedule's blocks (callers validate divisibility —
+    schedule_legal / _schedule_knobs)."""
     # NOT equivalent to _tile_rows(ho, row_tile): tests monkeypatch
     # _tile_rows with a single-arg lambda (test_fused_resnet.py), so
     # the default path must call it with one argument
     th = _tile_rows(ho) if row_tile is None else _tile_rows(ho, row_tile)
     ht = ho // th
     rows_in = stride * th
-    bco = _chan_block(co)
+    bco = chan_block if chan_block else _chan_block(co)
     cb = co // bco
-    wd = wo * stride
-    per_img = max((rows_in + (2 if k == 3 else 0)) * wd * ci,
-                  th * wo * bco)
-    nb = _batch_fold(n, th * wo, ci, bco, per_img)
+    per_img = _per_img_conv(th, wo, ci, bco, k, stride)
+    nb = (batch_fold if batch_fold
+          else _batch_fold(n, th * wo, ci, bco, per_img))
     return th, ht, rows_in, nb, n // nb, bco, cb
 
 
-def _plan_dgrad(n, h, wd, ci, co, k, stride, row_tile=None):
+def _plan_dgrad(n, h, wd, ci, co, k, stride, row_tile=None,
+                chan_block=None, batch_fold=None):
     """Grid plan for conv_dgrad: (th_in, ht, th_g, nb, nbb, bci, cib)."""
     # single-arg default call: see the monkeypatch note in _plan_conv
     th_in = _tile_rows(h) if row_tile is None else _tile_rows(h, row_tile)
@@ -314,30 +345,42 @@ def _plan_dgrad(n, h, wd, ci, co, k, stride, row_tile=None):
         th_in = 2 if h % 2 == 0 else 1
     ht = h // th_in
     th_g = th_in // stride
-    bci = _chan_block(ci)
+    bci = chan_block if chan_block else _chan_block(ci)
     cib = ci // bci
     wo = wd // stride
     rows_img = th_g * wo if k == 1 else th_in * wd
-    per_img = max(th_in * wd * bci, (th_g + 2) * wo * co)
-    nb = _batch_fold(n, rows_img, co, bci, per_img)
+    per_img = _per_img_dgrad(th_in, th_g, wd, bci, co, k, stride)
+    nb = (batch_fold if batch_fold
+          else _batch_fold(n, rows_img, co, bci, per_img))
     return th_in, ht, th_g, nb, n // nb, bci, cib
 
 
-def mxu_plan(kind, x_shape, w_shape, stride=1, row_tile=None):
+def _sched_parts(schedule, row_tile=None):
+    s = schedule or {}
+    return (s.get("row_tile", row_tile), s.get("chan_block"),
+            s.get("batch_fold"))
+
+
+def mxu_plan(kind, x_shape, w_shape, stride=1, row_tile=None,
+             schedule=None):
     """The matmul tile each MXU call sees for a kernel at these shapes.
 
     kind: 'fwd' | 'wgrad' | 'dgrad'; x_shape: the conv *input* NHWC
-    shape; w_shape: (k, k, Ci, Co) HWIO. Returns a dict with the grid,
-    the per-call matmul dims (m, k, n) and their product ``work`` —
-    tests assert ``work >= MXU_WORK_FLOOR`` at real ResNet-50 block
-    shapes (the tentpole contract of the round-6 rewrite)."""
+    shape; w_shape: (k, k, Ci, Co) HWIO; ``schedule``: an optional
+    searched {row_tile, chan_block, batch_fold} to plan instead of the
+    hand defaults (the tuner's legality/work oracle). Returns a dict
+    with the grid, the per-call matmul dims (m, k, n) and their product
+    ``work`` — tests assert ``work >= MXU_WORK_FLOOR`` at real
+    ResNet-50 block shapes (the tentpole contract of the round-6
+    rewrite)."""
+    rt, cbk, bfd = _sched_parts(schedule, row_tile)
     n, h, wd, ci = x_shape
     kk = int(w_shape[0])
     co = int(w_shape[-1])
     if kind in ("fwd", "wgrad"):
         ho, wo = h // stride, wd // stride
         th, ht, rows_in, nb, nbb, bco, cb = _plan_conv(
-            n, ho, wo, ci, co, kk, stride, row_tile)
+            n, ho, wo, ci, co, kk, stride, rt, cbk, bfd)
         rows = nb * th * wo
         m, kd, nd = ((rows, ci, bco) if kind == "fwd"
                      else (ci, rows, bco))
@@ -346,7 +389,7 @@ def mxu_plan(kind, x_shape, w_shape, stride=1, row_tile=None):
                     calls=kk * kk, floor=MXU_WORK_FLOOR)
     if kind == "dgrad":
         th_in, ht, th_g, nb, nbb, bci, cib = _plan_dgrad(
-            n, h, wd, ci, co, kk, stride, row_tile)
+            n, h, wd, ci, co, kk, stride, rt, cbk, bfd)
         rows = nb * (th_g * (wd // stride) if kk == 1 else th_in * wd)
         return dict(kind=kind, grid=(cib, nbb, ht), nb=nb, th=th_in,
                     bco=bci, m=rows, k=co, n=bci, work=rows * co * bci,
@@ -355,19 +398,102 @@ def mxu_plan(kind, x_shape, w_shape, stride=1, row_tile=None):
                      % (kind,))
 
 
+def schedule_legal(kind, x_shape, w_shape, stride, schedule):
+    """(ok, reason) for a candidate schedule at these shapes — the
+    tuner's pre-timing pruning predicate. Rejects tile > dim,
+    non-dividing tiles/blocks (they would silently clamp into another
+    candidate's plan), odd row tiles under the stride-2 dgrad
+    zero-stuffing, and batch folds that overrun the per-block VMEM
+    budget."""
+    n, h, wd, ci = x_shape
+    k = int(w_shape[0])
+    co = int(w_shape[-1])
+    rt, cbk, bfd = _sched_parts(schedule)
+    rows = h if kind == "dgrad" else h // stride
+    if rt is not None:
+        if rt > rows:
+            return False, "row_tile %d > %d output rows" % (rt, rows)
+        if rows % rt:
+            return False, "row_tile %d does not divide %d rows" % (rt, rows)
+        if kind == "dgrad" and stride == 2 and rt % 2:
+            return False, "odd row_tile %d with stride-2 dgrad" % rt
+    cdim = ci if kind == "dgrad" else co
+    if cbk is not None and (cbk > cdim or cdim % cbk):
+        return False, "chan_block %d does not tile %d channels" % (cbk, cdim)
+    if bfd is not None:
+        if bfd > n or n % bfd:
+            return False, "batch_fold %d does not tile batch %d" % (bfd, n)
+        if bfd > 1:
+            th = _tile_rows(rows, rt) if rt is not None else _tile_rows(rows)
+            bc = cbk if cbk else _chan_block(cdim)
+            if kind == "dgrad":
+                per_img = _per_img_dgrad(th, th // stride, wd, bc, co, k,
+                                         stride)
+            else:
+                per_img = _per_img_conv(th, wd // stride, ci, bc, k, stride)
+            if bfd * per_img > _VMEM_BLOCK_ELEMS:
+                return False, ("batch_fold %d x %d elems overruns the VMEM "
+                               "block budget" % (bfd, per_img))
+    return True, ""
+
+
+def _schedule_knobs(kind, key_shape, dtype, schedule, row_tile):
+    """Resolve one conv kernel call's (row_tile, chan_block,
+    batch_fold). Precedence: explicit ``schedule``/``row_tile`` args
+    (the tuner's own timing path and bench sweeps) > the module
+    ``ROW_TILE`` global (set_row_tile) > the on-disk schedule table
+    (trace-time consult, ISSUE 10) > the hand defaults. A table entry
+    that is illegal for the shape (hand-edited/corrupt) counts a
+    fallback and yields the defaults — it must never crash a job."""
+    if schedule is not None:
+        return _sched_parts(schedule, row_tile)
+    if row_tile is not None or ROW_TILE is not None \
+            or _config.get("MXNET_TPU_FUSED_ROW_TILE") not in (None, ""):
+        # every manual override — explicit arg, set_row_tile, or the
+        # env knob — pins the hand plan and beats the table (README
+        # contract: the knob is the debugging escape hatch)
+        return row_tile, None, None
+    from ..tune import make_key, schedule_for
+
+    s = schedule_for("fused_" + kind, key_shape, str(dtype))
+    if not s:
+        return None, None, None
+    n, h, wd, ci, co, k, stride = key_shape
+    ok, _reason = schedule_legal(kind, (n, h, wd, ci), (k, k, ci, co),
+                                 stride, s)
+    if not ok:
+        import jax
+
+        from .. import profiler
+
+        # overwrite the lookup's per-kernel "table" claim: the stored
+        # schedule was REJECTED and the hand defaults ran
+        profiler.tuning_record(
+            fallbacks=1,
+            kernel=make_key("fused_" + kind, key_shape, str(dtype),
+                            jax.default_backend()),
+            schedule=None, source="fallback_illegal")
+        return None, None, None
+    return _sched_parts(s)
+
+
 # ---------------------------------------------------------------------------
 # forward conv (k in {1,3}, stride in {1,2}), BN-apply prologue, stats
 # epilogue
 # ---------------------------------------------------------------------------
 def conv_fwd(x, w, *, stride=1, prologue=None, emit_stats=False,
-             interpret=None, row_tile=None):
+             interpret=None, row_tile=None, schedule=None):
     """NHWC conv: y = conv(act(bn(x)), w).
 
     x: (N, H, W, Ci); w: (k, k, Ci, Co) with k in {1, 3} (pad = k // 2);
     prologue: None or (scale, bias, relu) with (Ci,) f32 vectors —
     per-channel folded BN apply; emit_stats: additionally return a
     (2, Co) f32 [sum, sum_sq] over the *stored* (dtype-cast) output.
-    Returns (y, stats|None).
+    Returns (y, stats|None). ``schedule``: explicit searched
+    {row_tile, chan_block, batch_fold} (the tuner's timing path); when
+    absent and no row-tile override is active, the on-disk schedule
+    table is consulted at trace time (tune.schedule_for) with the hand
+    defaults as fallback.
 
     Grid: (Co-block, batch-block, row-tile); each kernel instance holds
     ``nb`` images and its matmuls are (nb*th*Wo, Ci) @ (Ci, bco).
@@ -383,8 +509,10 @@ def conv_fwd(x, w, *, stride=1, prologue=None, emit_stats=False,
             "fused conv: stride-2 requires even spatial dims, got "
             "(%d, %d)" % (h, wd))
     ho, wo = h // stride, wd // stride
+    rt, cbk, bfd = _schedule_knobs("fwd", (n, h, wd, ci, co, k, stride),
+                                   x.dtype, schedule, row_tile)
     th, ht, rows_in, nb, nbb, bco, cb = _plan_conv(
-        n, ho, wo, ci, co, k, stride, row_tile)
+        n, ho, wo, ci, co, k, stride, rt, cbk, bfd)
     dtype = x.dtype
     has_pro = prologue is not None
     relu = bool(prologue[2]) if has_pro else False
@@ -477,14 +605,15 @@ def conv_fwd(x, w, *, stride=1, prologue=None, emit_stats=False,
 # reconstruction of g riding the g-side read
 # ---------------------------------------------------------------------------
 def conv_wgrad(x, g_parts, w_shape, *, stride=1, x_prologue=None,
-               g_bnbwd=None, interpret=None, row_tile=None):
+               g_bnbwd=None, interpret=None, row_tile=None, schedule=None):
     """dw for conv_fwd, accumulated f32 across the whole grid.
 
     x: (N, H, W, Ci) raw input; g_parts: the complete output gradient
     (N, Ho, Wo, Co) when ``g_bnbwd`` is None, else ``(e, y_raw)`` from
     which dL/dy is reconstructed per tile (see _bnbwd_value);
     w_shape: (k, k, Ci, Co); x_prologue: (scale, bias, relu) BN-apply
-    consts for the x side.
+    consts for the x side; ``schedule``: see conv_fwd (table key
+    ``fused_wgrad``).
 
     Grid: (Co-block, batch-block, row-tile) — Co-block outermost so the
     revisited f32 dw accumulator stays VMEM-resident across the whole
@@ -495,8 +624,10 @@ def conv_wgrad(x, g_parts, w_shape, *, stride=1, x_prologue=None,
     k = int(w_shape[0])
     co = int(w_shape[-1])
     ho, wo = h // stride, wd // stride
+    rt, cbk, bfd = _schedule_knobs("wgrad", (n, h, wd, ci, co, k, stride),
+                                   x.dtype, schedule, row_tile)
     th, ht, rows_in, nb, nbb, bco, cb = _plan_conv(
-        n, ho, wo, ci, co, k, stride, row_tile)
+        n, ho, wo, ci, co, k, stride, rt, cbk, bfd)
     dtype = x.dtype
     has_xpro = x_prologue is not None
     x_relu = bool(x_prologue[2]) if has_xpro else False
@@ -604,7 +735,8 @@ def conv_wgrad(x, g_parts, w_shape, *, stride=1, x_prologue=None,
 # accumulation — the BN-backward input-side partial for the next layer down
 # ---------------------------------------------------------------------------
 def conv_dgrad(g_parts, w, x_shape, *, stride=1, g_bnbwd=None,
-               out_mask=None, extra=None, interpret=None, row_tile=None):
+               out_mask=None, extra=None, interpret=None, row_tile=None,
+               schedule=None):
     """Input gradient of conv_fwd with fused epilogue.
 
     g_parts: complete gradient (N, Ho, Wo, Co), or ``(e, y_raw)`` with
@@ -621,14 +753,17 @@ def conv_dgrad(g_parts, w, x_shape, *, stride=1, g_bnbwd=None,
     join at act1); g2 is a complete gradient at stride2 resolution.
 
     Grid: (Ci-block, batch-block, row-tile); the batch fold rides the
-    matmul row dimension: each call is (nb*rows, Co) @ (Co, bci).
+    matmul row dimension: each call is (nb*rows, Co) @ (Co, bci);
+    ``schedule``: see conv_fwd (table key ``fused_dgrad``).
     """
     n, h, wd, ci = x_shape
     k = int(w.shape[0])
     co = int(w.shape[-1])
     ho, wo = h // stride, wd // stride
+    rt, cbk, bfd = _schedule_knobs("dgrad", (n, h, wd, ci, co, k, stride),
+                                   w.dtype, schedule, row_tile)
     th_in, ht, th_g, nb, nbb, bci, cib = _plan_dgrad(
-        n, h, wd, ci, co, k, stride, row_tile)
+        n, h, wd, ci, co, k, stride, rt, cbk, bfd)
     dtype = w.dtype
 
     # flipped, io-transposed kernel: dgrad = conv(g_stuffed, wflip)

@@ -55,6 +55,12 @@ class TransformerConfig:
     dtype: str = "bfloat16"     # compute dtype (params stay fp32)
     attn: str = "auto"          # auto|ring|ulysses|full
     remat: bool = False
+    # flash-attention schedule parameters (ISSUE 10): None consults the
+    # on-disk schedule table at trace time (tune.schedule_for, keyed by
+    # this model's attention shape/dtype/backend) and falls back to the
+    # MXU-native 128; an explicit value pins the block
+    attn_block_q: int | None = None
+    attn_block_k: int | None = None
 
 
 def init_params(config, seed=0):
@@ -120,8 +126,10 @@ def _layernorm(x, gamma, beta, eps=1e-5):
     return (y * gamma + beta).astype(x.dtype)
 
 
-def _attention(q, k, v, *, axes, causal=True, attn="auto"):
-    """(B, H_loc, S_loc, D) in, same out; sp handled per `attn` mode."""
+def _attention(q, k, v, *, axes, causal=True, attn="auto", blocks=None):
+    """(B, H_loc, S_loc, D) in, same out; sp handled per `attn` mode.
+    ``blocks``: optional (block_q, block_k) flash schedule override —
+    None entries consult the schedule table (kernels/flash_attention)."""
     has_sp = "sp" in axes
     if attn == "auto":
         attn = "ring" if has_sp else "flash"
@@ -130,7 +138,9 @@ def _attention(q, k, v, *, axes, causal=True, attn="auto"):
         # so common head dims (64, 80, ...) all take the O(S)-memory kernel
         if attn == "flash" and jax.default_backend() == "tpu":
             from ..kernels import flash_attention
-            return flash_attention(q, k, v, causal=causal)
+            bq, bk = blocks or (None, None)
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=bq, block_k=bk)
         return full_attention(q, k, v, causal=causal)
     if attn == "full":
         # debug mode: gather the whole sequence onto every sp shard and
@@ -158,7 +168,8 @@ def _block(x, lp, c, axes, cdt):
     h = _layernorm(x, lp["ln1_gamma"], lp["ln1_beta"])
     qkv = jnp.einsum("bsd,dthe->tbhse", h, lp["attn_qkv_weight"].astype(cdt))
     q, k, v = qkv[0], qkv[1], qkv[2]
-    o = _attention(q, k, v, axes=axes, attn=c.attn)
+    o = _attention(q, k, v, axes=axes, attn=c.attn,
+                   blocks=(c.attn_block_q, c.attn_block_k))
     o = jnp.einsum("bhse,hed->bsd", o, lp["attn_out_weight"].astype(cdt))
     if "tp" in axes:
         o = lax.psum(o, "tp")      # row-parallel out-proj

@@ -101,6 +101,9 @@ def dump_profile():
     health = health_stats()
     if health:
         payload["healthStats"] = health
+    tuning = tuning_stats()
+    if tuning:
+        payload["tuningStats"] = tuning
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -377,6 +380,55 @@ def health_reset():
     with _HEALTH_LOCK:
         _HEALTH_EVENTS.clear()
         _HEALTH_SENTINEL.clear()
+
+
+# ---------------------------------------------------------------------------
+# autotuner observability (ISSUE 10): always-on counters for the
+# schedule-table consult path — table hits/misses (one per trace-time
+# schedule_for call, memo'd thereafter per key), fallbacks (a stored
+# schedule rejected as illegal for the shape), and the chosen schedule
+# per kernel key with its source (table vs default). Cheap enough to
+# run unconditionally, like comm_record; rides dump_profile as
+# tuningStats.
+# ---------------------------------------------------------------------------
+_TUNE_LOCK = threading.Lock()
+_TUNE_ZERO = {"hits": 0, "misses": 0, "fallbacks": 0}
+_TUNE = dict(_TUNE_ZERO)
+_TUNE_KERNELS = {}
+
+
+def tuning_record(hits=0, misses=0, fallbacks=0, kernel=None,
+                  schedule=None, source=None):
+    """Accumulate schedule-table counters; ``kernel`` (a table key)
+    additionally records that kernel's chosen schedule + source."""
+    with _TUNE_LOCK:
+        _TUNE["hits"] += hits
+        _TUNE["misses"] += misses
+        _TUNE["fallbacks"] += fallbacks
+        if kernel is not None:
+            _TUNE_KERNELS[kernel] = {"schedule": schedule, "source": source}
+
+
+def tuning_stats(reset=False):
+    """Snapshot {hits, misses, fallbacks, kernels: {key: {schedule,
+    source}}}; empty dict when the consult path never ran."""
+    with _TUNE_LOCK:
+        snap = dict(_TUNE)
+        kernels = {k: dict(v) for k, v in _TUNE_KERNELS.items()}
+        if reset:
+            _TUNE.update(_TUNE_ZERO)
+            _TUNE_KERNELS.clear()
+    if not (any(snap.values()) or kernels):
+        return {}
+    if kernels:
+        snap["kernels"] = kernels
+    return snap
+
+
+def tuning_reset():
+    with _TUNE_LOCK:
+        _TUNE.update(_TUNE_ZERO)
+        _TUNE_KERNELS.clear()
 
 
 def pause():

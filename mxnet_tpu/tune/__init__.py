@@ -1,0 +1,46 @@
+"""Schedule autotuner for the Pallas kernels (ISSUE 10, ROADMAP item 1).
+
+Reference counterpart: the reference framework leaned on cuDNN's
+autotuner (``MXNET_CUDNN_AUTOTUNE_DEFAULT``) and marked the concern
+"subsumed" by XLA in config.py — but the *Pallas* kernels sit below
+XLA's autotuning: their row-tile / channel-block / batch-fold and
+flash-attention block sizes were hand-picked constants. TVM
+(arXiv:1802.04799) showed schedule search over exactly this tile/block
+knob space beats hand schedules, and Relay (arXiv:1810.00952) that the
+payoff compounds when tuned schedules are consulted at bind time rather
+than baked into call sites. This package is that loop for the Pallas
+tier:
+
+- :mod:`.table` — the on-disk schedule table: versioned JSON records
+  keyed by ``(kernel, shape, dtype, backend)``, atomic writes
+  (``checkpoint.atomic_write_bytes``), a process-local memo so the
+  hot-path :func:`schedule_for` lookup is a dict hit, and loud-but-
+  non-fatal handling of corrupt/stale tables (a broken table must
+  never crash a training job — it logs, falls back to the hand
+  defaults, and is rewritten by the next tune).
+- :mod:`.harness` — the loop-amortized single-jitted-``lax.scan``
+  timing harness (the PR 1 measurement half, shared with
+  tools/bench_kernel.py).
+- :mod:`.search` — candidate generation over the existing knob space,
+  pre-timing pruning (illegal tiles and, where the shape can meet it,
+  sub-``MXU_WORK_FLOOR`` candidates — ``mxu_plan`` is the legality/
+  work oracle), round-robin candidate timing, and table commits.
+
+Kernel entry points (``fused_block`` fwd/wgrad/dgrad,
+``flash_attention``) consult :func:`schedule_for` at trace time with
+the current hand defaults as fallback, so an empty table is
+bit-identical to the pre-autotuner behavior. ``tools/tune_kernels.py``
+runs the sweep offline; ``profiler.tuning_stats`` counts table
+hits/misses/fallbacks and records each kernel's chosen schedule.
+"""
+from .table import (ScheduleTable, TABLE_VERSION, default_table_path,
+                    get_table, make_key, reset, schedule_for)
+from .search import (FLASH_BLOCKS, FUSED_KINDS, flash_candidates,
+                     fused_candidates, sweep_flash, sweep_fused)
+
+__all__ = [
+    "ScheduleTable", "TABLE_VERSION", "default_table_path", "get_table",
+    "make_key", "reset", "schedule_for",
+    "FLASH_BLOCKS", "FUSED_KINDS", "flash_candidates", "fused_candidates",
+    "sweep_flash", "sweep_fused",
+]

@@ -1,0 +1,114 @@
+"""Loop-amortized kernel timing harness (the PR 1 measurement half).
+
+One jitted ``lax.scan`` runs the kernel N iterations per timed program
+so dispatch cost amortizes to nothing; a tiny (*1e-30-scaled*) data
+dependence feeds each iteration's output back into the next input so
+XLA cannot hoist or CSE the kernel out of the loop (bit-identical in
+bf16). Originally written in tools/bench_kernel.py (round 6); hoisted
+here so the schedule search (:mod:`.search`) and the benchmark share
+one definition — bench_kernel imports these names back.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def make_run(fn, iters):
+    """The timed program: ``iters`` dependent invocations of ``fn``
+    inside one jitted ``lax.scan`` (first operand is the carry)."""
+    @jax.jit
+    def run(x, rest):
+        def body(c, _):
+            out = fn(c, *rest)
+            lead = jax.tree.leaves(out)[0]
+            dep = (lead.reshape(-1)[0].astype(jnp.float32)
+                   * 1e-30).astype(c.dtype)
+            return c + dep, ()
+        y, _ = lax.scan(body, x, None, length=iters)
+        return y
+    return run
+
+
+def pin_single_core():
+    """Pin the process to one core for CPU harness-validation mode, so
+    the process-CPU clock sees fixed work regardless of how a shared
+    host schedules XLA's worker threads across cores. Shared by
+    tools/bench_kernel.py and tools/tune_kernels.py — one definition,
+    one discipline."""
+    import os
+
+    if not hasattr(os, "sched_setaffinity"):
+        return
+    try:
+        os.sched_setaffinity(0, {sorted(os.sched_getaffinity(0))[0]})
+    except OSError:
+        pass
+
+
+def clock():
+    """Wall time on TPU (the device executes; host noise only shifts
+    the final block_until_ready return). On CPU backends the compute
+    runs in-process and a shared host's steal-time bursts put >60%
+    spread on *fixed* work, so harness-validation mode times process
+    CPU seconds instead — steal-immune, and identical threading for
+    every variant keeps comparisons fair."""
+    return (time.perf_counter if jax.default_backend() == "tpu"
+            else time.process_time)
+
+
+def prepare_run(fn, operands, iters, target_sec=0.5, min_iters=10):
+    """Calibrate + compile + warm one kernel's timed program; returns
+    (run, carry, rest, iters). Calibration uses WALL time (bounds the
+    tool's runtime even when CPU utilization is low); measurement uses
+    :func:`clock`."""
+    x0, rest = operands[0], tuple(operands[1:])
+    if iters is None:
+        probe_n = max(min_iters // 10, 5)
+        probe = make_run(fn, probe_n)
+        probe(x0, rest).block_until_ready()      # compile + warm caches
+        t0 = time.perf_counter()
+        probe(x0, rest).block_until_ready()
+        per = (time.perf_counter() - t0) / probe_n
+        iters = max(min_iters,
+                    min(200000, int(target_sec / max(per, 1e-9))))
+    run = make_run(fn, iters)
+    run(x0, rest).block_until_ready()            # compile + warm caches
+    return run, x0, rest, iters
+
+
+def summarize(runs):
+    """Trimmed mean + spread: shared-CPU hosts show ~65% max-min spread
+    on FIXED numpy work (steal-time bursts + sustained frequency
+    drift), so the extremes measure the machine, not the kernel — drop
+    len//3 runs from each end and report the middle."""
+    n = len(runs)
+    if not n:
+        return 0.0, 0.0
+    trim = max(1, n // 3) if n >= 4 else 0
+    mid = sorted(runs)[trim:-trim] if trim else sorted(runs)
+    mean = sum(mid) / len(mid)
+    spread = (max(mid) - min(mid)) / mean if mean else 0.0
+    return mean, spread
+
+
+def time_round_robin(prepared, repeats):
+    """Interleaved timing of several prepared programs: every repeat of
+    every program samples the same machine-noise epoch, so sustained
+    drift hits all candidates alike and a schedule comparison cannot
+    flip on scheduling luck (the bench_kernel round-robin discipline).
+
+    ``prepared``: [(name, run, x0, rest, iters)];
+    returns {name: [ms_per_iter, ...]}.
+    """
+    clk = clock()
+    runs = {name: [] for name, *_ in prepared}
+    for _ in range(repeats):
+        for name, run, x0, rest, iters in prepared:
+            t0 = clk()
+            run(x0, rest).block_until_ready()
+            runs[name].append((clk() - t0) / iters * 1e3)
+    return runs

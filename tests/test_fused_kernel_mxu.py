@@ -234,3 +234,15 @@ def test_bench_kernel_harness_smoke():
         # the smoke only proves the harness plumbing end-to-end
         assert r["ms_per_iter"] >= 0
         assert r["iters"] >= 3 and len(r["runs_ms"]) == 2
+    # ISSUE 10 satellite: pallas conv records carry the mxu_plan
+    # summary + the schedule-table key, so bench records and table
+    # entries are join-able
+    for name in ("conv3x3_fwd_pallas", "conv1x1_fwd_pallas"):
+        r = rec["bench_kernel"][name]
+        plan = r["mxu_plan"]
+        assert plan["work"] == plan["m"] * plan["k"] * plan["n"]
+        assert len(plan["grid"]) == 3
+        assert r["schedule_key"].startswith("fused_fwd|")
+        assert r["schedule_key"].endswith("|bfloat16|cpu")
+    assert "mxu_plan" not in rec["bench_kernel"]["conv3x3_fwd_xla"]
+    assert rec["tuned"] is False

@@ -34,7 +34,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -43,66 +42,32 @@ import jax            # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax   # noqa: E402
 
+# the loop-amortized timing harness now lives in mxnet_tpu/tune/harness.py
+# (ISSUE 10: the schedule search times candidates with the SAME scan
+# discipline) — imported lazily so `--cpu` platform selection still
+# happens before any backend touch
+def _harness():
+    from mxnet_tpu.tune import harness
+
+    return harness
+
 
 def _make_run(fn, iters):
-    @jax.jit
-    def run(x, rest):
-        def body(c, _):
-            out = fn(c, *rest)
-            lead = jax.tree.leaves(out)[0]
-            dep = (lead.reshape(-1)[0].astype(jnp.float32)
-                   * 1e-30).astype(c.dtype)
-            return c + dep, ()
-        y, _ = lax.scan(body, x, None, length=iters)
-        return y
-    return run
+    return _harness().make_run(fn, iters)
 
 
 def _clock():
-    """Wall time on TPU (the device executes; host noise only shifts
-    the final block_until_ready return). On CPU backends the compute
-    runs in-process and this container's shared host has steal-time
-    bursts that put >60% spread on *fixed* work, so the
-    harness-validation mode times process CPU seconds instead —
-    steal-immune, and identical threading for every variant keeps the
-    comparison fair."""
-    return (time.perf_counter if jax.default_backend() == "tpu"
-            else time.process_time)
+    return _harness().clock()
 
 
 def prepare_run(fn, operands, iters, target_sec=0.5, min_iters=10):
-    """Calibrate + compile + warm one kernel's timed program; returns
-    (run, carry, rest, iters). Calibration uses WALL time (bounds the
-    tool's runtime even when CPU utilization is low); measurement uses
-    ``_clock``."""
-    x0, rest = operands[0], tuple(operands[1:])
-    if iters is None:
-        probe_n = max(min_iters // 10, 5)
-        probe = _make_run(fn, probe_n)
-        probe(x0, rest).block_until_ready()      # compile + warm caches
-        t0 = time.perf_counter()
-        probe(x0, rest).block_until_ready()
-        per = (time.perf_counter() - t0) / probe_n
-        iters = max(min_iters,
-                    min(200000, int(target_sec / max(per, 1e-9))))
-    run = _make_run(fn, iters)
-    run(x0, rest).block_until_ready()            # compile + warm caches
-    return run, x0, rest, iters
+    return _harness().prepare_run(fn, operands, iters,
+                                  target_sec=target_sec,
+                                  min_iters=min_iters)
 
 
 def summarize(runs):
-    """Trimmed mean + spread: this container's shared CPU shows ~65%
-    max-min spread on FIXED numpy work (steal-time bursts + sustained
-    frequency drift), so the extremes measure the machine, not the
-    kernel — drop len//3 runs from each end and report the middle."""
-    n = len(runs)
-    if not n:
-        return 0.0, 0.0
-    trim = max(1, n // 3) if n >= 4 else 0
-    mid = sorted(runs)[trim:-trim] if trim else sorted(runs)
-    mean = sum(mid) / len(mid)
-    spread = (max(mid) - min(mid)) / mean if mean else 0.0
-    return mean, spread
+    return _harness().summarize(runs)
 
 
 def _case_args(batch, hw, ci, co, k):
@@ -170,9 +135,42 @@ def _xla_unit(data, w1, w2, w3, gs, bs, eps=1e-5):
     return y + data
 
 
+def _conv_plan_meta(fb, x_shape, w_shape, tuned=False):
+    """The mxu_plan summary + schedule-table key riding a pallas conv
+    timing record, so bench records and schedule-table entries are
+    join-able by key (ISSUE 10 satellite). Under ``--tuned`` the plan
+    is computed with the schedule the kernel will actually consult —
+    the record must describe the program that was timed."""
+    from mxnet_tpu.tune import get_table, make_key
+    from mxnet_tpu.tune.search import plan_summary
+
+    n, hw, _hw2, ci = x_shape
+    k = int(w_shape[0])
+    co = int(w_shape[-1])
+    key_shape = (n, hw, hw, ci, co, k, 1)
+    sched = None
+    if tuned:
+        sched = get_table().lookup("fused_fwd", key_shape, "bfloat16",
+                                   jax.default_backend(),
+                                   record_stats=False)
+        if sched and not fb.schedule_legal("fwd", x_shape, w_shape, 1,
+                                           sched)[0]:
+            sched = None  # the kernel falls back too (_schedule_knobs)
+    meta = {
+        "mxu_plan": plan_summary(fb.mxu_plan("fwd", x_shape, w_shape,
+                                             stride=1, schedule=sched)),
+        "schedule_key": make_key("fused_fwd", key_shape, "bfloat16",
+                                 jax.default_backend()),
+    }
+    if sched:
+        meta["tuned_schedule"] = sched
+    return meta
+
+
 def build_cases(args, fb, interpret):
-    """(name, fn, operands, flops_per_iter) — fn's first operand is the
-    scan carry."""
+    """(name, fn, operands, flops_per_iter, meta) — fn's first operand
+    is the scan carry; meta (plan summary + schedule key) rides the
+    pallas conv records, None elsewhere."""
     n, hw, ci, co = args.batch, args.hw, args.ci, args.co
     cases = []
 
@@ -182,9 +180,10 @@ def build_cases(args, fb, interpret):
                   lambda x_, w_, s_, b_: fb.conv_fwd(
                       x_, w_, stride=1, prologue=(s_, b_, True),
                       emit_stats=True, interpret=interpret),
-                  (x, w33, scale, bias), fl3))
+                  (x, w33, scale, bias), fl3,
+                  _conv_plan_meta(fb, x.shape, w33.shape, args.tuned)))
     cases.append(("conv3x3_fwd_xla", _xla_conv_fwd,
-                  (x, w33, scale, bias), fl3))
+                  (x, w33, scale, bias), fl3, None))
 
     x1, w11, scale1, bias1 = _case_args(n, hw, ci, co, 1)
     fl1 = 2 * n * hw * hw * ci * co
@@ -192,9 +191,10 @@ def build_cases(args, fb, interpret):
                   lambda x_, w_, s_, b_: fb.conv_fwd(
                       x_, w_, stride=1, prologue=(s_, b_, True),
                       emit_stats=True, interpret=interpret),
-                  (x1, w11, scale1, bias1), fl1))
+                  (x1, w11, scale1, bias1), fl1,
+                  _conv_plan_meta(fb, x1.shape, w11.shape, args.tuned)))
     cases.append(("conv1x1_fwd_xla", _xla_conv_fwd,
-                  (x1, w11, scale1, bias1), fl1))
+                  (x1, w11, scale1, bias1), fl1, None))
 
     data, w1, w2, w3, gs, bs = _unit_args(n, hw, args.unit_cin, ci)
     flu = (2 * n * hw * hw * args.unit_cin * ci * 2
@@ -216,9 +216,9 @@ def build_cases(args, fb, interpret):
         return jax.value_and_grad(loss, argnums=(0, 1, 2, 3))(d_, a1, a2, a3)
 
     cases.append(("unit_fwdbwd_pallas", pallas_unit_fwdbwd,
-                  (data, w1, w2, w3), 3 * flu))
+                  (data, w1, w2, w3), 3 * flu, None))
     cases.append(("unit_fwdbwd_xla", xla_unit_fwdbwd,
-                  (data, w1, w2, w3), 3 * flu))
+                  (data, w1, w2, w3), 3 * flu, None))
     return cases
 
 
@@ -242,21 +242,24 @@ def main(argv=None):
     ap.add_argument("--repeats", type=int, default=9)
     ap.add_argument("--row-tile", type=int, default=None,
                     help="set the fused-kernel row-tile knob for this run")
+    ap.add_argument("--tuned", action="store_true",
+                    help="let the kernels consult the on-disk schedule "
+                         "table (tools/tune_kernels.py winners); default "
+                         "pins the hand schedules so bench records stay "
+                         "comparable across rounds")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU/interpret (harness validation mode)")
     args = ap.parse_args(argv)
 
+    # default-untuned: a populated schedule table on the host must not
+    # silently shift the trajectory numbers (the `tune` bench variant
+    # reports winner-vs-default explicitly)
+    os.environ["MXNET_TPU_TUNE"] = "1" if args.tuned else "0"
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() == "tpu"
-    if not on_tpu and hasattr(os, "sched_setaffinity"):
-        # harness-validation mode: pin to one core so the process-CPU
-        # clock sees fixed work regardless of how the shared host
-        # schedules XLA's worker threads across cores
-        try:
-            os.sched_setaffinity(0, {sorted(os.sched_getaffinity(0))[0]})
-        except OSError:
-            pass
+    if not on_tpu:
+        _harness().pin_single_core()
     # CPU runs validate the harness (variance bound), not kernel speed:
     # interpret-mode Pallas is orders of magnitude off, so default to a
     # small shape and short scan that still gives >=100 ms per timed run
@@ -290,11 +293,11 @@ def main(argv=None):
     # the pallas/xla comparison cannot flip on scheduling luck
     cases = build_cases(args, fb, interpret)
     prepared = []
-    for name, fn, operands, flops in cases:
+    for name, fn, operands, flops, meta in cases:
         run, x0, rest, iters = prepare_run(
             fn, operands, args.iters, target_sec=args.target_sec,
             min_iters=min_iters)
-        prepared.append((name, run, x0, rest, iters, flops))
+        prepared.append((name, run, x0, rest, iters, flops, meta))
     clock = _clock()
 
     # CPU drift normalization: this shared host's effective speed
@@ -313,7 +316,7 @@ def main(argv=None):
     all_runs = {name: [] for name, *_ in prepared}
     all_calib = {name: [] for name, *_ in prepared}
     for _ in range(args.repeats):
-        for name, run, x0, rest, iters, _fl in prepared:
+        for name, run, x0, rest, iters, _fl, _meta in prepared:
             if calib is not None:
                 crun, cx, crest, citers = calib
                 t0 = clock()
@@ -326,7 +329,7 @@ def main(argv=None):
     cmed = cflat[len(cflat) // 2] if cflat else None
 
     summary = {}
-    for name, _run, _x0, _rest, iters, flops in prepared:
+    for name, _run, _x0, _rest, iters, flops, meta in prepared:
         raw = all_runs[name]
         if cmed:
             runs = [r * cmed / c if c else r
@@ -343,6 +346,8 @@ def main(argv=None):
         if cmed:
             rec["drift_normalized"] = True
             rec["raw_runs_ms"] = [round(r, 4) for r in raw]
+        if meta:
+            rec.update(meta)
         summary[name] = rec
         print("%-22s %8.4f ms/iter  %7.2f TFLOP/s  spread %5.2f%%"
               % (name, mean, tflops, spread * 100))
@@ -370,6 +375,7 @@ def main(argv=None):
     print(json.dumps({"bench_kernel": summary, "ratios": ratios,
                       "backend": jax.default_backend(),
                       "row_tile": args.row_tile,
+                      "tuned": bool(args.tuned),
                       "worst_spread_pct": worst}))
     return 0 if worst < 10.0 else 4
 

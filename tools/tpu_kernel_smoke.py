@@ -96,6 +96,13 @@ def main():
                          "per-kernel benchmark (tools/bench_kernel.py) — "
                          "the MXU-ceiling measurement the tpu_watch "
                          "evidence pipeline captures")
+    ap.add_argument("--tune", action="store_true",
+                    help="after the smoke passes, run the schedule sweep "
+                         "(tools/tune_kernels.py): search the row-tile/"
+                         "channel-block/batch-fold and flash block space "
+                         "and commit winners to the on-disk schedule table")
+    ap.add_argument("--tune-budget", type=int, default=None,
+                    help="timed-candidate budget per kernel for --tune")
     args = ap.parse_args()
 
     if args.cpu or args.lower:
@@ -253,6 +260,22 @@ def main():
         print("--- loop-amortized kernel bench ---", flush=True)
         rc = subprocess.call(cmd)
         if rc not in (0, 4):     # 4 = ran, spread above the 10% bar
+            return rc
+    if args.tune and ok and not _LOWER_ONLY:
+        # parity first, search second: tuning a wrong kernel would
+        # cache a schedule for a kernel that must not ship. The sweep's
+        # last stdout line is a JSON report with the search trajectory.
+        import subprocess
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tune_kernels.py")]
+        if args.cpu:
+            cmd.append("--cpu")
+        if args.tune_budget is not None:
+            cmd += ["--budget", str(args.tune_budget)]
+        print("--- schedule sweep ---", flush=True)
+        rc = subprocess.call(cmd)
+        if rc != 0:
             return rc
     return 0 if ok else 1
 

@@ -1,0 +1,174 @@
+"""Offline schedule sweep for the Pallas kernels (ISSUE 10).
+
+Sweeps the fused conv→BN→ReLU family's (row-tile, channel-block,
+batch-fold) space and flash attention's (block_q, block_k) space at one
+shape set, times the surviving candidates with the loop-amortized
+single-jitted-``lax.scan`` harness (mxnet_tpu/tune/harness.py — the
+bench_kernel discipline: round-robin interleaved repeats, trimmed-mean
+spread against the <10% bar), and commits each winner into the on-disk
+schedule table (``MXNET_TPU_TUNE_TABLE`` /
+``~/.cache/mxnet_tpu/schedule_table.json``). Kernel entry points then
+pick the winners up at trace time via ``tune.schedule_for`` — no call
+sites change.
+
+Illegal candidates (tile > dim, non-dividing blocks, VMEM overruns)
+and — where the shape can meet it at all — sub-``MXU_WORK_FLOOR``
+candidates are pruned BEFORE timing; every pruning decision rides the
+``trajectory`` field of the JSON report (the last stdout line, the
+bench.py convention).
+
+Run on a TPU host:
+
+    python tools/tune_kernels.py                  # bench shapes
+    python tools/tune_kernels.py --budget 24      # wider search
+
+A re-run with an already-tuned table is a pure cache hit (zero
+candidate timings — visible in ``profiler.tuning_stats``); ``--force``
+re-searches. On CPU hosts (``--cpu``) the kernels run in interpret
+mode at a reduced default shape: that validates the search mechanics
+(pruning, table commit, cache-hit reload), not TPU schedule quality —
+the table is backend-keyed, so a CPU table never leaks into TPU runs.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+
+def run_sweeps(args, on_tpu):
+    from mxnet_tpu import profiler, tune
+
+    interpret = None if on_tpu else True
+    common = dict(budget=args.budget, repeats=args.repeats,
+                  iters=args.iters, target_sec=args.target_sec,
+                  min_iters=1000 if on_tpu else 5,
+                  interpret=interpret, force=args.force)
+    kernels = args.kernels.split(",")
+    reports = {}
+    x_shape = (args.batch, args.hw, args.hw, args.ci)
+    w_shape = (3, 3, args.ci, args.co)
+    for kernel in kernels:
+        if kernel in tune.FUSED_KINDS:
+            rep = tune.sweep_fused(kernel, x_shape, w_shape,
+                                   stride=args.stride, dtype=args.dtype,
+                                   **common)
+        elif kernel == "flash_attention":
+            rep = tune.sweep_flash(args.flash_batch, args.heads, args.seq,
+                                   args.seq, args.head_dim,
+                                   causal=args.causal,
+                                   dtype=args.flash_dtype, **common)
+        else:
+            raise SystemExit("unknown kernel %r (choose from %s)"
+                             % (kernel, ",".join(tune.FUSED_KINDS
+                                                 + ("flash_attention",))))
+        reports[rep["key"]] = rep
+        if rep["cache_hit"]:
+            print("%-50s cache hit  schedule=%s"
+                  % (rep["key"], rep["winner"]["schedule"]))
+        else:
+            w = rep["winner"]
+            print("%-50s timed %d/%d (pruned %d)  winner=%s  "
+                  "%.4f ms/iter (default %.4f, %.2fx)"
+                  % (rep["key"], rep["n_timed"], rep["n_candidates"],
+                     rep["n_pruned"], w["schedule"], w["ms_per_iter"],
+                     w["default_ms_per_iter"], w["speedup_vs_default"]))
+    return {"tune": reports, "backend": jax.default_backend(),
+            "table": tune.default_table_path(),
+            "tuning_stats": profiler.tuning_stats()}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernels", default=None,
+                    help="comma list: fused_fwd,fused_wgrad,fused_dgrad,"
+                         "flash_attention (default: all)")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--hw", type=int, default=None,
+                    help="conv spatial size (stage-3 default: 14)")
+    ap.add_argument("--ci", type=int, default=None)
+    ap.add_argument("--co", type=int, default=None)
+    ap.add_argument("--stride", type=int, default=1, choices=(1, 2))
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--flash-batch", type=int, default=None)
+    ap.add_argument("--heads", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--head-dim", type=int, default=None)
+    ap.add_argument("--no-causal", dest="causal", action="store_false",
+                    help="sweep non-causal attention instead; the "
+                         "default is causal=True because the wired "
+                         "consumer (models/transformer.py _attention) "
+                         "consults with causal=True — causal is part "
+                         "of the table key")
+    ap.set_defaults(causal=True)
+    ap.add_argument("--flash-dtype", default="bfloat16",
+                    help="flash sweep dtype; must match the consumer's "
+                         "compute dtype (the table key includes it) — "
+                         "TransformerConfig defaults to bfloat16")
+    ap.add_argument("--budget", type=int, default=8,
+                    help="max timed programs per kernel, default "
+                         "baseline included (the rest of the legal "
+                         "space is marked skipped_budget)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=None,
+                    help="scan length per timed program (default: "
+                         "calibrated to ~--target-sec)")
+    ap.add_argument("--target-sec", type=float, default=None)
+    ap.add_argument("--table", default=None,
+                    help="table path (overrides MXNET_TPU_TUNE_TABLE)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-search keys already in the table")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU/interpret (mechanics validation)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if args.table:
+        os.environ["MXNET_TPU_TUNE_TABLE"] = args.table
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        from mxnet_tpu.tune.harness import pin_single_core
+
+        pin_single_core()
+    if args.kernels is None:
+        args.kernels = ",".join(("fused_fwd", "fused_wgrad", "fused_dgrad",
+                                 "flash_attention"))
+    # CPU interpret mode validates mechanics at a reduced shape; TPU
+    # defaults are the bench_kernel stage-3 shapes, so table keys join
+    # with BENCH records
+    if args.batch is None:
+        args.batch = 64 if on_tpu else 2
+    if args.hw is None:
+        args.hw = 14 if on_tpu else 8
+    if args.ci is None:
+        args.ci = 256 if on_tpu else 32
+    if args.co is None:
+        args.co = args.ci
+    if args.flash_batch is None:
+        args.flash_batch = 8 if on_tpu else 2
+    if args.heads is None:
+        args.heads = 8 if on_tpu else 2
+    if args.seq is None:
+        args.seq = 1024 if on_tpu else 64
+    if args.head_dim is None:
+        args.head_dim = 128 if on_tpu else 16
+    if args.target_sec is None:
+        args.target_sec = 0.5 if on_tpu else 0.1
+
+    print("backend: %s  conv: batch=%d hw=%d ci=%d co=%d stride=%d  "
+          "flash: b=%d h=%d seq=%d d=%d  budget=%d repeats=%d"
+          % (jax.default_backend(), args.batch, args.hw, args.ci, args.co,
+             args.stride, args.flash_batch, args.heads, args.seq,
+             args.head_dim, args.budget, args.repeats))
+    report = run_sweeps(args, on_tpu)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
