@@ -70,6 +70,8 @@ def _measure(variant):
         return _measure_fit(n_dev)
     if variant == "serve":
         return _measure_serve()
+    if variant == "fleet":
+        return _measure_fleet()
     if variant == "tune":
         return _measure_tune()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
@@ -235,6 +237,35 @@ def _measure_serve():
         print(json.dumps({"error": "serve: %s" % str(e)[:500]}))
 
 
+def _measure_fleet():
+    """Serving-fleet variant (ISSUE 11): 1 router / 3 replica
+    PROCESSES discovered through the tracker, closed-loop load with a
+    mid-run replica SIGKILL (tools/bench_serve.py --fleet). Tracks
+    req/s scaling 1→3, p99, and the shed/retried/failed split — the
+    acceptance number is failed == 0 across the kill. Scaling is only
+    meaningful with >= 4 cores; the record carries the core count."""
+    try:
+        from tools.bench_serve import measure_fleet
+
+        rec = measure_fleet(replicas=3, clients=16, seconds=4.0)
+        print(json.dumps({
+            "variant": "fleet",
+            "req_s": rec["fleet"]["req_s"],
+            "single_req_s": rec["single"]["req_s"],
+            "scaling": rec["scaling"],
+            "p99_ms": rec["fleet"]["p99_ms"],
+            "failed": rec["fleet"]["failed"],
+            "retried": rec["fleet"]["retried"],
+            "failovers": rec["fleet"]["failovers"],
+            "inflight_lost": rec["fleet"]["inflight_lost"],
+            "shed": rec["fleet"]["shed"],
+            "cores": rec["cores"],
+            "cores_pinned": rec["cores_pinned"],
+        }))
+    except Exception as e:
+        print(json.dumps({"error": "fleet: %s" % str(e)[:500]}))
+
+
 def _measure_tune():
     """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
     space at the bench shapes (tools/tune_kernels.py) and record the
@@ -307,6 +338,9 @@ def _report(results, kernels=None):
     if "serve" in results:
         rec["serve"] = {k: v for k, v in results["serve"].items()
                         if k != "variant"}
+    if "fleet" in results:
+        rec["fleet"] = {k: v for k, v in results["fleet"].items()
+                        if k != "variant"}
     if "tune" in results:
         rec["tune"] = {k: v for k, v in results["tune"].items()
                        if k != "variant"}
@@ -368,8 +402,10 @@ def main():
     # after EVERY success: the driver reads the LAST json line, so even
     # if it kills this process mid-attempt the round still lands a
     # number.
-    for variant in ("unfused", "fused", "fit", "zero", "serve", "tune",
-                    "unfused", "fused", "fit", "zero", "serve", "tune"):
+    for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
+                    "tune",
+                    "unfused", "fused", "fit", "zero", "serve", "fleet",
+                    "tune"):
         if variant in results:
             continue
         if time.time() > deadline - 60:

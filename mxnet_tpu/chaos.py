@@ -61,6 +61,26 @@ Rules:
     exits with the resumable ``EXIT_PREEMPTED`` status; without it the
     default SIGTERM disposition kills the process like a crash.
 
+``replica:R:crash@req=N``  /  ``replica:R:stall@req=N`` (ISSUE 11)
+    Serving-fleet faults, counted in ADMITTED REQUESTS (a replica has
+    no training steps). ``crash`` hard-exits (``os._exit(137)``) at
+    the N-th admitted request — the replica-SIGKILL simulation the
+    router's retry/failover path exists for. ``stall`` wedges request
+    serving from the N-th request ON (the handler blocks; sockets and
+    heartbeats stay open) — the wedged-but-alive replica only the
+    router's per-attempt deadline catches. ``crash`` fires once per
+    incarnation (``restart`` gating as for worker crash); ``stall``
+    defaults to ``restart=any``.
+
+``router:drop@[p=P,seed=S|n=N][,phase=send|reply]`` (ISSUE 11)
+    Connection drop on a matching router→replica forward.
+    ``phase=send`` (default) drops BEFORE the request leaves the
+    router — a never-sent request, retry-safe on any replica
+    regardless of idempotency; ``phase=reply`` drops AFTER the request
+    was delivered but before the reply is read — an in-flight loss,
+    which the router must fail distinctly (``ReplicaConnectionLost``)
+    and retry only for idempotent requests.
+
 A malformed spec raises :class:`FaultSpecError` at parse time — a chaos
 harness that silently no-ops would certify recovery paths that were
 never exercised.
@@ -74,10 +94,12 @@ import sys
 
 _EXIT_CODE = 137  # SIGKILL'd processes report 128+9; crash mimics that
 
-_TARGETS = ("worker", "server", "rpc", "heartbeat")
+_TARGETS = ("worker", "server", "replica", "rpc", "router", "heartbeat")
 _ACTIONS = {"worker": ("crash", "nan", "preempt"),
             "server": ("crash", "preempt"),
-            "rpc": ("drop",), "heartbeat": ("stall",)}
+            "replica": ("crash", "stall"),
+            "rpc": ("drop",), "router": ("drop",),
+            "heartbeat": ("stall",)}
 
 
 class FaultSpecError(ValueError):
@@ -110,7 +132,7 @@ class _Rule:
                 "fault rule %r: unknown target %r (expected one of %s)"
                 % (text, parts[0], "/".join(_TARGETS)))
         self.target = parts[0]
-        if self.target in ("worker", "server"):
+        if self.target in ("worker", "server", "replica"):
             if len(parts) != 3:
                 raise FaultSpecError(
                     "fault rule %r: expected '%s:<rank>:<action>@...'"
@@ -145,14 +167,27 @@ class _Rule:
 
     def _validate(self):
         p = self.params
-        if self.action in ("crash", "nan", "preempt") and "step" not in p:
+        if self.target == "replica":
+            # replica faults count admitted requests, not train steps
+            if "req" not in p:
+                raise FaultSpecError(
+                    "fault rule %r: replica %s requires req=N"
+                    % (self.text, self.action))
+        elif self.action in ("crash", "nan", "preempt") and "step" not in p:
             raise FaultSpecError(
                 "fault rule %r: %s requires step=N"
                 % (self.text, self.action))
-        if self.action == "stall" and "after" not in p:
+        if self.target == "heartbeat" and "after" not in p:
             raise FaultSpecError(
                 "fault rule %r: stall requires after=N" % self.text)
-        for key in ("step", "after", "n", "seed"):
+        if self.target == "router":
+            for bad in ("op", "side"):
+                if bad in p:
+                    raise FaultSpecError(
+                        "fault rule %r: %s only applies to rpc rules "
+                        "(the router drop always targets the "
+                        "router→replica forward)" % (self.text, bad))
+        for key in ("step", "after", "req", "n", "seed"):
             if key in p:
                 _parse_int(self.text, key, p[key])
         if "p" in p:
@@ -223,6 +258,8 @@ class ChaosEngine:
         if rank is None:
             if self.role == "server":
                 rank = os.environ.get("DMLC_SERVER_ID", "0")
+            elif self.role == "replica":
+                rank = os.environ.get("DMLC_REPLICA_ID", "0")
             else:
                 rank = (os.environ.get("DMLC_WORKER_ID")
                         or os.environ.get("DMLC_RANK")
@@ -233,6 +270,7 @@ class ChaosEngine:
         self.restart = int(restart or 0)
         self._step = 0
         self._beats = 0
+        self._reqs = 0
         self._exit = os._exit  # injectable for tests
         self._kill = lambda: os.kill(os.getpid(), signal.SIGTERM)  # ditto
 
@@ -285,6 +323,51 @@ class ChaosEngine:
                       "fired at %s %d step %d (restart %d)"
                       % (rule.text, self.role, self.rank, nxt,
                          self.restart), file=sys.stderr, flush=True)
+                return True
+        return False
+
+    def replica_request(self):
+        """Count one admitted serving request; fire matching replica
+        rules. Returns ``"stall"`` when the handler must wedge (serve
+        nothing, keep the socket open), None otherwise; a matching
+        crash rule never returns."""
+        self._reqs += 1
+        for rule in self.rules:
+            if (rule.target != "replica" or rule.rank != self.rank
+                    or self.role != "replica"):
+                continue
+            if rule.action == "crash" \
+                    and rule.restart_matches(self.restart) \
+                    and self._reqs == int(rule.params["req"]) \
+                    and not rule.fired:
+                rule.fired += 1
+                self._step = self._reqs  # the crash log names a "step"
+                self._crash(rule)
+            elif rule.action == "stall" \
+                    and rule.restart_matches(self.restart, default="any") \
+                    and self._reqs >= int(rule.params["req"]):
+                if not rule.fired:
+                    rule.fired += 1
+                    print("[chaos] wedging replica (stall): rule %r "
+                          "fired at replica %d request %d (restart %d)"
+                          % (rule.text, self.rank, self._reqs,
+                             self.restart), file=sys.stderr, flush=True)
+                return "stall"
+        return None
+
+    def router_drop(self, phase="send"):
+        """True when a matching router:drop rule fires for this
+        router→replica forward attempt."""
+        for rule in self.rules:
+            if rule.target != "router" or rule.action != "drop":
+                continue
+            if not rule.restart_matches(self.restart, default="any"):
+                continue
+            if rule.params.get("phase", "send") != phase:
+                continue
+            if rule.should_fire():
+                print("[chaos] dropping router forward (%s) per rule %r"
+                      % (phase, rule.text), file=sys.stderr, flush=True)
                 return True
         return False
 
@@ -360,6 +443,22 @@ def nan_fault():
 def rpc_fault(op, phase="send", side="client"):
     e = engine()
     return e is not None and e.rpc(op, phase=phase, side=side)
+
+
+def replica_request_fault():
+    """Per-admitted-request replica hook (serving/fleet.py): returns
+    ``"stall"`` to wedge the handler, None otherwise; a matching crash
+    rule hard-exits the process."""
+    e = engine()
+    return e.replica_request() if e is not None else None
+
+
+def router_fault(phase="send"):
+    """True when the router must drop this forward attempt
+    (router:drop rule; phase=send before the request leaves, reply
+    after it was delivered)."""
+    e = engine()
+    return e is not None and e.router_drop(phase=phase)
 
 
 def heartbeat_fault():

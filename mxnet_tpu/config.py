@@ -308,6 +308,41 @@ KNOBS = {
         "60", "honored",
         "seconds submit() may block on backpressure before raising "
         "(serving/broker.py)"),
+    # --- serving fleet (ISSUE 11) ---
+    "MXNET_FLEET_RETRIES": (
+        "2", "honored",
+        "router retry budget per request BEYOND the first attempt: "
+        "never-sent failures and admission rejections (draining/"
+        "closed/overloaded) retry on a DIFFERENT replica, in-flight "
+        "losses retry only for idempotent requests; integer >= 0 "
+        "(serving/fleet.py)"),
+    "MXNET_FLEET_TIMEOUT": (
+        "30", "honored",
+        "per-request end-to-end deadline budget in seconds across ALL "
+        "router attempts (also forwarded to the replica as the "
+        "deadline-at-dequeue shed bound); finite float > 0 "
+        "(serving/fleet.py)"),
+    "MXNET_FLEET_BACKOFF": (
+        "0.05", "honored",
+        "base exponential backoff in seconds between router retry "
+        "attempts (doubles per attempt, capped at 1 s); finite float "
+        ">= 0 (serving/fleet.py)"),
+    "MXNET_FLEET_VIEW_INTERVAL": (
+        "2.0", "honored",
+        "tracker-view refresh period in seconds: the router re-reads "
+        "the replica membership/load gauges, and each replica "
+        "re-publishes its load at the same cadence; finite float > 0 "
+        "(serving/fleet.py)"),
+    "MXNET_FLEET_CONNECT_DEADLINE": (
+        "5.0", "honored",
+        "seconds the router spends connecting to one replica before "
+        "counting the attempt as never-sent and failing over; finite "
+        "float > 0 (serving/fleet.py)"),
+    "MXNET_SERVE_DRAIN_TIMEOUT": (
+        "30", "honored",
+        "seconds a draining replica waits for queued + in-flight "
+        "requests to finish before the drain RPC errors (the rolling "
+        "fleet_swap bound); finite float > 0 (serving/fleet.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

@@ -104,6 +104,9 @@ def dump_profile():
     tuning = tuning_stats()
     if tuning:
         payload["tuningStats"] = tuning
+    fleet = fleet_stats()
+    if fleet:
+        payload["fleetStats"] = fleet
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -429,6 +432,72 @@ def tuning_reset():
     with _TUNE_LOCK:
         _TUNE.update(_TUNE_ZERO)
         _TUNE_KERNELS.clear()
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet observability (ISSUE 11): router-side counters for the
+# multi-replica serving tier — requests routed/completed, retries split
+# by cause (never-sent failover, in-flight loss, draining rejection,
+# overload shed), terminal failures, and a bounded latency reservoir
+# for end-to-end (router-observed) p50/p99. Always-on like comm_record;
+# rides dump_profile as fleetStats.
+# ---------------------------------------------------------------------------
+_FLEET_LOCK = threading.Lock()
+_FLEET_ZERO = {
+    "requests": 0, "completed": 0, "failed": 0, "retries": 0,
+    "failovers": 0, "inflight_lost": 0, "draining_rejections": 0,
+    "overload_rejections": 0, "overloaded": 0, "swaps": 0,
+    "replicas_alive": 0,
+}
+_FLEET = dict(_FLEET_ZERO)
+_FLEET_LAT_CAP = 8192
+_FLEET_LAT = None  # deque, created lazily
+
+
+def fleet_record(latencies=None, replicas_alive=None, **adds):
+    """Accumulate router-side fleet counters (thread-safe).
+    ``replicas_alive`` is a gauge (latest view size); everything else
+    accumulates. Unknown counter names raise — a typo'd counter would
+    silently vanish from the acceptance evidence."""
+    global _FLEET_LAT
+    with _FLEET_LOCK:
+        for k, v in adds.items():
+            if k not in _FLEET_ZERO:
+                raise ValueError("fleet_record: unknown counter %r" % k)
+            _FLEET[k] += int(v)
+        if replicas_alive is not None:
+            _FLEET["replicas_alive"] = int(replicas_alive)
+        if latencies:
+            if _FLEET_LAT is None:
+                from collections import deque
+
+                _FLEET_LAT = deque(maxlen=_FLEET_LAT_CAP)
+            _FLEET_LAT.extend(latencies)
+
+
+def fleet_stats(reset=False):
+    """Snapshot of the router-side fleet counters with derived p50/p99
+    (ms); empty dict when no router ever ran."""
+    global _FLEET_LAT
+    with _FLEET_LOCK:
+        snap = dict(_FLEET)
+        lat = sorted(_FLEET_LAT) if _FLEET_LAT else []
+        if reset:
+            _FLEET.update(_FLEET_ZERO)
+            _FLEET_LAT = None
+    if not any(snap.values()):
+        return {}
+    if lat:
+        snap["p50_ms"] = _percentile_ms(lat, 0.50)
+        snap["p99_ms"] = _percentile_ms(lat, 0.99)
+    return snap
+
+
+def fleet_reset():
+    global _FLEET_LAT
+    with _FLEET_LOCK:
+        _FLEET.update(_FLEET_ZERO)
+        _FLEET_LAT = None
 
 
 def pause():

@@ -9,6 +9,11 @@ forwards, a drain-and-coalesce request broker with backpressure,
 multi-model residency behind one compiled-executable LRU, and
 zero-drop checkpoint hot-swap. ``mxnet_tpu/c_predict.py`` (the C ABI
 backend) binds through the same :class:`AOTPredictor` path.
+
+The fleet tier (ISSUE 11, ``fleet.py``) scales this to N replica
+processes: tracker-discovered :class:`ReplicaServer` endpoints, a
+:class:`FleetRouter` with failure-classified bounded retry, typed
+health-driven draining, and zero-drop rolling checkpoint swap.
 """
 from .predictor import (  # noqa: F401
     AOTPredictor,
@@ -18,4 +23,19 @@ from .predictor import (  # noqa: F401
     env_batch_ladder,
     validate_ladder,
 )
-from .broker import DeadlineExceeded, ModelServer  # noqa: F401
+from .broker import (  # noqa: F401
+    DeadlineExceeded,
+    ModelServer,
+    ReplicaDraining,
+    ServerClosed,
+    ServerOverloaded,
+)
+from .fleet import (  # noqa: F401
+    FleetError,
+    FleetOverloaded,
+    FleetRemoteError,
+    FleetRouter,
+    NoLiveReplica,
+    ReplicaConnectionLost,
+    ReplicaServer,
+)

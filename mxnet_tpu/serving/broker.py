@@ -52,6 +52,27 @@ class DeadlineExceeded(ServingError):
     fast with this error."""
 
 
+class ServerClosed(ServingError):
+    """The request was REJECTED (or failed while still queued) because
+    the server is shutting down — it never executed, so a fleet router
+    may safely resubmit it to a different replica. Distinct from
+    :class:`DeadlineExceeded` (the client gave up) and from genuine
+    request failures (which must not be retried blindly)."""
+
+
+class ReplicaDraining(ServerClosed):
+    """Admission-time rejection from a replica in the ``draining``
+    state (explicit drain RPC or rolling ``fleet_swap``): nothing was
+    executed, in-flight work continues to completion, and the router is
+    expected to retry the request on a different replica."""
+
+
+class ServerOverloaded(ServingError):
+    """Backpressure rejection: the bounded request queue stayed full
+    past the submit timeout. The request never entered the queue, so
+    routing it to a less-loaded replica is always safe."""
+
+
 class _Request:
     __slots__ = ("inputs", "rows", "future", "t_submit", "deadline")
 
@@ -93,13 +114,13 @@ class _ModelWorker:
                         raise ServingError(
                             "model %r: worker died: %r"
                             % (self.name, self._error))
-                    raise ServingError(
+                    raise ServerClosed(
                         "model %r: worker is stopped" % self.name)
                 if len(self._q) < self._depth:
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise ServingError(
+                    raise ServerOverloaded(
                         "model %r: request queue full (%d queued, "
                         "MXNET_SERVE_QUEUE_DEPTH=%d) — backpressure "
                         "timeout" % (self.name, len(self._q), self._depth))
@@ -317,7 +338,7 @@ class ModelServer:
 
     def _check_open(self):
         if self._closed:
-            raise ServingError("ModelServer is closed")
+            raise ServerClosed("ModelServer is closed")
 
     # -- request surface -----------------------------------------------------
     def submit(self, name, inputs, timeout=None, deadline=None):
@@ -393,6 +414,19 @@ class ModelServer:
         """Per-model serving counters (see profiler.serving_stats)."""
         return profiler.serving_stats(reset=reset)
 
+    def pending(self):
+        """Queued requests plus in-flight batches across all resident
+        models — the drain observable: a draining replica admits
+        nothing and waits for this to reach 0 before swapping or
+        deregistering (serving/fleet.py)."""
+        with self._lock:
+            workers = list(self._workers.values())
+        total = 0
+        for w in workers:
+            with w._cond:
+                total += len(w._q) + (1 if w._busy else 0)
+        return total
+
     @property
     def executable_cache(self):
         return self._cache
@@ -400,8 +434,9 @@ class ModelServer:
     # -- lifecycle -----------------------------------------------------------
     def close(self, timeout=5.0):
         """Stop and join every worker (bounded — no leaked daemons),
-        fail still-queued requests. Idempotent; submits after close
-        raise."""
+        fail still-queued requests with the typed :class:`ServerClosed`
+        (they never executed — a router may retry them elsewhere).
+        Idempotent; submits after close raise :class:`ServerClosed`."""
         if self._closed:
             return
         self._closed = True
@@ -412,7 +447,8 @@ class ModelServer:
         deadline = time.monotonic() + timeout
         for w in workers:
             w.join(max(0.0, deadline - time.monotonic()))
-        exc = ServingError("ModelServer closed")
+        exc = ServerClosed("ModelServer closed before the request was "
+                           "dispatched")
         for w in workers:
             w.fail_pending(exc)
 

@@ -262,9 +262,18 @@ def connect_with_backoff(uri, deadline=30.0, base_delay=0.05, max_delay=2.0):
 # ---------------------------------------------------------------------------
 # scheduler
 # ---------------------------------------------------------------------------
+#: roles whose ranks come from the fixed worker/server slot pools sized
+#: by DMLC_NUM_WORKER/DMLC_NUM_SERVER. Every OTHER role (the serving
+#: fleet's ``replica``, a routing/admin client, ...) registers slot-free:
+#: ranks are assigned from an unbounded per-role counter, the node never
+#: consumes a worker/server slot, and its death never counts toward the
+#: training job's ``num_dead_node`` parity (ISSUE 11 satellite).
+SLOTTED_ROLES = ("worker", "server")
+
+
 class _Node:
     __slots__ = ("node_id", "role", "rank", "addr", "last_beat", "alive",
-                 "done", "replaced", "restart")
+                 "done", "replaced", "restart", "info")
 
     def __init__(self, node_id, role, rank, addr, restart=0):
         self.node_id = node_id
@@ -276,6 +285,7 @@ class _Node:
         self.done = False
         self.replaced = False   # a respawn took over this node's rank
         self.restart = restart  # incarnation number (DMLC_RESTART_COUNT)
+        self.info = {}          # published metadata (serving load gauge)
 
 
 class Tracker:
@@ -328,8 +338,12 @@ class Tracker:
 
     # -- state helpers (lock held) -------------------------------------------
     def _num_dead_locked(self):
+        # only worker/server deaths count: num_dead_node is the TRAINING
+        # job's parity signal (ref ps-lite get_num_dead_node) — a dead
+        # serving replica is the router's problem, not the optimizer's
         return sum(1 for n in self._nodes.values()
-                   if not n.alive and not n.done and not n.replaced)
+                   if n.role in SLOTTED_ROLES
+                   and not n.alive and not n.done and not n.replaced)
 
     def _servers_locked(self):
         return sorted((n for n in self._nodes.values()
@@ -387,6 +401,12 @@ class Tracker:
         respawned holds the job open — tearing the servers down while
         the launcher is mid-respawn would turn a recoverable crash into
         a job failure."""
+        if self._num_workers <= 0:
+            # a serving-fleet tracker (launch.py --serve): no training
+            # workers exist, so "all workers done" is vacuously true on
+            # the FIRST done/dead event — the fleet is torn down
+            # explicitly (stop op / launcher), never by worker count
+            return
         workers = [n for n in self._nodes.values()
                    if n.role == "worker" and not n.replaced]
         if len(workers) < self._num_workers or self._fanned_out:
@@ -445,18 +465,26 @@ class Tracker:
 
     def _op_register(self, conn_nodes, p):
         role = p.get("role")
-        if role not in ("worker", "server"):
+        if not isinstance(role, str) or not role or role == "scheduler":
             raise ValueError("register: bad role %r" % (role,))
         want = p.get("rank")
         restart = int(p.get("restart") or 0)
         addr = p.get("addr")
-        limit = (self._num_workers if role == "worker"
-                 else self._num_servers)
+        info = p.get("info")
+        if info is not None and not isinstance(info, dict):
+            raise ValueError("register: info must be a dict")
+        # slotted roles draw ranks from the fixed worker/server pools;
+        # every other role (replica, ...) is slot-free: unbounded
+        # per-role ranks, no effect on the training topology's counts
+        limit = None
+        if role in SLOTTED_ROLES:
+            limit = (self._num_workers if role == "worker"
+                     else self._num_servers)
         with self._cv:
             node = None
             if want is not None:
                 want = int(want)
-                if want < 0 or want >= limit:
+                if want < 0 or (limit is not None and want >= limit):
                     raise ValueError(
                         "register: rank %d out of range for %d %ss"
                         % (want, limit, role))
@@ -526,13 +554,19 @@ class Tracker:
                     self._cv.wait(timeout=0.1)
             if node is None:
                 taken = {n.rank for n in self._role_nodes_locked(role)}
-                rank = next((r for r in range(limit) if r not in taken),
-                            None)
-                if rank is None:
-                    raise ValueError(
-                        "register: all %d %s ranks already assigned"
-                        % (limit, role))
+                if limit is None:
+                    rank = next(r for r in range(len(taken) + 1)
+                                if r not in taken)
+                else:
+                    rank = next((r for r in range(limit)
+                                 if r not in taken), None)
+                    if rank is None:
+                        raise ValueError(
+                            "register: all %d %s ranks already assigned"
+                            % (limit, role))
                 node = self._new_node_locked(role, rank, addr, restart)
+            if info:
+                node.info = dict(info)
             conn_nodes.add(node.node_id)
             self._cv.notify_all()
         return {"node_id": node.node_id, "rank": node.rank,
@@ -649,12 +683,41 @@ class Tracker:
         self._lifecycle(event, **clean)
         return None
 
+    def _op_publish(self, p):
+        """Replace a node's published metadata (the serving fleet's
+        load gauge / draining state): replicas re-publish on every
+        heartbeat interval and on hot-swap, routers read it through
+        ``members``."""
+        nid = p.get("node_id")
+        info = p.get("info")
+        if not isinstance(info, dict):
+            raise ValueError("publish: info must be a dict")
+        with self._cv:
+            node = self._nodes.get(nid)
+            if node is None:
+                raise ValueError("publish: unknown node %r" % (nid,))
+            node.info = dict(info)
+            self._cv.notify_all()
+        return None
+
+    def _op_members(self, p):
+        """Live view of one role's nodes (default ``replica``) with
+        their published info — the FleetRouter's discovery surface."""
+        role = p.get("role", "replica")
+        with self._cv:
+            return [{"node_id": n.node_id, "rank": n.rank, "addr": n.addr,
+                     "alive": n.alive, "done": n.done,
+                     "restart": n.restart, "info": dict(n.info)}
+                    for n in self._nodes.values()
+                    if n.role == role and not n.replaced]
+
     def _op_nodes(self):
         """Topology snapshot (debugging / tests)."""
         with self._cv:
             return [{"node_id": n.node_id, "role": n.role, "rank": n.rank,
                      "addr": n.addr, "alive": n.alive, "done": n.done,
-                     "replaced": n.replaced, "restart": n.restart}
+                     "replaced": n.replaced, "restart": n.restart,
+                     "info": dict(n.info)}
                     for n in self._nodes.values()]
 
     def _dispatch(self, conn_nodes, op, p):
@@ -672,6 +735,10 @@ class Tracker:
             return self._op_num_dead()
         if op == "event":
             return self._op_event(p)
+        if op == "publish":
+            return self._op_publish(p)
+        if op == "members":
+            return self._op_members(p)
         if op == "nodes":
             return self._op_nodes()
         raise ValueError("unknown op %r" % (op,))
@@ -775,7 +842,8 @@ class TrackerClient:
 
     def __init__(self, uri, role, addr=None,
                  connect_deadline=30.0,
-                 heartbeat_interval=None, rank=None, restart_count=0):
+                 heartbeat_interval=None, rank=None, restart_count=0,
+                 info=None):
         self._uri = uri
         self._lock = threading.Lock()
         self._closed = threading.Event()
@@ -792,6 +860,8 @@ class TrackerClient:
             payload["rank"] = int(rank)
         if restart_count:
             payload["restart"] = int(restart_count)
+        if info is not None:
+            payload["info"] = dict(info)
         # a respawning registration may wait TAKEOVER_WAIT server-side
         # for its dead predecessor; give the rpc room beyond that
         info = self._rpc("register", payload,
@@ -868,6 +938,17 @@ class TrackerClient:
 
     def nodes(self):
         return self._rpc("nodes")
+
+    def publish(self, info):
+        """Replace this node's published metadata on the scheduler (the
+        replica load gauge / draining state; see ``members``)."""
+        self._rpc("publish", {"node_id": self.node_id,
+                              "info": dict(info)}, timeout=10.0)
+
+    def members(self, role="replica"):
+        """One role's nodes with their published info — the router's
+        discovery view."""
+        return self._rpc("members", {"role": role})
 
     def log_event(self, event, **fields):
         """Report a lifecycle event into the scheduler's timeline log

@@ -43,6 +43,15 @@ def test_parses_the_issue_spec_verbatim():
     "worker:0:preempt@",            # preempt without step
     "server:0:nan@step=1",          # nan is worker-only (one grad)
     "rpc:nan@step=1",               # nan is not an rpc action
+    # ISSUE 11 serving-fleet kinds
+    "replica:0:crash@step=5",       # replica faults count REQUESTS
+    "replica:0:stall@after=5",      # ditto (req=, not after=)
+    "replica:crash@req=5",          # missing rank
+    "replica:0:preempt@req=5",      # preempt is not a replica action
+    "router:drop@op=push",          # op is an rpc-rule filter
+    "router:drop@side=server",      # side is an rpc-rule filter
+    "router:drop@phase=later",      # bad phase
+    "router:0:drop@n=1",            # router rules carry no rank
 ])
 def test_malformed_specs_raise(bad):
     with pytest.raises(FaultSpecError):
@@ -141,6 +150,58 @@ def test_heartbeat_stall_after():
     eng = ChaosEngine("heartbeat:stall@after=2", role="worker", rank=0)
     assert [eng.heartbeat() for _ in range(5)] == \
         [False, False, True, True, True]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: serving-fleet fault kinds
+# ---------------------------------------------------------------------------
+def test_replica_crash_fires_at_exact_request_once():
+    eng = ChaosEngine("replica:1:crash@req=3", role="replica", rank=1,
+                      restart=0)
+    exits = []
+    eng._exit = exits.append
+    assert [eng.replica_request() for _ in range(2)] == [None, None]
+    eng.replica_request()
+    assert exits == [137], "must fire exactly at request 3"
+    # wrong rank / wrong role: never fires
+    for role, rank in (("replica", 0), ("worker", 1)):
+        other = ChaosEngine("replica:1:crash@req=1", role=role, rank=rank)
+        other._exit = lambda code: (_ for _ in ()).throw(AssertionError(
+            "crash fired for %s:%d" % (role, rank)))
+        for _ in range(3):
+            other.replica_request()
+    # default restart=0: the respawned incarnation does not re-crash
+    respawn = ChaosEngine("replica:1:crash@req=3", role="replica",
+                          rank=1, restart=1)
+    respawn._exit = lambda code: (_ for _ in ()).throw(AssertionError(
+        "crash re-fired in restart incarnation"))
+    for _ in range(5):
+        respawn.replica_request()
+
+
+def test_replica_stall_wedges_from_request_on():
+    eng = ChaosEngine("replica:0:stall@req=3", role="replica", rank=0)
+    assert [eng.replica_request() for _ in range(5)] == \
+        [None, None, "stall", "stall", "stall"]
+    # stall defaults to restart=any: a respawn of a wedging replica
+    # wedges again (the fault is environmental, not incarnation-bound)
+    again = ChaosEngine("replica:0:stall@req=1", role="replica", rank=0,
+                        restart=2)
+    assert again.replica_request() == "stall"
+
+
+def test_router_drop_count_and_phase():
+    eng = ChaosEngine("router:drop@n=2,phase=reply", role="worker",
+                      rank=0)
+    assert not eng.router_drop("send"), "phase filter must hold"
+    assert [eng.router_drop("reply") for _ in range(4)] == \
+        [True, True, False, False]
+    # seed-deterministic probabilistic drops, like rpc:drop
+    e1 = ChaosEngine("router:drop@p=0.4,seed=7", role="worker", rank=0)
+    e2 = ChaosEngine("router:drop@p=0.4,seed=7", role="worker", rank=0)
+    seq1 = [e1.router_drop() for _ in range(64)]
+    assert seq1 == [e2.router_drop() for _ in range(64)]
+    assert any(seq1) and not all(seq1)
 
 
 def test_env_engine_and_reset(monkeypatch):

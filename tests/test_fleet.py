@@ -1,0 +1,664 @@
+"""Serving fleet (ISSUE 11): tracker-discovered replicas, retrying
+router, health-driven draining, zero-drop rolling swap.
+
+Default tier is subprocess-free: routing/retry/backoff/selection units
+run against a FAKED tracker view (``view_fn`` seam) with a stubbed
+forward, and the draining state machine / typed wire errors / rolling
+swap run against REAL in-process ReplicaServers (threads + loopback
+sockets) behind an in-process Tracker.
+
+The slow tier adds the ISSUE acceptance e2e: 1 router / 3 replica
+PROCESSES under load survive a replica SIGKILL with zero failed
+requests and complete a rolling ``fleet_swap`` — plus the chaos_check
+replica-crash case through ``launch.py --serve`` supervision.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.model import save_checkpoint
+from mxnet_tpu.serving import (
+    DeadlineExceeded,
+    FleetError,
+    FleetOverloaded,
+    FleetRemoteError,
+    FleetRouter,
+    ModelServer,
+    NoLiveReplica,
+    ReplicaConnectionLost,
+    ReplicaDraining,
+    ReplicaServer,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+from mxnet_tpu.serving.fleet import _NeverSent
+from mxnet_tpu.tracker import Tracker
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RNG = np.random.RandomState(0)
+DIM = 5
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet_stats():
+    profiler.fleet_reset()
+    profiler.serving_reset()
+    yield
+    profiler.fleet_reset()
+    profiler.serving_reset()
+
+
+def _linear(seed=1):
+    rng = np.random.RandomState(seed)
+    out = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    args = {"fc_weight": rng.randn(4, DIM).astype(np.float32),
+            "fc_bias": rng.randn(4).astype(np.float32)}
+    return out, args
+
+
+def _expected(x, a):
+    return x @ a["fc_weight"].T + a["fc_bias"]
+
+
+def _make_replica(tracker, sym, args, rank=None, publish_interval=0.2):
+    srv = ModelServer(ladder=(1, 4))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))  # compile warmup
+    rep = ReplicaServer(srv, tracker_uri=tracker.addr, rank=rank,
+                        publish_interval=publish_interval)
+    rep.serve_in_background()
+    return rep
+
+
+@pytest.fixture
+def fleet():
+    """In-process tracker + 2 replicas serving the seed-1 linear
+    model, and a fast-refresh router."""
+    trk = Tracker(num_workers=0, num_servers=0, heartbeat_timeout=2.0)
+    trk.serve_in_background()
+    sym, args = _linear(seed=1)
+    reps = [_make_replica(trk, sym, args) for _ in range(2)]
+    router = FleetRouter(tracker_uri=trk.addr, view_interval=0.2,
+                         timeout=15.0)
+    yield {"tracker": trk, "replicas": reps, "router": router,
+           "sym": sym, "args": args}
+    router.close()
+    for rep in reps:
+        rep.shutdown()
+    trk.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# knob validation (satellite: strict accessors, loud failure)
+# ---------------------------------------------------------------------------
+def test_fleet_knob_validation(monkeypatch):
+    view = lambda: []  # noqa: E731
+    for name, bad in [("MXNET_FLEET_RETRIES", "-1"),
+                      ("MXNET_FLEET_RETRIES", "two"),
+                      ("MXNET_FLEET_TIMEOUT", "0"),
+                      ("MXNET_FLEET_TIMEOUT", "nan"),
+                      ("MXNET_FLEET_BACKOFF", "-0.5"),
+                      ("MXNET_FLEET_VIEW_INTERVAL", "0"),
+                      ("MXNET_FLEET_CONNECT_DEADLINE", "abc")]:
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(MXNetError, match=name):
+            FleetRouter(view_fn=view)
+        monkeypatch.delenv(name)
+    # the drain knob is read replica-side
+    monkeypatch.setenv("MXNET_SERVE_DRAIN_TIMEOUT", "-3")
+    srv = ModelServer(ladder=(1,))
+    try:
+        with pytest.raises(MXNetError, match="MXNET_SERVE_DRAIN_TIMEOUT"):
+            ReplicaServer(srv)
+    finally:
+        srv.close()
+    monkeypatch.delenv("MXNET_SERVE_DRAIN_TIMEOUT")
+    with pytest.raises(FleetError, match="exactly one"):
+        FleetRouter()
+    with pytest.raises(FleetError, match="exactly one"):
+        FleetRouter(view_fn=view, replicas=["127.0.0.1:1"])
+
+
+# ---------------------------------------------------------------------------
+# typed errors (satellite: ServerClosed / ReplicaDraining vs
+# DeadlineExceeded — test both router-retry paths)
+# ---------------------------------------------------------------------------
+def test_close_fails_queued_futures_with_typed_server_closed():
+    sym, args = _linear()
+    srv = ModelServer(ladder=(1, 4))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))
+    worker = srv._workers["m"]
+    x = np.zeros((1, DIM), np.float32)
+    with worker._exec_lock:  # wedge the worker mid-batch
+        f0 = srv.submit("m", x)
+        deadline = time.monotonic() + 10
+        while not worker._busy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        queued = srv.submit("m", x)  # stays queued behind the wedge
+        srv.close(timeout=0.2)
+    with pytest.raises(ServerClosed):
+        queued.result(timeout=30)
+    with pytest.raises(ServerClosed):
+        srv.submit("m", x)
+    del f0
+    # the type distinctions the router's retry contract rests on
+    assert issubclass(ReplicaDraining, ServerClosed)
+    assert not issubclass(DeadlineExceeded, ServerClosed)
+    assert not issubclass(ServerClosed, DeadlineExceeded)
+    assert issubclass(ServerOverloaded, ServingError)
+
+
+def _stub_router(view, forward):
+    """Router over a faked view with a stubbed wire forward."""
+    router = FleetRouter(view_fn=lambda: view, retries=2, timeout=10.0,
+                         backoff=0.0, view_interval=0.05)
+    router._forward = forward
+    return router
+
+
+def _entry(addr, rank, state="serving", alive=True, queued=0,
+           models=("m",)):
+    return {"addr": addr, "rank": rank, "alive": alive, "done": False,
+            "node_id": rank,
+            "info": {"state": state, "queued": queued,
+                     "models": list(models)}}
+
+
+def test_drained_rejection_is_retried_but_genuine_failure_is_not():
+    """Satellite 3, both paths: a typed draining/closed rejection is
+    safely retried on a DIFFERENT replica; a genuine request failure
+    surfaces immediately, unretried."""
+    view = [_entry("a:1", 0), _entry("b:2", 1)]
+    calls = []
+
+    def forward(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        if h.addr == "a:1":
+            raise ReplicaDraining("a:1 draining")
+        return ["ok"]
+
+    router = _stub_router(view, forward)
+    assert router.request("m", np.zeros((1, DIM), np.float32)) == ["ok"]
+    assert calls == ["a:1", "b:2"], "retry must pick the OTHER replica"
+    assert profiler.fleet_stats()["draining_rejections"] == 1
+
+    calls.clear()
+
+    def forward_fail(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        raise FleetRemoteError("bad_request", "unknown input")
+
+    router2 = _stub_router(view, forward_fail)
+    with pytest.raises(FleetRemoteError):
+        router2.request("m", np.zeros((1, DIM), np.float32))
+    assert len(calls) == 1, "genuine failures must never be retried"
+
+
+def test_never_sent_retries_even_non_idempotent():
+    view = [_entry("a:1", 0), _entry("b:2", 1)]
+    calls = []
+
+    def forward(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        if len(calls) == 1:
+            raise _NeverSent("connect refused")
+        return ["ok"]
+
+    router = _stub_router(view, forward)
+    out = router.request("m", np.zeros((1, DIM), np.float32),
+                         idempotent=False)
+    assert out == ["ok"] and len(calls) == 2
+    stats = profiler.fleet_stats()
+    assert stats["failovers"] == 1 and stats["failed"] == 0
+
+
+def test_inflight_loss_retries_only_idempotent():
+    view = [_entry("a:1", 0), _entry("b:2", 1)]
+    calls = []
+
+    def forward(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        if len(calls) == 1:
+            raise ReplicaConnectionLost("sent, no reply")
+        return ["ok"]
+
+    router = _stub_router(view, forward)
+    with pytest.raises(ReplicaConnectionLost):
+        router.request("m", np.zeros((1, DIM), np.float32),
+                       idempotent=False)
+    assert len(calls) == 1, "non-idempotent in-flight loss: no retry"
+    assert profiler.fleet_stats()["inflight_lost"] == 1
+
+    calls.clear()
+    router2 = _stub_router(view, forward)
+    assert router2.request("m", np.zeros((1, DIM), np.float32)) == ["ok"]
+    assert calls == ["a:1", "b:2"], "idempotent loss retries elsewhere"
+
+
+def test_overload_raises_typed_fleet_overloaded():
+    view = [_entry("a:1", 0), _entry("b:2", 1)]
+    calls = []
+
+    def forward(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        raise ServerOverloaded("queue full")
+
+    router = _stub_router(view, forward)
+    with pytest.raises(FleetOverloaded, match="retry budget"):
+        router.request("m", np.zeros((1, DIM), np.float32))
+    assert len(calls) == 3  # first attempt + 2 retries
+    stats = profiler.fleet_stats()
+    assert stats["overload_rejections"] == 3 and stats["failed"] == 1
+    # a replica-side deadline shed routes through the same typed path
+    router2 = _stub_router(view, lambda *a: (_ for _ in ()).throw(
+        DeadlineExceeded("shed at dequeue")))
+    with pytest.raises(FleetOverloaded):
+        router2.request("m", np.zeros((1, DIM), np.float32))
+
+
+def test_no_live_replica_is_typed():
+    router = _stub_router([_entry("a:1", 0, state="draining"),
+                           _entry("b:2", 1, alive=False)],
+                          lambda *a: ["never"])
+    with pytest.raises(NoLiveReplica):
+        router.request("m", np.zeros((1, DIM), np.float32))
+    with pytest.raises(NoLiveReplica):
+        _stub_router([], lambda *a: ["never"]).request(
+            "m", np.zeros((1, DIM), np.float32))
+
+
+def test_least_loaded_selection_and_model_filter():
+    view = [_entry("a:1", 0, queued=5), _entry("b:2", 1, queued=1),
+            _entry("c:3", 2, queued=0, state="draining"),
+            _entry("d:4", 3, queued=0, alive=False),
+            _entry("e:5", 4, queued=0, models=("other",))]
+    calls = []
+
+    def forward(h, model, wire, attempt_timeout, remaining):
+        calls.append(h.addr)
+        return ["ok"]
+
+    router = _stub_router(view, forward)
+    router.request("m", np.zeros((1, DIM), np.float32))
+    # b:2 (least queued among live serving replicas holding 'm');
+    # draining/dead replicas and other models never considered
+    assert calls == ["b:2"]
+    # router-local in-flight counts on top of the published gauge
+    with router._handles["b:2"]._lock:
+        router._handles["b:2"].inflight += 10
+    calls.clear()
+    router.request("m", np.zeros((1, DIM), np.float32))
+    assert calls == ["a:1"]
+
+
+def test_backoff_grows_and_respects_budget():
+    view = [_entry("a:1", 0)]
+    t0 = time.perf_counter()
+    router = FleetRouter(view_fn=lambda: view, retries=2, timeout=10.0,
+                         backoff=0.05, view_interval=0.05)
+    router._forward = lambda *a: (_ for _ in ()).throw(
+        ServerOverloaded("full"))
+    with pytest.raises(FleetOverloaded):
+        router.request("m", np.zeros((1, DIM), np.float32))
+    elapsed = time.perf_counter() - t0
+    assert elapsed >= 0.05 + 0.10, "exponential backoff must sleep"
+    # a tight budget cuts the retry loop off early with the typed error
+    router2 = FleetRouter(view_fn=lambda: view, retries=50, timeout=0.3,
+                          backoff=0.05, view_interval=0.05)
+    router2._forward = lambda *a: (_ for _ in ()).throw(
+        ServerOverloaded("full"))
+    t0 = time.perf_counter()
+    with pytest.raises(FleetOverloaded, match="budget"):
+        router2.request("m", np.zeros((1, DIM), np.float32))
+    assert time.perf_counter() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# the real thing: in-process replicas behind an in-process tracker
+# ---------------------------------------------------------------------------
+def test_fleet_routes_and_matches_reference(fleet):
+    router, args = fleet["router"], fleet["args"]
+    for rows in (1, 3):
+        x = RNG.randn(rows, DIM).astype(np.float32)
+        out = router.request("m", x)
+        np.testing.assert_allclose(out[0], _expected(x, args),
+                                   rtol=1e-5, atol=1e-5)
+    stats = profiler.fleet_stats()
+    assert stats["completed"] == 2 and stats["failed"] == 0
+    assert stats["replicas_alive"] == 2
+
+
+def test_drain_state_machine_over_the_wire(fleet):
+    router, reps = fleet["router"], fleet["replicas"]
+    rep0 = reps[0]
+    # occupy rep0 with an in-flight request, then drain it: the drain
+    # must wait for the in-flight work, reject new admissions with the
+    # typed error, and resume cleanly
+    worker = rep0._server._workers["m"]
+    x = RNG.randn(1, DIM).astype(np.float32)
+    drain_done = []
+    with worker._exec_lock:  # holds rep0's batch mid-execution
+        fut = rep0._server.submit("m", x)
+        deadline = time.monotonic() + 10
+        while not worker._busy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t = threading.Thread(
+            target=lambda: drain_done.append(router.drain(rep0.addr)))
+        t.start()
+        deadline = time.monotonic() + 10
+        while rep0._state != "draining" \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert rep0._state == "draining"
+        assert not drain_done, "drain must wait for in-flight work"
+        # a direct wire submit to the draining replica is rejected
+        # with the typed error (the router's admin path sees it raw)
+        with pytest.raises(ReplicaDraining):
+            router._admin_rpc(rep0.addr, "predict", {
+                "model": "m",
+                "inputs": {"__single__":
+                           ("float32", (1, DIM), x.tobytes())}})
+    t.join(timeout=30)
+    assert drain_done == [{"state": "drained"}]
+    fut.result(timeout=30)  # in-flight work finished, not dropped
+    # routed traffic survives the drained replica transparently
+    for _ in range(4):
+        router.request("m", x)
+    assert profiler.fleet_stats()["failed"] == 0
+    router.resume(rep0.addr)
+    assert rep0._state == "serving"
+    info = router.replica_stats(rep0.addr)["info"]
+    assert info["state"] == "serving"
+
+
+def test_wire_inflight_loss_classification(fleet):
+    """A wedged replica (exec lock held, request submitted) trips the
+    per-attempt deadline as ReplicaConnectionLost — the distinct
+    in-flight failure — and a non-idempotent request refuses to
+    retry it."""
+    router, reps = fleet["router"], fleet["replicas"]
+    # wedge BOTH replicas so the router cannot silently succeed
+    locks = [rep._server._workers["m"]._exec_lock for rep in reps]
+    x = RNG.randn(1, DIM).astype(np.float32)
+    for lk in locks:
+        lk.acquire()
+    try:
+        with pytest.raises(ReplicaConnectionLost):
+            router.request("m", x, timeout=1.5, idempotent=False)
+    finally:
+        for lk in locks:
+            lk.release()
+    assert profiler.fleet_stats()["inflight_lost"] >= 1
+    # fleet recovers once the wedge clears
+    router.request("m", x)
+
+
+def test_rolling_fleet_swap_zero_drop(fleet, tmp_path):
+    """The ISSUE choreography in miniature: traffic flows while
+    fleet_swap drains/swaps/resumes each replica in turn — zero
+    drops, zero errors, every response is exactly old-or-new."""
+    router, sym = fleet["router"], fleet["sym"]
+    args1 = fleet["args"]
+    _, args2 = _linear(seed=7)
+    prefix = str(tmp_path / "v2")
+    from mxnet_tpu import nd
+
+    save_checkpoint(prefix, 3, sym,
+                    {k: nd.array(v) for k, v in args2.items()}, {})
+    collected, errors = [], []
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(25):
+                x = rng.randn(rng.randint(1, 4), DIM).astype(np.float32)
+                collected.append((x, router.request("m", x)))
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(40 + i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while len(collected) < 10 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    swapped = router.fleet_swap(prefix=prefix, epoch=3)
+    for t in threads:
+        t.join()
+    assert swapped == 2
+    assert not errors, errors
+    assert len(collected) == 100  # zero dropped
+    n_old = n_new = 0
+    for x, res in collected:
+        if np.allclose(res[0], _expected(x, args1), atol=1e-4):
+            n_old += 1
+        else:
+            np.testing.assert_allclose(res[0], _expected(x, args2),
+                                       rtol=1e-4, atol=1e-4)
+            n_new += 1
+    assert n_new > 0, "the swap landed while traffic flowed"
+    # post-swap requests all serve the NEW weights
+    x = RNG.randn(2, DIM).astype(np.float32)
+    np.testing.assert_allclose(router.request("m", x)[0],
+                               _expected(x, args2), rtol=1e-4, atol=1e-4)
+    stats = profiler.fleet_stats()
+    assert stats["swaps"] == 2 and stats["failed"] == 0
+    # the replicas republished their bumped swap generation
+    router.refresh_view(force=True)
+    with router._view_lock:
+        gens = [h.info.get("swap_gen") for h in
+                router._handles.values()]
+    assert gens == [1, 1]
+
+
+def test_fleet_stats_ride_dump_profile(fleet, tmp_path):
+    import json
+
+    router = fleet["router"]
+    router.request("m", RNG.randn(1, DIM).astype(np.float32))
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(filename=fname)
+    try:
+        profiler.dump_profile()
+    finally:
+        profiler.profiler_set_config(filename="profile.json")
+    with open(fname) as f:
+        trace = json.load(f)
+    stats = trace["fleetStats"]
+    assert stats["requests"] == 1 and stats["completed"] == 1
+    assert "p50_ms" in stats and stats["replicas_alive"] == 2
+
+
+def test_static_replica_list_discovery(fleet):
+    """Tracker-less mode: a static address list, refreshed by pinging
+    each replica (drain visibility included)."""
+    reps = fleet["replicas"]
+    router = FleetRouter(replicas=[r.addr for r in reps],
+                         view_interval=0.1, timeout=10.0)
+    try:
+        x = RNG.randn(2, DIM).astype(np.float32)
+        np.testing.assert_allclose(
+            router.request("m", x)[0], _expected(x, fleet["args"]),
+            rtol=1e-5, atol=1e-5)
+        reps[0].drain()
+        time.sleep(0.15)
+        router.refresh_view(force=True)
+        states = dict((a, s) for a, s, _al, _l in router.replicas())
+        assert states[reps[0].addr] == "drained"
+        router.request("m", x)  # still routable via replica 1
+        reps[0].resume()
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the ISSUE acceptance e2e (replica PROCESSES)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_e2e_sigkill_and_rolling_swap(tmp_path):
+    """1 router / 3 replica processes under threaded load: a replica
+    SIGKILL costs NOTHING beyond retried in-flight requests (zero
+    failures surface), and a rolling fleet_swap under load completes
+    with zero drops — every response matches old-or-new weights. The
+    >= 2.5x 1→3 scaling half of the acceptance needs >= 4 cores (each
+    replica is its own process); on smaller hosts the ratio is
+    reported by tools/bench_serve.py --fleet instead (cores recorded
+    in the bench line)."""
+    import signal
+    import subprocess
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from bench_serve import _spawn_replica
+
+    from mxnet_tpu import nd
+
+    sym, args1 = _linear(seed=1)
+    _, args2 = _linear(seed=7)
+    prefix1 = str(tmp_path / "v1")
+    prefix2 = str(tmp_path / "v2")
+    save_checkpoint(prefix1, 0, sym,
+                    {k: nd.array(v) for k, v in args1.items()}, {})
+    save_checkpoint(prefix2, 0, sym,
+                    {k: nd.array(v) for k, v in args2.items()}, {})
+
+    trk = Tracker(num_workers=0, num_servers=0, heartbeat_timeout=2.0)
+    trk.serve_in_background()
+    procs = [_spawn_replica(r, trk.addr, prefix1, DIM, (1, 4))
+             for r in range(3)]
+    router = FleetRouter(tracker_uri=trk.addr, view_interval=0.3,
+                         timeout=20.0)
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            router.refresh_view(force=True)
+            if sum(1 for _a, s, alive, _l in router.replicas()
+                   if alive and s == "serving") >= 3:
+                break
+            assert time.monotonic() < deadline, "fleet never came up"
+            time.sleep(0.25)
+
+        stop = threading.Event()
+        collected, errors = [], []
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            while not stop.is_set():
+                x = rng.randn(rng.randint(1, 4), DIM) \
+                    .astype(np.float32)
+                try:
+                    collected.append((x, router.request("model", x)))
+                except Exception as e:
+                    errors.append("%s: %s" % (type(e).__name__, e))
+
+        threads = [threading.Thread(target=client, args=(60 + i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+
+        # phase 1: SIGKILL a replica mid-load — zero failed requests
+        # beyond in-flight (in-flight losses retry elsewhere)
+        deadline = time.monotonic() + 30
+        while len(collected) < 50 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        procs[2].send_signal(signal.SIGKILL)
+        n_at_kill = len(collected)
+        deadline = time.monotonic() + 30
+        while len(collected) < n_at_kill + 100 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not errors, errors[:3]
+
+        # phase 2: rolling swap across the surviving fleet, under load
+        swapped = router.fleet_swap(prefix=prefix2, epoch=0)
+        assert swapped == 2
+        deadline = time.monotonic() + 30
+        n_at_swap = len(collected)
+        while len(collected) < n_at_swap + 30 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+
+        n_old = n_new = 0
+        for x, res in collected:
+            if np.allclose(res[0], _expected(x, args1), atol=1e-4):
+                n_old += 1
+            else:
+                np.testing.assert_allclose(
+                    res[0], _expected(x, args2), rtol=1e-4, atol=1e-4)
+                n_new += 1
+        assert n_old > 0 and n_new > 0
+        stats = profiler.fleet_stats()
+        assert stats["failed"] == 0
+        assert stats["failovers"] + stats["inflight_lost"] >= 1, \
+            "the kill must have been absorbed by the retry path"
+        # post-swap: only new weights
+        x = RNG.randn(2, DIM).astype(np.float32)
+        np.testing.assert_allclose(
+            router.request("model", x)[0], _expected(x, args2),
+            rtol=1e-4, atol=1e-4)
+    finally:
+        try:
+            router.stop_fleet()
+        except Exception:
+            pass
+        router.close()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        trk.shutdown()
+
+
+@pytest.mark.slow
+def test_fleet_scaling_1_to_3(tmp_path):
+    """The throughput half of the acceptance: >= 2.5x req/s from 1→3
+    replicas. Each replica is a PROCESS, so the ratio is only
+    measurable with >= 4 cores — smaller hosts skip (the bench line
+    records the ratio + core count for the trajectory either way)."""
+    cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
+    if cores < 4:
+        pytest.skip("1→3 replica-process scaling needs >= 4 cores, "
+                    "host has %d (bench_serve --fleet records the "
+                    "measured ratio regardless)" % cores)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from bench_serve import measure_fleet
+
+    rec = measure_fleet(replicas=3, clients=16, seconds=5.0)
+    assert rec["fleet"]["failed"] == 0
+    assert rec["scaling"] >= 2.5, rec
+
+
+@pytest.mark.slow
+def test_chaos_check_serve_cases_pass():
+    """The launch.py --serve supervision loop under the injected
+    replica crash: chaos_check's serve case asserts the failover, the
+    free respawn path, the heal, and rc 0."""
+    import subprocess
+
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_check.py"),
+         "--spec", "replica:0:crash@req=10", "--timeout", "90"],
+        env=clean_dist_env(repo_root=ROOT), capture_output=True,
+        text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "chaos_check[serve]: OK" in proc.stdout

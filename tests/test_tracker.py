@@ -41,6 +41,56 @@ def test_register_assigns_ranks_per_role(tracker):
         c.close()
 
 
+def test_replica_role_is_slot_free(tracker):
+    """ISSUE 11 satellite: non-worker/server roles (the serving
+    fleet's ``replica``) never consume worker/server rank slots and
+    never count toward num_dead_node parity. Pins the rank-assignment
+    invariant: replica ranks are an independent, unbounded sequence."""
+    reps = [TrackerClient(tracker.addr, "replica",
+                          addr="127.0.0.1:%d" % (9000 + i))
+            for i in range(3)]  # MORE replicas than worker slots (2)
+    assert [r.rank for r in reps] == [0, 1, 2]
+    # worker/server pools are untouched: both worker slots still free
+    w0 = TrackerClient(tracker.addr, "worker")
+    w1 = TrackerClient(tracker.addr, "worker")
+    assert (w0.rank, w1.rank) == (0, 1)
+    with pytest.raises(TrackerError, match="already assigned"):
+        TrackerClient(tracker.addr, "worker")
+    # a replica death never disturbs the training job's parity signal
+    reps[2].close()  # conn drop => dead
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        members = w0.members("replica")
+        if any(not m["alive"] for m in members):
+            break
+        time.sleep(0.05)
+    assert any(not m["alive"] for m in w0.members("replica"))
+    assert w0.num_dead_node() == 0  # replica deaths excluded
+    for c in (reps[0], reps[1], w0, w1):
+        c.close()
+
+
+def test_replica_publish_and_members_roundtrip(tracker):
+    """Replicas publish a load-gauge info dict at registration and
+    re-publish updates; ``members`` exposes it to routers."""
+    r = TrackerClient(tracker.addr, "replica", addr="127.0.0.1:9100",
+                      info={"state": "serving", "queued": 0})
+    w = TrackerClient(tracker.addr, "worker")
+    (m,) = w.members("replica")
+    assert m["addr"] == "127.0.0.1:9100"
+    assert m["info"] == {"state": "serving", "queued": 0}
+    r.publish({"state": "draining", "queued": 7})
+    (m,) = w.members("replica")
+    assert m["info"] == {"state": "draining", "queued": 7}
+    assert w.members("worker")[0]["info"] == {}
+    with pytest.raises(TrackerError, match="info must be a dict"):
+        r._rpc("publish", {"node_id": r.node_id, "info": [1, 2]})
+    with pytest.raises(TrackerError, match="bad role"):
+        TrackerClient(tracker.addr, "scheduler")
+    for c in (r, w):
+        c.close()
+
+
 def test_server_uri_publication_blocks_until_rendezvous(tracker):
     """get_server_uris arriving BEFORE the server registers must wait
     for it (process start order is arbitrary), then deliver its URI."""

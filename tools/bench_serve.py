@@ -212,6 +212,192 @@ def _nd(v):
     return nd.array(v)
 
 
+# ---------------------------------------------------------------------------
+# fleet mode (ISSUE 11): N replica PROCESSES behind a FleetRouter,
+# discovered through an in-process tracker — req/s scaling 1→R, p99,
+# shed/retried/failed counts, with a mid-run replica SIGKILL.
+# ---------------------------------------------------------------------------
+REPLICA_BOOT_CODE = ("import sys; from mxnet_tpu.serving import fleet; "
+                     "sys.exit(fleet.main())")
+
+
+def _spawn_replica(rank, coord, prefix, dim, ladder, pin_core=None):
+    """One replica subprocess (CPU-pinned when asked: on a shared host
+    per-replica core pinning is what makes process-level scaling
+    measurable at all)."""
+    import subprocess
+
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_dist_env(repo_root=root)
+    host, port = coord.rsplit(":", 1)
+    env.update({"DMLC_ROLE": "replica", "DMLC_REPLICA_ID": str(rank),
+                "DMLC_PS_ROOT_URI": host, "DMLC_PS_ROOT_PORT": port})
+    cmd = [sys.executable, "-c", REPLICA_BOOT_CODE, "replica",
+           "--prefix", prefix, "--epoch", "0",
+           "--data-shape", "data:1,%d" % dim,
+           "--ladder", ",".join(str(b) for b in ladder)]
+    if pin_core is not None:
+        cmd += ["--pin-core", str(pin_core)]
+    return subprocess.Popen(cmd, env=env)
+
+
+def _fleet_client(router, stop_at, think_s, dim, rows, seed, out):
+    """Closed-loop fleet client: think (Exp), route, record. Typed
+    overload (FleetOverloaded/shed) is counted separately from genuine
+    failures — the acceptance number is failed == 0."""
+    import numpy as np
+
+    from mxnet_tpu.serving import FleetOverloaded
+
+    rng = random.Random(seed)
+    nrng = np.random.RandomState(seed)
+    x = nrng.randn(rows, dim).astype(np.float32)
+    lat, overloaded, errors = [], 0, []
+    while time.perf_counter() < stop_at:
+        if think_s > 0:
+            time.sleep(rng.expovariate(1.0 / think_s))
+        t0 = time.perf_counter()
+        try:
+            router.request("model", x, timeout=20.0)
+            lat.append(time.perf_counter() - t0)
+        except FleetOverloaded:
+            overloaded += 1
+        except Exception as e:
+            errors.append("%s: %s" % (type(e).__name__, e))
+    out.append((lat, overloaded, errors))
+
+
+def run_fleet_mode(prefix, dim, num_replicas, clients, seconds, think_ms,
+                   rows=1, ladder=(1, 4, 16), kill_mid_run=False,
+                   pin_cores=False):
+    """Measure one fleet size; returns (record, stats)."""
+    import signal as _signal
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetRouter
+    from mxnet_tpu.tracker import Tracker
+
+    cores = sorted(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else []
+    tracker = Tracker(num_workers=0, num_servers=0)
+    tracker.serve_in_background()
+    procs = [_spawn_replica(
+        r, tracker.addr, prefix, dim, ladder,
+        pin_core=cores[r % len(cores)]
+        if pin_cores and len(cores) >= num_replicas else None)
+        for r in range(num_replicas)]
+    profiler.fleet_reset()
+    router = FleetRouter(tracker_uri=tracker.addr, view_interval=0.5,
+                         timeout=20.0)
+    try:
+        deadline = time.monotonic() + 120
+        while sum(1 for _a, s, alive, _l in router.replicas()
+                  if alive and s == "serving") < num_replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never came up: %s"
+                                   % (router.replicas(),))
+            time.sleep(0.25)
+            router.refresh_view(force=True)
+        results = []
+        t0 = time.perf_counter()
+        stop_at = t0 + seconds
+        threads = [threading.Thread(
+            target=_fleet_client,
+            args=(router, stop_at, think_ms / 1e3, dim, rows, 2000 + i,
+                  results)) for i in range(clients)]
+        for t in threads:
+            t.start()
+        killed = None
+        if kill_mid_run:
+            time.sleep(seconds / 2.0)
+            victim = procs[-1]
+            victim.send_signal(_signal.SIGKILL)
+            killed = {"pid": victim.pid,
+                      "at_s": round(time.perf_counter() - t0, 2)}
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lats = sorted(x for lat, _o, _e in results for x in lat)
+        overloaded = sum(o for _l, o, _e in results)
+        errors = [e for _l, _o, es in results for e in es]
+        stats = profiler.fleet_stats(reset=True)
+        rec = {
+            "replicas": num_replicas,
+            "req_s": round(len(lats) / wall, 1),
+            "requests": len(lats),
+            "failed": len(errors),
+            "failed_examples": errors[:3],
+            "overloaded": overloaded,
+            "retried": stats.get("retries", 0),
+            "failovers": stats.get("failovers", 0),
+            "inflight_lost": stats.get("inflight_lost", 0),
+            "shed": stats.get("overload_rejections", 0),
+            "p50_ms": round(_pctl(lats, 0.50) * 1e3, 2) if lats else None,
+            "p99_ms": round(_pctl(lats, 0.99) * 1e3, 2) if lats else None,
+        }
+        if killed is not None:
+            rec["killed"] = killed
+        return rec
+    finally:
+        try:
+            router.stop_fleet()
+        except Exception:
+            pass
+        router.close()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        tracker.shutdown()
+
+
+def measure_fleet(replicas=3, clients=24, seconds=6.0, think_ms=1.0,
+                  dim=128, hidden=256, layers=4, classes=32, rows=1):
+    """The --fleet record: req/s at 1 replica vs N replicas (each its
+    own process, core-pinned when the host has enough cores), with a
+    mid-run SIGKILL of one replica during the N-replica window. The
+    scaling ratio is only meaningful with >= replicas+1 cores — the
+    record carries the core count so the trajectory tooling can tell a
+    regression from a small host."""
+    import jax
+
+    from mxnet_tpu.model import save_checkpoint
+
+    symbol, args_np = build_model(dim, hidden, layers, classes)
+    tmpdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, symbol,
+                    {k: _nd(v) for k, v in args_np.items()}, {})
+    cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
+    pin = cores >= replicas + 1
+    single = run_fleet_mode(prefix, dim, 1, clients, seconds, think_ms,
+                            rows=rows, pin_cores=pin)
+    fleet = run_fleet_mode(prefix, dim, replicas, clients, seconds,
+                           think_ms, rows=rows, kill_mid_run=True,
+                           pin_cores=pin)
+    rec = {
+        "metric": "fleet_serving_throughput",
+        "value": fleet["req_s"],
+        "unit": "req/s",
+        "scaling": round(fleet["req_s"] / single["req_s"], 2)
+        if single["req_s"] else None,
+        "single": single,
+        "fleet": fleet,
+        "clients": clients,
+        "seconds": seconds,
+        "think_ms": think_ms,
+        "cores": cores,
+        "cores_pinned": pin,
+        "model": {"dim": dim, "hidden": hidden, "layers": layers},
+        "backend": jax.default_backend(),
+    }
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=32)
@@ -227,11 +413,22 @@ def main():
     ap.add_argument("--deadline-ms", type=float, default=25.0,
                     help="per-request deadline for the overload "
                          "measurement (0 disables it)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode (ISSUE 11): req/s scaling 1→"
+                         "--replicas replica PROCESSES behind a "
+                         "FleetRouter, with a mid-run replica SIGKILL")
+    ap.add_argument("--replicas", type=int, default=3)
     args = ap.parse_args()
-    rec = measure(clients=args.clients, seconds=args.seconds,
-                  think_ms=args.think_ms, dim=args.dim,
-                  hidden=args.hidden, layers=args.layers, rows=args.rows,
-                  deadline_ms=args.deadline_ms)
+    if args.fleet:
+        rec = measure_fleet(replicas=args.replicas, clients=args.clients,
+                            seconds=args.seconds, think_ms=args.think_ms,
+                            dim=args.dim, hidden=args.hidden,
+                            layers=args.layers, rows=args.rows)
+    else:
+        rec = measure(clients=args.clients, seconds=args.seconds,
+                      think_ms=args.think_ms, dim=args.dim,
+                      hidden=args.hidden, layers=args.layers,
+                      rows=args.rows, deadline_ms=args.deadline_ms)
     print(json.dumps(rec))
 
 

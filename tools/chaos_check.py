@@ -30,11 +30,25 @@ case as a ``slow``-marked test; the nan/preempt cases have their own
 slow-tier tests. ``--matrix`` sweeps all four kinds in one invocation
 for manual/nightly use.
 
+Serving-fleet kinds (ISSUE 11; ``replica:``/``router:`` specs run a
+``launch.py --serve`` 2-replica fleet with an in-process FleetRouter
+driving requests instead of a training job):
+
+- ``replica:R:crash@req=N``: the SIGKILL-equivalent replica death —
+  the router fails over (every request still succeeds), launch.py
+  respawns the replica, the job exits 0;
+- ``replica:R:stall@req=N``: the wedged-but-heartbeating replica —
+  the per-attempt deadline fires (``inflight_lost`` > 0), idempotent
+  retries land elsewhere, zero failed requests;
+- ``router:drop@...``: injected router→replica connection drops
+  (driver-side spec) — dropped forwards are retried, zero failed.
+
 Usage:
     python tools/chaos_check.py                      # worker crash
     python tools/chaos_check.py --spec 'server:0:crash@step=130'
     python tools/chaos_check.py --spec 'worker:0:nan@step=16'
     python tools/chaos_check.py --spec 'worker:1:preempt@step=16'
+    python tools/chaos_check.py --spec 'replica:1:crash@req=10'
     python tools/chaos_check.py --matrix             # all of the above
 """
 import argparse
@@ -44,6 +58,8 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+sys.path.insert(0, ROOT)
 
 MATRIX = [
     "worker:1:crash@step=18",
@@ -52,10 +68,195 @@ MATRIX = [
     "worker:1:preempt@step=16",
 ]
 
+#: serving-fleet fault kinds (ISSUE 11): driven through a launch.py
+#: --serve fleet + an in-process FleetRouter instead of a training job
+SERVE_MATRIX = [
+    # rank 0 on purpose: the least-loaded tie-break sends a sequential
+    # driver's traffic to rank 0, so the fault deterministically fires
+    "replica:0:crash@req=10",
+    "replica:0:stall@req=10",
+    # n=2 on purpose: the default retry budget is 2, so the first
+    # request eats both injected drops and SUCCEEDS on its third
+    # attempt — n=3 would (correctly) exhaust the budget and fail it
+    "router:drop@n=2,phase=reply",
+]
+
 
 def _kind(spec):
     m = re.search(r":(crash|nan|preempt)@", spec)
     return m.group(1) if m else "crash"
+
+
+def _is_serve_spec(spec):
+    return spec.startswith(("replica:", "router:"))
+
+
+def run_serve_case(args, spec):
+    """One serving-fleet fault case: 2-replica launch.py --serve fleet,
+    a router drives requests under the injected fault, and the case
+    passes only when EVERY request succeeded (the reaction path —
+    failover / per-attempt timeout / idempotent retry — actually ran,
+    asserted via the fleet counters) and the job exits 0."""
+    import json as _json
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from bench_serve import REPLICA_BOOT_CODE, build_model
+    from mxnet_tpu import chaos
+    from mxnet_tpu.model import save_checkpoint
+    from mxnet_tpu import nd
+    from mxnet_tpu.serving import FleetRouter
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    dim = 16
+    sym, model_args = build_model(dim, 32, 2, 4)
+    tmpdir = tempfile.mkdtemp(prefix="chaos_fleet_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, sym,
+                    {k: nd.array(v) for k, v in model_args.items()}, {})
+
+    env = clean_dist_env(repo_root=ROOT)
+    router_side = spec.startswith("router:")
+    if not router_side:
+        env["MXNET_FAULT_SPEC"] = spec  # replica faults live fleet-side
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "--serve", "-n", "2", "--max-restarts",
+           str(args.max_restarts), "--coordinator", coord,
+           "--timeout", str(args.timeout),
+           sys.executable, "-c", REPLICA_BOOT_CODE, "replica",
+           "--prefix", prefix, "--epoch", "0",
+           "--data-shape", "data:1,%d" % dim, "--ladder", "1,4"]
+    print("chaos_check[serve]: %s  (MXNET_FAULT_SPEC=%s, %s-side)"
+          % (" ".join(cmd), spec,
+             "router" if router_side else "replica"), flush=True)
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    reader = {"out": ""}
+
+    def _drain():
+        reader["out"] = proc.stdout.read()
+
+    t = threading.Thread(target=_drain, daemon=True)
+    t.start()
+
+    failures = []
+    engine = None
+    stats = {}
+    router = None
+    if router_side:
+        os.environ["MXNET_FAULT_SPEC"] = spec
+    chaos.reset_engine()
+    try:
+        from mxnet_tpu import profiler
+
+        profiler.fleet_reset()
+        router = FleetRouter(tracker_uri=coord, view_interval=0.5,
+                             timeout=15.0)
+        deadline = _time.monotonic() + 60
+        while sum(1 for _a, st, alive, _l in router.replicas()
+                  if alive and st == "serving") < 2:
+            if _time.monotonic() > deadline:
+                raise RuntimeError("fleet never came up")
+            _time.sleep(0.25)
+            router.refresh_view(force=True)
+        x = np.zeros((1, dim), np.float32)
+        errors = []
+        for i in range(30):
+            try:
+                router.request("model", x)
+            except Exception as e:
+                errors.append("req %d: %s: %s"
+                              % (i, type(e).__name__, e))
+        if spec.startswith("replica:") and ":crash@" in spec:
+            # the crashed replica respawns under --max-restarts: wait
+            # for the fleet to HEAL back to 2 serving replicas and
+            # prove the respawn takes traffic again (also keeps
+            # stop_fleet from racing a mid-respawn registration)
+            deadline = _time.monotonic() + 60
+            while True:
+                # refresh BEFORE counting: the stale view still shows
+                # the just-crashed replica as serving
+                router.refresh_view(force=True)
+                if sum(1 for _a, st, alive, _l in router.replicas()
+                       if alive and st == "serving") >= 2:
+                    break
+                if _time.monotonic() > deadline:
+                    failures.append("fleet never healed back to 2 "
+                                    "serving replicas after the crash")
+                    break
+                _time.sleep(0.25)
+            for i in range(5):
+                try:
+                    router.request("model", x)
+                except Exception as e:
+                    errors.append("post-heal req %d: %s: %s"
+                                  % (i, type(e).__name__, e))
+        stats = profiler.fleet_stats()
+        engine = chaos.engine()
+        if errors:
+            failures.append("requests failed under %r: %s"
+                            % (spec, errors[:3]))
+        if spec.startswith("replica:") and ":crash@" in spec:
+            if not (stats.get("failovers", 0)
+                    or stats.get("inflight_lost", 0)):
+                failures.append("crash never forced a failover "
+                                "(fleet counters all zero)")
+        elif spec.startswith("replica:") and ":stall@" in spec:
+            if not stats.get("inflight_lost", 0):
+                failures.append("stall never tripped the per-attempt "
+                                "deadline (inflight_lost == 0)")
+        elif router_side:
+            if not (engine and any(r.matched for r in engine.rules)):
+                failures.append("router:drop rule never fired")
+            if not stats.get("retries", 0):
+                failures.append("dropped forwards were never retried")
+    except Exception as e:
+        # a setup failure (fleet never booted, driver crashed) is a
+        # per-case FAIL, not an abort of the remaining matrix — and
+        # must still tear the fleet down below
+        failures.append("driver failed: %s: %s" % (type(e).__name__, e))
+    finally:
+        if router is not None:
+            try:
+                router.stop_fleet()
+            except Exception:
+                pass
+            router.close()
+        if router_side:
+            os.environ.pop("MXNET_FAULT_SPEC", None)
+            chaos.reset_engine()
+    try:
+        rc = proc.wait(timeout=args.timeout + 30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+    t.join(timeout=10)
+    out = reader["out"]
+    sys.stdout.write(out)
+    if rc != 0:
+        failures.append("fleet job exited %d" % rc)
+    if not router_side and "[chaos]" not in out:
+        failures.append("fault spec never fired (no [chaos] line)")
+    if spec.startswith("replica:") and ":crash@" in spec:
+        if "respawning" not in out:
+            failures.append("crashed replica was never respawned")
+    if failures:
+        print("chaos_check[serve]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[serve]: OK — fleet survived %r (counters: %s)"
+          % (spec, _json.dumps({k: v for k, v in stats.items()
+                                if v and not k.endswith("_ms")})))
+    return 0
 
 
 def run_case(args, spec):
@@ -138,7 +339,9 @@ def main():
                          "(default: kill worker 1 mid-epoch)")
     ap.add_argument("--matrix", action="store_true",
                     help="run the full fault matrix (crash, nan, "
-                         "preempt) instead of a single --spec")
+                         "preempt, plus the serving-fleet replica "
+                         "crash/stall and router drop kinds) instead "
+                         "of a single --spec")
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--max-restarts", type=int, default=1)
@@ -146,10 +349,13 @@ def main():
                     help="launch.py watchdog per case (seconds)")
     args = ap.parse_args()
 
-    specs = MATRIX if args.matrix else [args.spec]
+    specs = (MATRIX + SERVE_MATRIX) if args.matrix else [args.spec]
     rc = 0
     for spec in specs:
-        rc |= run_case(args, spec)
+        if _is_serve_spec(spec):
+            rc |= run_serve_case(args, spec)
+        else:
+            rc |= run_case(args, spec)
     if args.matrix:
         print("chaos_check: matrix %s" % ("FAIL" if rc else "OK"))
     return rc

@@ -30,11 +30,24 @@ job fails cleanly with a per-node exit summary instead of hanging.
 Deterministic fault injection for testing: ``MXNET_FAULT_SPEC``
 (mxnet_tpu/chaos.py).
 
+**Serving fleet** (``--serve``, ISSUE 11): the N primary processes are
+inference REPLICAS instead of training workers — one scheduler
+(discovery plane) + N copies of your replica command
+(``DMLC_ROLE=replica``, ``DMLC_REPLICA_ID=rank``), each registering
+slot-free with the tracker so a ``FleetRouter`` discovers and routes
+to them. The same supervision applies: ``--max-restarts`` respawns a
+crashed replica with its old rank, exit-75 respawns are free. The job
+ends when every replica exits (normally via the router's fleet
+``stop``), after which the launcher stops the tracker itself.
+
 Usage (reference-compatible):
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
     python tools/launch.py -n 2 -s 1 python train.py --kv-store dist_async
     python tools/launch.py -n 2 -s 1 --max-restarts 1 \\
         python train.py --kv-store dist_async
+    python tools/launch.py --serve -n 3 --max-restarts 2 \\
+        python -m mxnet_tpu.serving.fleet replica \\
+        --prefix ckpt --epoch 0 --data-shape data:1,128
 
 Modes:
     --launcher local  (default) all processes on this host, each seeing
@@ -122,6 +135,14 @@ def _role_env(args, coord, role, rank=0):
         env["DMLC_RANK"] = str(rank)
         env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
         env["MXNET_TPU_WORKER_ID"] = str(rank)
+    if role == "replica":
+        env["DMLC_REPLICA_ID"] = str(rank)
+    if getattr(args, "serve", False):
+        # serving-fleet mode (--serve): replicas are slot-free tracker
+        # members — DMLC_NUM_WORKER=0 (every role, incl. the scheduler)
+        # keeps the tracker from fanning out shutdown on worker
+        # bookkeeping that does not exist; the launcher stops it
+        env["DMLC_NUM_WORKER"] = "0"
     return _apply_env_overrides(env, args)
 
 
@@ -148,6 +169,15 @@ def _print_env(env, keys_prefix=("MXNET_TPU_", "MXNET_KVSTORE_", "DMLC_",
 
 
 def _manual(args, coord):
+    if getattr(args, "serve", False):
+        print("# --- scheduler (run first, one process) ---")
+        _print_env(_role_env(args, coord, "scheduler"))
+        print("# run: %s -m mxnet_tpu.tracker" % sys.executable)
+        print("# --- replica i (i = 0..%d) ---" % (args.num_workers - 1))
+        _print_env(_role_env(args, coord, "replica", 0),
+                   rank_keys=("DMLC_REPLICA_ID",))
+        print("# run: %s" % " ".join(args.command))
+        return 0
     if args.num_servers <= 0:
         print("# export on host i (i = 0..%d):" % (args.num_workers - 1))
         _print_env(_serverless_worker_env(args, coord, 0),
@@ -224,18 +254,43 @@ def _print_exit_summary(nodes, out=None):
         print("launch.py:   %s" % node, file=out)
 
 
+def _stop_tracker(args, coord):
+    """Best-effort 'stop' to the scheduler over its own wire (serve
+    mode: with DMLC_NUM_WORKER=0 no worker-done fan-out ever stops it)."""
+    code = ("from mxnet_tpu.tracker import connect_with_backoff, "
+            "_send_msg, _recv_msg\n"
+            "s = connect_with_backoff(%r, deadline=5.0)\n"
+            "_send_msg(s, ('stop', None))\n"
+            "_recv_msg(s)\n" % coord)
+    try:
+        subprocess.run([sys.executable, "-c", code],
+                       env=_base_env(args, coord), timeout=15,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+
+
 def _spawn_topology(args, coord):
     """scheduler + S servers + W workers; workers' collective exit
     status is the job's. With --max-restarts K a worker/server that
     exits nonzero is respawned (same rank, DMLC_RESTART_COUNT bumped)
     up to K times per node; an exhausted budget fails the whole job
-    with a per-node exit summary."""
+    with a per-node exit summary.
+
+    With ``--serve`` the primary processes are serving-fleet REPLICAS
+    instead of training workers (same supervision: restart budget,
+    exit-75 free respawn) and the job ends when every replica exits —
+    normally via the router's fleet ``stop`` — after which the
+    launcher stops the tracker itself."""
     # -c, not -m: the package __init__ already imports .tracker, and
     # runpy warns when re-executing an imported submodule as __main__
     tracker_cmd = [sys.executable, "-c",
                    "import sys; from mxnet_tpu import tracker; "
                    "sys.exit(tracker.main())"]
     server_cmd = [sys.executable, "-m", "mxnet_tpu.kvstore_server"]
+    serve = getattr(args, "serve", False)
+    primary_role = "replica" if serve else "worker"
 
     def env_fn(role, rank):
         def build(restart_count):
@@ -248,9 +303,10 @@ def _spawn_topology(args, coord):
                    env_fn("scheduler", 0))]
     nodes += [_Node("server%d" % i, "server", i, server_cmd,
                     env_fn("server", i)) for i in range(args.num_servers)]
-    nodes += [_Node("worker%d" % r, "worker", r, list(args.command),
-                    env_fn("worker", r)) for r in range(args.num_workers)]
-    workers = [n for n in nodes if n.role == "worker"]
+    nodes += [_Node("%s%d" % (primary_role, r), primary_role, r,
+                    list(args.command), env_fn(primary_role, r))
+              for r in range(args.num_workers)]
+    workers = [n for n in nodes if n.role == primary_role]
     deadline = (time.monotonic() + args.timeout) if args.timeout else None
     rc = 0
     try:
@@ -297,7 +353,7 @@ def _spawn_topology(args, coord):
                           file=sys.stderr)
                     node.spawn()
                     continue
-                if not args.max_restarts and node.role != "worker":
+                if not args.max_restarts and node.role != primary_role:
                     # legacy (non-elastic) semantics: helper exit codes
                     # never drive the job's status — the workers' own
                     # failures surface the problem
@@ -330,8 +386,11 @@ def _spawn_topology(args, coord):
             # timeline a post-mortem needs most on exactly this path.
             _print_exit_summary(nodes)
         # workers done: the tracker fans out server shutdown itself
-        # (workers' done reports); give the helpers a grace window
-        helpers = [n for n in nodes if n.role != "worker"
+        # (workers' done reports); give the helpers a grace window. In
+        # serve mode nothing stops the tracker for us — stop it now.
+        if serve:
+            _stop_tracker(args, coord)
+        helpers = [n for n in nodes if n.role != primary_role
                    and n.proc is not None and not n.finished]
         _rc, timed_out = _wait_procs([n.proc for n in helpers],
                                      time.monotonic() + 15)
@@ -390,6 +449,15 @@ def main():
                          "--kv-store dist_async runs server-side "
                          "optimization; 0 (default) runs the serverless "
                          "collective path")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-fleet mode (ISSUE 11): the -n "
+                         "primary processes are serving REPLICAS "
+                         "(DMLC_ROLE=replica, DMLC_REPLICA_ID=rank) "
+                         "running your command — e.g. 'python -m "
+                         "mxnet_tpu.serving.fleet replica ...' — "
+                         "registered slot-free with the spawned "
+                         "tracker; --max-restarts supervision (incl. "
+                         "the exit-75 free respawn) applies to them")
     ap.add_argument("--launcher", choices=("local", "manual"),
                     default="local")
     ap.add_argument("--coordinator", default=None,
@@ -419,10 +487,13 @@ def main():
         ap.error("no command given")
     if args.max_restarts < 0:
         ap.error("--max-restarts must be >= 0")
-    if args.max_restarts and args.num_servers <= 0:
+    if args.serve and args.num_servers > 0:
+        ap.error("--serve spawns a scheduler + replicas; parameter "
+                 "servers (-s) belong to training jobs")
+    if args.max_restarts and args.num_servers <= 0 and not args.serve:
         ap.error("--max-restarts requires the scheduler topology "
-                 "(-s > 0): the serverless collective path has no "
-                 "server-held state to recover a worker against")
+                 "(-s > 0 or --serve): the serverless collective path "
+                 "has no server-held state to recover a worker against")
 
     coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
 
@@ -433,7 +504,7 @@ def main():
         return _manual(args, coord)
 
     auto_ckpt = None
-    if args.max_restarts and args.checkpoint_dir is None:
+    if args.max_restarts and args.checkpoint_dir is None and not args.serve:
         args.checkpoint_dir = os.environ.get("MXNET_CHECKPOINT_DIR")
         if not args.checkpoint_dir:
             import tempfile
@@ -442,7 +513,7 @@ def main():
             args.checkpoint_dir = auto_ckpt
             print("launch.py: checkpoints in %s (auto-created; kept on "
                   "failure for post-mortem)" % auto_ckpt, flush=True)
-    if args.num_servers > 0:
+    if args.num_servers > 0 or args.serve:
         rc = _spawn_topology(args, coord)
     else:
         rc = _spawn_serverless(args, coord)
