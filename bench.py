@@ -72,6 +72,8 @@ def _measure(variant):
         return _measure_serve()
     if variant == "fleet":
         return _measure_fleet()
+    if variant == "generate":
+        return _measure_generate()
     if variant == "tune":
         return _measure_tune()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
@@ -266,6 +268,34 @@ def _measure_fleet():
         print(json.dumps({"error": "fleet: %s" % str(e)[:500]}))
 
 
+def _measure_generate():
+    """Generative-serving variant (ISSUE 12): autoregressive decode
+    under Poisson arrivals with sampled prompt/output lengths
+    (tools/bench_serve.py --generate) — continuous batching vs
+    drain-whole-batch tokens/s, p99 time-to-first-token, and slot
+    occupancy. The acceptance pair is speedup >= 2x at equal-or-better
+    p99 TTFT; pages_in_use_after == 0 is the paged-allocator
+    exactness evidence riding every record."""
+    try:
+        from tools.bench_serve import measure_generate
+
+        rec = measure_generate()
+        print(json.dumps({
+            "variant": "generate",
+            "tokens_s": rec["continuous"]["tokens_s"],
+            "speedup_vs_drain": rec["speedup_vs_drain"],
+            "ttft_p99_ms": rec["continuous"]["ttft_p99_ms"],
+            "drain_tokens_s": rec["drain"]["tokens_s"],
+            "drain_ttft_p99_ms": rec["drain"]["ttft_p99_ms"],
+            "slot_occupancy": rec["continuous"]["slot_occupancy"],
+            "drain_occupancy": rec["drain"]["slot_occupancy"],
+            "pages_high_water": rec["continuous"]["pages_high_water"],
+            "pages_in_use_after": rec["continuous"]["pages_in_use_after"],
+        }))
+    except Exception as e:
+        print(json.dumps({"error": "generate: %s" % str(e)[:500]}))
+
+
 def _measure_tune():
     """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
     space at the bench shapes (tools/tune_kernels.py) and record the
@@ -341,6 +371,9 @@ def _report(results, kernels=None):
     if "fleet" in results:
         rec["fleet"] = {k: v for k, v in results["fleet"].items()
                         if k != "variant"}
+    if "generate" in results:
+        rec["generate"] = {k: v for k, v in results["generate"].items()
+                           if k != "variant"}
     if "tune" in results:
         rec["tune"] = {k: v for k, v in results["tune"].items()
                        if k != "variant"}
@@ -403,9 +436,9 @@ def main():
     # if it kills this process mid-attempt the round still lands a
     # number.
     for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "tune",
+                    "generate", "tune",
                     "unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "tune"):
+                    "generate", "tune"):
         if variant in results:
             continue
         if time.time() > deadline - 60:

@@ -72,6 +72,17 @@ Rules:
     incarnation (``restart`` gating as for worker crash); ``stall``
     defaults to ``restart=any``.
 
+``generate:stall@req=N`` (ISSUE 12)
+    The N-th ADMITTED generate request never emits EOS — the
+    wedged-generation simulation (a client streaming forever, a model
+    that never produces the stop token): the request's EOS check is
+    suppressed so only the ``MXNET_GENERATE_MAX_STEPS`` cap (or its
+    deadline) can finish it. The reaction under test: the cap fires,
+    the request finishes with reason ``length``, and its batch slot +
+    KV pages are reclaimed for the requests queued behind it. Fires
+    once (``restart`` gating defaults to ``any`` — the serving loop
+    has no incarnations).
+
 ``router:drop@[p=P,seed=S|n=N][,phase=send|reply]`` (ISSUE 11)
     Connection drop on a matching router→replica forward.
     ``phase=send`` (default) drops BEFORE the request leaves the
@@ -94,12 +105,14 @@ import sys
 
 _EXIT_CODE = 137  # SIGKILL'd processes report 128+9; crash mimics that
 
-_TARGETS = ("worker", "server", "replica", "rpc", "router", "heartbeat")
+_TARGETS = ("worker", "server", "replica", "rpc", "router", "heartbeat",
+            "generate")
 _ACTIONS = {"worker": ("crash", "nan", "preempt"),
             "server": ("crash", "preempt"),
             "replica": ("crash", "stall"),
             "rpc": ("drop",), "router": ("drop",),
-            "heartbeat": ("stall",)}
+            "heartbeat": ("stall",),
+            "generate": ("stall",)}
 
 
 class FaultSpecError(ValueError):
@@ -167,12 +180,13 @@ class _Rule:
 
     def _validate(self):
         p = self.params
-        if self.target == "replica":
-            # replica faults count admitted requests, not train steps
+        if self.target in ("replica", "generate"):
+            # replica/generate faults count admitted requests, not
+            # train steps
             if "req" not in p:
                 raise FaultSpecError(
-                    "fault rule %r: replica %s requires req=N"
-                    % (self.text, self.action))
+                    "fault rule %r: %s %s requires req=N"
+                    % (self.text, self.target, self.action))
         elif self.action in ("crash", "nan", "preempt") and "step" not in p:
             raise FaultSpecError(
                 "fault rule %r: %s requires step=N"
@@ -271,6 +285,7 @@ class ChaosEngine:
         self._step = 0
         self._beats = 0
         self._reqs = 0
+        self._gen_reqs = 0
         self._exit = os._exit  # injectable for tests
         self._kill = lambda: os.kill(os.getpid(), signal.SIGTERM)  # ditto
 
@@ -352,6 +367,28 @@ class ChaosEngine:
                           "fired at replica %d request %d (restart %d)"
                           % (rule.text, self.rank, self._reqs,
                              self.restart), file=sys.stderr, flush=True)
+                return "stall"
+        return None
+
+    def generate_request(self):
+        """Count one admitted generate request; returns ``"stall"``
+        when this request must never emit EOS (generate:stall@req=N —
+        only the max-decode-steps cap or its deadline can finish it),
+        None otherwise. Role/rank-free: the generate loop runs inside
+        whatever serving process hosts it."""
+        self._gen_reqs += 1
+        for rule in self.rules:
+            if rule.target != "generate" or rule.action != "stall":
+                continue
+            if not rule.restart_matches(self.restart, default="any"):
+                continue
+            if self._gen_reqs == int(rule.params["req"]) \
+                    and not rule.fired:
+                rule.fired += 1
+                print("[chaos] suppressing EOS (generate stall): rule "
+                      "%r fired at generate request %d"
+                      % (rule.text, self._gen_reqs),
+                      file=sys.stderr, flush=True)
                 return "stall"
         return None
 
@@ -451,6 +488,14 @@ def replica_request_fault():
     rule hard-exits the process."""
     e = engine()
     return e.replica_request() if e is not None else None
+
+
+def generate_fault():
+    """Per-admitted-generate-request hook (serving/broker.py
+    GenerateServer): returns ``"stall"`` when the request must never
+    emit EOS, None otherwise."""
+    e = engine()
+    return e.generate_request() if e is not None else None
 
 
 def router_fault(phase="send"):

@@ -343,6 +343,38 @@ KNOBS = {
         "seconds a draining replica waits for queued + in-flight "
         "requests to finish before the drain RPC errors (the rolling "
         "fleet_swap bound); finite float > 0 (serving/fleet.py)"),
+    # --- generative serving (ISSUE 12) ---
+    "MXNET_GENERATE_MAX_STEPS": (
+        "256", "honored",
+        "decode-step cap per generate request (also the default "
+        "max_new_tokens): a request that never emits EOS — wedged "
+        "client, chaos generate:stall — finishes with reason 'length' "
+        "at this many generated tokens and its slot + KV pages are "
+        "recycled; integer >= 1 (serving/broker.py GenerateServer)"),
+    "MXNET_GENERATE_SLOTS": (
+        "8", "honored",
+        "batch-slot count of the continuous-batching decode program: "
+        "the static batch dimension every decode step runs at; new "
+        "requests are admitted into vacated slots every step; integer "
+        ">= 1 (serving/generate.py GenerativePredictor)"),
+    "MXNET_GENERATE_PAGE_SIZE": (
+        "16", "honored",
+        "tokens per KV-cache page: the paged allocator's block size — "
+        "a finished request returns ceil(len/page_size) pages to the "
+        "pool immediately; integer >= 1 (serving/generate.py)"),
+    "MXNET_GENERATE_POOL_BYTES": (
+        "0", "honored",
+        "KV page-pool budget in bytes; 0 auto-sizes to slots x "
+        "max-context pages (no oversubscription). A smaller explicit "
+        "budget oversubscribes: admission backpressures on the typed "
+        "PagePoolExhausted instead of OOMing; integer >= 0 "
+        "(serving/generate.py)"),
+    "MXNET_GENERATE_STREAM_FLUSH": (
+        "8", "honored",
+        "decode steps between stream_fn token flushes: generated "
+        "tokens buffer per request and flush to the streaming "
+        "callback every N steps (and at finish); integer >= 1 "
+        "(serving/broker.py GenerateServer)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

@@ -273,13 +273,26 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, kv_len, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _effective_one(block, seq):
+    seq = max(int(seq), 1)
+    if block >= seq:
+        # full-size block: Mosaic accepts the whole dimension as one
+        # tile, so clamp EXACTLY to the sequence — rounding up past it
+        # would only pad. The decode shape (seq_q == 1) depends on
+        # this: block_q must clamp to 1, not round up to a 16-row tile
+        # the single query would rattle around in (ISSUE 12).
+        return seq
+    return _round_up(block, 16)
+
+
 def effective_blocks(block_q, block_k, seq_q, seq_k):
     """The block sizes a (block_q, block_k) request actually runs with:
-    clamped to the sequence length and rounded up to the 16-row Mosaic
-    tile. One definition shared with the schedule search
-    (tune/search.py), so candidate dedup matches the kernel exactly."""
-    return (_round_up(min(block_q, max(seq_q, 1)), 16),
-            _round_up(min(block_k, max(seq_k, 1)), 16))
+    rounded up to the 16-row Mosaic tile while smaller than the
+    sequence, clamped to exactly the sequence length (a legal full-size
+    tile) once they reach it. One definition shared with the schedule
+    search (tune/search.py), so candidate dedup matches the kernel
+    exactly."""
+    return (_effective_one(block_q, seq_q), _effective_one(block_k, seq_k))
 
 
 # hand default block size (MXU-native); the schedule table can override

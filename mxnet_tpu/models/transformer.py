@@ -40,7 +40,8 @@ from ..util import shard_map as _shard_map
 from ..parallel.ring import ring_attention_inner, full_attention
 
 __all__ = ["TransformerConfig", "init_params", "param_specs", "make_loss_fn",
-           "make_train_step"]
+           "make_train_step", "make_forward_fn", "init_kv_cache",
+           "make_prefill_fn", "make_decode_fn", "decode_schedule_shape"]
 
 
 @dataclasses.dataclass
@@ -174,7 +175,13 @@ def _block(x, lp, c, axes, cdt):
     if "tp" in axes:
         o = lax.psum(o, "tp")      # row-parallel out-proj
     x = x + o
+    return _ffn(x, lp, c, axes, cdt)
 
+
+def _ffn(x, lp, c, axes, cdt):
+    """The ffn half of a block (post-attention residual included) —
+    shared verbatim between the training forward and the incremental
+    decode step, so the two paths cannot drift numerically."""
     h = _layernorm(x, lp["ln2_gamma"], lp["ln2_beta"])
     if c.n_experts:
         gate = jax.nn.softmax(
@@ -331,3 +338,259 @@ def make_train_step(config, mesh, optimizer=None, data_axes=("dp",)):
         return (params, opt_state, jnp.zeros((), jnp.int32))
 
     return jax.jit(step, donate_argnums=(0,)), place
+
+
+# ---------------------------------------------------------------------------
+# incremental decode (ISSUE 12): prefill + single-token decode against a
+# PAGED per-layer KV cache. The serving tier (serving/generate.py) owns
+# page allocation and batch-slot bookkeeping; the functions here are the
+# pure compiled programs:
+#
+# - ``make_forward_fn``      one-shot logits (B, S, V) on a single
+#                            device — the numerical reference the decode
+#                            path must match per token.
+# - ``init_kv_cache``        the cache buffer: (L, 2, P, page, H, Dh).
+#                            Page 0 is the SCRATCH page — never handed
+#                            out by the allocator; inactive slots and
+#                            padded prompt tail positions write there.
+# - ``make_prefill_fn``      causal forward over one padded prompt that
+#                            scatters every position's K/V into its
+#                            page (block-table order) and returns the
+#                            last valid position's logits — the first
+#                            generated token comes out of prefill.
+# - ``make_decode_fn``       one token per active batch slot: write the
+#                            token's K/V at (page, offset) derived from
+#                            its position, then attend over the pages
+#                            named by the slot's block table with a
+#                            flash-style blocked online softmax whose
+#                            ``block_k`` is consulted from the PR 10
+#                            schedule table at trace time (decode-shape
+#                            key: seq_q == 1, causal == 0 — the decode
+#                            query attends to ALL cached keys, masked
+#                            by length, not by the kernel's causal
+#                            row>=col rule).
+#
+# The attention math mirrors kernels/flash_attention.py's online
+# softmax (running max / denominator / unnormalized accumulator, fp32),
+# so prefill+decode logits match the one-shot forward to
+# accumulation-order tolerance — asserted in tests/test_generate.py.
+# ---------------------------------------------------------------------------
+def make_forward_fn(config):
+    """Single-device one-shot logits fn(params, tokens (B, S) int32) →
+    (B, S, V) fp32 — ``make_loss_fn``'s mesh-free twin (the serving
+    parity reference and the prefill program's ancestor)."""
+    c = config
+
+    def fwd(params, tokens):
+        return _forward_local(params, tokens, c, frozenset())
+
+    return jax.jit(fwd)
+
+
+def init_kv_cache(config, num_pages, page_size, dtype=None):
+    """Zeroed paged KV cache (n_layers, 2, num_pages + 1, page_size,
+    n_heads, head_dim) in the compute dtype. Index 0 on the page axis
+    is the scratch page (see module comment); callers allocate real
+    page ids from 1..num_pages."""
+    c = config
+    cdt = jnp.dtype(dtype if dtype is not None else c.dtype)
+    dh = c.d_model // c.n_heads
+    return jnp.zeros((c.n_layers, 2, int(num_pages) + 1, int(page_size),
+                      c.n_heads, dh), cdt)
+
+
+def decode_schedule_shape(config, slots, max_ctx):
+    """The schedule-table key shape the decode step consults:
+    (batch=slots, heads, seq_q=1, seq_k=max_ctx, head_dim, causal=0) —
+    the same convention the flash-attention consult uses, so the
+    tune_kernels decode-shape sweep populates exactly this key."""
+    c = config
+    return (int(slots), c.n_heads, 1, int(max_ctx),
+            c.d_model // c.n_heads, 0)
+
+
+def _decode_block_k(config, slots, max_ctx):
+    """Trace-time consult for the decode attention chunk size."""
+    from ..kernels.flash_attention import DEFAULT_BLOCK
+    from ..tune import schedule_for
+
+    sched = schedule_for("flash_attention",
+                         decode_schedule_shape(config, slots, max_ctx),
+                         str(jnp.dtype(config.dtype))) or {}
+    block_k = int(sched.get("block_k", DEFAULT_BLOCK))
+    return max(1, min(block_k, int(max_ctx)))
+
+
+def _paged_decode_attention(q, k, v, positions, block_k):
+    """Flash-style blocked decode attention for one query token per
+    slot. q: (B, H, 1, Dh); k/v: (B, H, L, Dh) gathered from the page
+    pool (L = max_pages_per_slot * page_size); key column j of slot b
+    is valid iff j <= positions[b] (the slot's own token was written
+    before the call). Online softmax over ``block_k``-column chunks —
+    the flash forward kernel's loop in lax, so per-slot dynamic
+    lengths mask exactly."""
+    B, H, L, Dh = k.shape
+    scale = 1.0 / (Dh ** 0.5)
+    nb = -(-L // block_k)
+    pad = nb * block_k - L
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    q32 = q[:, :, 0, :].astype(jnp.float32) * scale          # (B, H, Dh)
+    neg = jnp.float32(-1e30)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = lax.dynamic_slice_in_dim(k, j * block_k, block_k,
+                                      axis=2).astype(jnp.float32)
+        vb = lax.dynamic_slice_in_dim(v, j * block_k, block_k,
+                                      axis=2).astype(jnp.float32)
+        s = jnp.einsum("bhd,bhkd->bhk", q32, kb,
+                       preferred_element_type=jnp.float32)
+        cols = j * block_k + jnp.arange(block_k)
+        ok = cols[None, None, :] <= positions[:, None, None]
+        s = jnp.where(ok, s, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhk,bhkd->bhd", p, vb, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, H), neg, jnp.float32)
+    l0 = jnp.zeros((B, H), jnp.float32)
+    a0 = jnp.zeros((B, H, Dh), jnp.float32)
+    _, l, acc = lax.fori_loop(0, nb, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out[:, :, None, :].astype(q.dtype)                # (B, H, 1, Dh)
+
+
+def _stacked_layer_params(params):
+    return {k: v for k, v in params.items()
+            if k not in ("embed_weight", "pos_embed_weight",
+                         "final_ln_gamma", "final_ln_beta")}
+
+
+def make_prefill_fn(config, page_size):
+    """fn(params, cache, tokens (1, S_pad) int32, length () int32,
+    pages (S_pad // page_size,) int32) → (cache', logits (V,) fp32).
+
+    Runs the SAME causal block forward as ``make_forward_fn`` over the
+    padded prompt (so flash/full attention and its schedule consult are
+    shared), scatters each position p's K/V into
+    ``cache[layer, :, pages[p // page_size], p % page_size]``, and
+    returns the logits of position ``length - 1``. Padded tail
+    positions write garbage K/V into whatever page their index names —
+    callers pad ``pages`` with 0, the scratch page, past the allocated
+    prompt pages; garbage inside an allocated page at offsets >=
+    length is never attended (decode masks columns > position) and is
+    overwritten before the position is reached."""
+    c = config
+    cdt = jnp.dtype(c.dtype)
+    page_size = int(page_size)
+
+    def prefill(params, cache, tokens, length, pages):
+        _b, S = tokens.shape
+        n_pages = S // page_size
+        x = jnp.take(params["embed_weight"],
+                     jnp.clip(tokens, 0, params["embed_weight"].shape[0] - 1),
+                     axis=0)
+        x = (x + params["pos_embed_weight"][:S]).astype(cdt)
+
+        def layer(x, xs):
+            lp, cl = xs
+            h = _layernorm(x, lp["ln1_gamma"], lp["ln1_beta"])
+            qkv = jnp.einsum("bsd,dthe->tbhse", h,
+                             lp["attn_qkv_weight"].astype(cdt))
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            # scatter K/V into this layer's pages: (1,H,S,Dh) → page grid
+            kp = k[0].transpose(1, 0, 2).reshape(
+                n_pages, page_size, c.n_heads, -1)
+            vp = v[0].transpose(1, 0, 2).reshape(
+                n_pages, page_size, c.n_heads, -1)
+            cl = cl.at[0, pages].set(kp.astype(cl.dtype))
+            cl = cl.at[1, pages].set(vp.astype(cl.dtype))
+            o = _attention(q, k, v, axes=frozenset(), attn=c.attn,
+                           blocks=(c.attn_block_q, c.attn_block_k))
+            o = jnp.einsum("bhse,hed->bsd", o,
+                           lp["attn_out_weight"].astype(cdt))
+            return _ffn(x + o, lp, c, frozenset(), cdt), cl
+
+        x, cache = lax.scan(layer, x, (_stacked_layer_params(params), cache))
+        x = _layernorm(x, params["final_ln_gamma"], params["final_ln_beta"])
+        x_last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                          keepdims=False)
+        logits = jnp.einsum("d,vd->v", x_last,
+                            params["embed_weight"].astype(cdt))
+        return cache, logits.astype(jnp.float32)
+
+    return prefill
+
+
+def make_decode_fn(config, slots, max_pages_per_slot, page_size,
+                   block_k=None):
+    """fn(params, cache, tokens (S,) int32, positions (S,) int32,
+    block_tables (S, max_pages_per_slot) int32, active (S,) bool) →
+    (cache', logits (S, V) fp32).
+
+    One decode step for ``slots`` batch slots: embed token b at
+    ``positions[b]``, write its per-layer K/V at page
+    ``block_tables[b, positions[b] // page_size]`` offset
+    ``positions[b] % page_size``, attend over the slot's gathered pages
+    (columns <= position), and emit next-token logits. Inactive slots
+    compute too (the batch shape is static) but their writes are routed
+    to the scratch page and their logits zeroed. ``block_k`` defaults
+    to the schedule-table consult at the decode shape
+    (:func:`decode_schedule_shape`)."""
+    c = config
+    cdt = jnp.dtype(c.dtype)
+    page_size = int(page_size)
+    max_ctx = int(max_pages_per_slot) * page_size
+    if block_k is None:
+        block_k = _decode_block_k(c, slots, max_ctx)
+
+    def decode(params, cache, tokens, positions, block_tables, active):
+        S = tokens.shape[0]
+        emb = params["embed_weight"]
+        x = jnp.take(emb, jnp.clip(tokens, 0, emb.shape[0] - 1), axis=0)
+        pos = jnp.take(params["pos_embed_weight"],
+                       jnp.clip(positions, 0,
+                                params["pos_embed_weight"].shape[0] - 1),
+                       axis=0)
+        x = (x + pos).astype(cdt)[:, None, :]                # (S, 1, d)
+
+        page_idx = positions // page_size
+        offset = positions % page_size
+        page = jnp.take_along_axis(block_tables, page_idx[:, None],
+                                   axis=1)[:, 0]
+        # inactive slots (and any unset table entry) write to scratch
+        page = jnp.where(active, page, 0)
+
+        def layer(x, xs):
+            lp, cl = xs
+            h = _layernorm(x, lp["ln1_gamma"], lp["ln1_beta"])
+            qkv = jnp.einsum("bsd,dthe->tbhse", h,
+                             lp["attn_qkv_weight"].astype(cdt))
+            q, k, v = qkv[0], qkv[1], qkv[2]          # (S, H, 1, Dh)
+            cl = cl.at[0, page, offset].set(k[:, :, 0, :].astype(cl.dtype))
+            cl = cl.at[1, page, offset].set(v[:, :, 0, :].astype(cl.dtype))
+            # paged gather: (S, MP, page, H, Dh) → (S, H, L, Dh)
+            kg = cl[0][block_tables].reshape(
+                S, max_ctx, c.n_heads, -1).transpose(0, 2, 1, 3)
+            vg = cl[1][block_tables].reshape(
+                S, max_ctx, c.n_heads, -1).transpose(0, 2, 1, 3)
+            o = _paged_decode_attention(q.astype(cdt), kg, vg, positions,
+                                        block_k)
+            o = jnp.einsum("bhse,hed->bsd", o,
+                           lp["attn_out_weight"].astype(cdt))
+            return _ffn(x + o, lp, c, frozenset(), cdt), cl
+
+        x, cache = lax.scan(layer, x, (_stacked_layer_params(params), cache))
+        x = _layernorm(x, params["final_ln_gamma"], params["final_ln_beta"])
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed_weight"].astype(cdt))[:, 0]
+        logits = jnp.where(active[:, None], logits, 0.0)
+        return cache, logits.astype(jnp.float32)
+
+    return decode

@@ -107,6 +107,9 @@ def dump_profile():
     fleet = fleet_stats()
     if fleet:
         payload["fleetStats"] = fleet
+    gen = generate_stats()
+    if gen:
+        payload["generateStats"] = gen
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -498,6 +501,96 @@ def fleet_reset():
     with _FLEET_LOCK:
         _FLEET.update(_FLEET_ZERO)
         _FLEET_LAT = None
+
+
+# ---------------------------------------------------------------------------
+# generative-serving observability (ISSUE 12): counters for the
+# continuous-batching decode loop — request/prefill/decode-step/token
+# counts, finish reasons (eos/length/deadline/exhausted/errors), shed
+# at dequeue, slot occupancy (active-slot-steps / slot-steps — the
+# continuous-batching acceptance signal), a time-to-first-token
+# reservoir, and the page-pool GAUGE (in_use / high_water / pool size —
+# ``pages_in_use == 0`` after a drained run is the exact-accounting
+# acceptance assert). Always-on like comm_record; rides dump_profile as
+# generateStats. Unknown counter names raise (the fleet_record rule).
+# ---------------------------------------------------------------------------
+_GEN_LOCK = threading.Lock()
+_GEN_ZERO = {
+    "requests": 0, "prefills": 0, "prefill_tokens": 0,
+    "decode_steps": 0, "tokens": 0, "finished": 0, "eos": 0, "length": 0,
+    "deadline": 0, "exhausted": 0, "errors": 0, "shed": 0,
+    "slot_steps": 0, "active_slot_steps": 0, "max_queue_depth": 0,
+    "busy_seconds": 0.0,   # prefill + decode compute time (floats)
+}
+_GEN_FLOATS = ("busy_seconds",)
+_GEN_GAUGES = ("pages_in_use", "pages_high_water", "pool_pages")
+_GEN = dict(_GEN_ZERO)
+_GEN_PAGES = {}
+_GEN_TTFT_CAP = 8192
+_GEN_TTFT = None  # deque, created lazily
+
+
+def generate_record(queue_depth=None, ttfts=None, **adds):
+    """Accumulate generative-serving counters (thread-safe). The
+    ``pages_*``/``pool_pages`` names are gauges (latest pool snapshot);
+    everything else accumulates. Unknown names raise."""
+    global _GEN_TTFT
+    with _GEN_LOCK:
+        for k, v in adds.items():
+            if k in _GEN_GAUGES:
+                _GEN_PAGES[k] = int(v)
+            elif k in _GEN_FLOATS:
+                _GEN[k] += float(v)
+            elif k in _GEN_ZERO:
+                _GEN[k] += int(v)
+            else:
+                raise ValueError("generate_record: unknown counter %r" % k)
+        if queue_depth is not None and queue_depth > _GEN["max_queue_depth"]:
+            _GEN["max_queue_depth"] = int(queue_depth)
+        if ttfts:
+            if _GEN_TTFT is None:
+                from collections import deque
+
+                _GEN_TTFT = deque(maxlen=_GEN_TTFT_CAP)
+            _GEN_TTFT.extend(ttfts)
+
+
+def generate_stats(reset=False):
+    """Snapshot with derived slot occupancy and TTFT p50/p99 (ms);
+    empty dict when the generative tier never ran."""
+    global _GEN_TTFT
+    with _GEN_LOCK:
+        snap = dict(_GEN)
+        pages = dict(_GEN_PAGES)
+        ttft = sorted(_GEN_TTFT) if _GEN_TTFT else []
+        if reset:
+            _GEN.update(_GEN_ZERO)
+            _GEN_PAGES.clear()
+            _GEN_TTFT = None
+    if not (any(snap.values()) or pages):
+        return {}
+    snap.update(pages)
+    if snap["slot_steps"]:
+        snap["slot_occupancy"] = round(
+            snap["active_slot_steps"] / snap["slot_steps"], 3)
+    if snap["busy_seconds"] > 0:
+        # generated tokens over prefill+decode compute time — the
+        # server-side throughput gauge (bench_serve reports the
+        # arrival-to-completion wall-clock variant next to it)
+        snap["tokens_s"] = round(snap["tokens"] / snap["busy_seconds"], 1)
+        snap["busy_seconds"] = round(snap["busy_seconds"], 4)
+    if ttft:
+        snap["ttft_p50_ms"] = _percentile_ms(ttft, 0.50)
+        snap["ttft_p99_ms"] = _percentile_ms(ttft, 0.99)
+    return snap
+
+
+def generate_reset():
+    global _GEN_TTFT
+    with _GEN_LOCK:
+        _GEN.update(_GEN_ZERO)
+        _GEN_PAGES.clear()
+        _GEN_TTFT = None
 
 
 def pause():

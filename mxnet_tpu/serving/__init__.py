@@ -14,6 +14,15 @@ The fleet tier (ISSUE 11, ``fleet.py``) scales this to N replica
 processes: tracker-discovered :class:`ReplicaServer` endpoints, a
 :class:`FleetRouter` with failure-classified bounded retry, typed
 health-driven draining, and zero-drop rolling checkpoint swap.
+
+The generative tier (ISSUE 12, ``generate.py`` + ``broker.py``) opens
+the autoregressive LLM decoding workload: KV-cache incremental decode
+(prefill + single-token steps against a PAGED per-layer cache,
+models/transformer.py), an exact-accounting :class:`PagePool` that
+recycles a finished request's memory immediately, and a
+:class:`GenerateServer` whose continuous-batching decode loop admits
+new requests into vacated batch slots every step instead of draining
+whole batches.
 """
 from .predictor import (  # noqa: F401
     AOTPredictor,
@@ -25,10 +34,17 @@ from .predictor import (  # noqa: F401
 )
 from .broker import (  # noqa: F401
     DeadlineExceeded,
+    GenerateServer,
     ModelServer,
     ReplicaDraining,
     ServerClosed,
     ServerOverloaded,
+)
+from .generate import (  # noqa: F401
+    GenerateError,
+    GenerativePredictor,
+    PagePool,
+    PagePoolExhausted,
 )
 from .fleet import (  # noqa: F401
     FleetError,

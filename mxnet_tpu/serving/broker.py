@@ -34,7 +34,13 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from .. import profiler
+from .. import chaos, profiler
+from .generate import (
+    GenerateError,
+    GenerativePredictor,
+    PagePoolExhausted,
+    _env_positive_int,
+)
 from .predictor import (
     AOTPredictor,
     ExecutableCache,
@@ -451,6 +457,462 @@ class ModelServer:
                            "dispatched")
         for w in workers:
             w.fail_pending(exc)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# generative serving (ISSUE 12): continuous-batching decode loop
+# ---------------------------------------------------------------------------
+class _GenRequest:
+    __slots__ = ("tokens", "max_new", "eos_id", "future", "stream_fn",
+                 "t_submit", "deadline", "no_eos", "out", "pages",
+                 "slot", "ttft", "unflushed")
+
+    def __init__(self, tokens, max_new, eos_id, deadline, stream_fn):
+        self.tokens = tokens
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.future = Future()
+        self.stream_fn = stream_fn
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline     # absolute time.monotonic(), or None
+        self.no_eos = False          # chaos generate:stall — never sees EOS
+        self.out = []
+        self.pages = []
+        self.slot = None
+        self.ttft = None
+        self.unflushed = []
+
+
+class GenerateServer:
+    """Continuous-batching autoregressive decode server (ISSUE 12).
+
+    The structural difference from :class:`ModelServer`: a generate
+    request is not one forward but a *prefill* plus an open-ended run
+    of single-token decode steps, and requests finish at different
+    steps. Draining whole batches would leave finished slots idle for
+    the remainder of the longest request — so the decode loop here
+    admits new requests into vacated batch slots EVERY decode step
+    (continuous batching): admit (shedding deadline-expired requests
+    at dequeue, the PR 9 rule) → prefill admitted prompts into freshly
+    allocated KV pages → one decode step over all active slots →
+    sample, stream, finish, recycle pages. ``admit_policy="drain"``
+    keeps the old drain-whole-batch behavior for the bench comparison.
+
+    Memory is paged (:class:`~.generate.PagePool`): each slot holds a
+    block table naming its pages; completion returns the pages
+    immediately. Pool exhaustion at admission backpressures (the
+    request waits in queue); a request that can never fit — or a
+    mid-decode page the pool cannot provide — fails fast with the
+    typed :class:`~.generate.PagePoolExhausted`.
+
+    Tokens stream back through the request future (resolves to
+    ``{"tokens", "finish_reason", "ttft_s", "latency_s"}``); a
+    ``stream_fn`` callback additionally receives token chunks every
+    ``MXNET_GENERATE_STREAM_FLUSH`` decode steps.
+    """
+
+    FINISH_EOS = "eos"
+    FINISH_LENGTH = "length"
+
+    def __init__(self, config=None, params=None, predictor=None, *,
+                 slots=None, page_size=None, pool_bytes=None,
+                 max_steps=None, stream_flush=None, queue_depth=None,
+                 submit_timeout=None, admit_policy="continuous",
+                 device=None, cache=None, name="generate", **pred_kwargs):
+        if predictor is None:
+            if config is None or params is None:
+                raise GenerateError(
+                    "GenerateServer: need either predictor= or "
+                    "config=/params=")
+            predictor = GenerativePredictor(
+                config, params, slots=slots, page_size=page_size,
+                pool_bytes=pool_bytes, device=device, cache=cache,
+                model_name=name, **pred_kwargs)
+        self.predictor = predictor
+        self.name = name
+        if admit_policy not in ("continuous", "drain"):
+            raise GenerateError("GenerateServer: admit_policy must be "
+                                "continuous|drain, got %r" % admit_policy)
+        self._policy = admit_policy
+        self._max_steps = _env_positive_int("MXNET_GENERATE_MAX_STEPS") \
+            if max_steps is None else int(max_steps)
+        if self._max_steps < 1:
+            raise GenerateError("GenerateServer: max_steps must be >= 1, "
+                                "got %d" % self._max_steps)
+        self._flush_every = _env_positive_int("MXNET_GENERATE_STREAM_FLUSH") \
+            if stream_flush is None else int(stream_flush)
+        if self._flush_every < 1:
+            raise GenerateError("GenerateServer: stream_flush must be "
+                                ">= 1, got %d" % self._flush_every)
+        self._depth = env_positive_int("MXNET_SERVE_QUEUE_DEPTH", 256) \
+            if queue_depth is None else int(queue_depth)
+        self._submit_timeout = env_positive_float(
+            "MXNET_SERVE_SUBMIT_TIMEOUT", 60.0) if submit_timeout is None \
+            else float(submit_timeout)
+
+        S, MP = predictor.slots, predictor.max_pages_per_slot
+        self._slot_req = [None] * S
+        self._block_tables = np.zeros((S, MP), np.int32)
+        self._positions = np.zeros((S,), np.int32)
+        self._tokens = np.zeros((S,), np.int32)
+        self._active = np.zeros((S,), bool)
+
+        self._cond = threading.Condition()
+        self._q = deque()
+        self._stopped = False
+        self._error = None
+        self._step_hook = None       # test seam: called before each decode
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="generate-%s" % name)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+    def submit(self, tokens, max_new_tokens=None, eos_id=None,
+               deadline=None, stream_fn=None, timeout=None):
+        """Enqueue one generate request; returns a Future resolving to
+        ``{"tokens": [int], "finish_reason": "eos"|"length",
+        "ttft_s", "latency_s", "prompt_tokens"}``. ``deadline``
+        (seconds from now) marks it sheddable at dequeue (PR 9) AND
+        bounds the decode run itself — a mid-generation expiry fails
+        the future with :class:`DeadlineExceeded` and recycles the
+        slot + pages. ``max_new_tokens`` is capped by
+        ``MXNET_GENERATE_MAX_STEPS`` and the per-slot context bound."""
+        pred = self.predictor
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.shape[0] < 1:
+            raise GenerateError("submit: empty prompt")
+        if int(tokens.min()) < 0 or int(tokens.max()) >= pred.config.vocab:
+            # the compiled programs CLAMP ids (shape-static gather);
+            # serving a clamped id would silently diverge from the
+            # zero-masking one-shot forward, so reject at the door
+            raise GenerateError(
+                "submit: prompt token ids must lie in [0, %d), got "
+                "range [%d, %d]" % (pred.config.vocab, tokens.min(),
+                                    tokens.max()))
+        if tokens.shape[0] > pred.max_ctx - 1:
+            raise GenerateError(
+                "submit: %d-token prompt exceeds the per-slot context "
+                "bound %d (need room for >= 1 generated token)"
+                % (tokens.shape[0], pred.max_ctx))
+        if pred.pages_needed(tokens.shape[0]) > pred.pool.num_pages:
+            raise PagePoolExhausted(
+                "submit: prompt needs %d pages, the whole pool holds %d"
+                % (pred.pages_needed(tokens.shape[0]),
+                   pred.pool.num_pages))
+        max_new = self._max_steps if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise GenerateError("submit: max_new_tokens must be >= 1, "
+                                "got %d" % max_new)
+        max_new = min(max_new, self._max_steps,
+                      pred.max_ctx - int(tokens.shape[0]))
+        if deadline is not None:
+            deadline = float(deadline)
+            if not deadline > 0:
+                raise GenerateError("submit: deadline must be > 0 "
+                                    "seconds, got %r" % deadline)
+            deadline = time.monotonic() + deadline
+        req = _GenRequest(tokens, max_new, eos_id, deadline, stream_fn)
+        wait_until = time.monotonic() + (
+            self._submit_timeout if timeout is None else float(timeout))
+        with self._cond:
+            while True:
+                if self._stopped:
+                    if self._error is not None:
+                        raise ServingError("GenerateServer %r: worker "
+                                           "died: %r" % (self.name,
+                                                         self._error))
+                    raise ServerClosed("GenerateServer %r is closed"
+                                       % self.name)
+                if len(self._q) < self._depth:
+                    break
+                remaining = wait_until - time.monotonic()
+                if remaining <= 0:
+                    raise ServerOverloaded(
+                        "GenerateServer %r: request queue full (%d "
+                        "queued, MXNET_SERVE_QUEUE_DEPTH=%d)"
+                        % (self.name, len(self._q), self._depth))
+                self._cond.wait(min(remaining, 0.1))
+            self._q.append(req)
+            depth = len(self._q)
+            self._cond.notify_all()
+        profiler.generate_record(requests=1, queue_depth=depth)
+        return req.future
+
+    def generate(self, tokens, **kw):
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(tokens, **kw).result()
+
+    # -- worker side ---------------------------------------------------------
+    def _active_count(self):
+        return int(self._active.sum())
+
+    def _admit_locked(self):
+        """Pop admissible requests into slots (shedding expired ones at
+        dequeue); pages are reserved here so a request is only popped
+        when its prompt fits. Returns (admitted, shed, starved) —
+        ``starved`` is a request that can NEVER be admitted (no active
+        slots to recycle pages from and nothing else admitted this
+        round): it must fail typed instead of stalling forever."""
+        pred = self.predictor
+        admitted, shed = [], []
+        if self._policy == "drain" and self._active_count() > 0:
+            return admitted, shed, None
+        free = [i for i in range(pred.slots) if self._slot_req[i] is None]
+        now = time.monotonic()
+        while free and self._q:
+            r = self._q[0]
+            if r.deadline is not None and now > r.deadline:
+                shed.append(self._q.popleft())
+                continue
+            try:
+                r.pages = pred.pool.alloc(
+                    pred.pages_needed(r.tokens.shape[0]))
+            except PagePoolExhausted:
+                if not admitted and self._active_count() == 0:
+                    return admitted, shed, self._q.popleft()
+                break     # backpressure: completions will recycle pages
+            self._q.popleft()
+            r.slot = free.pop(0)
+            self._slot_req[r.slot] = r
+            admitted.append(r)
+        if shed or admitted:
+            self._cond.notify_all()   # queue space freed
+        return admitted, shed, None
+
+    def _record_pool(self):
+        s = self.predictor.pool.stats()
+        profiler.generate_record(pages_in_use=s["in_use"],
+                                 pages_high_water=s["high_water"],
+                                 pool_pages=s["num_pages"])
+
+    def _vacate(self, r):
+        slot = r.slot
+        with self._cond:
+            self._slot_req[slot] = None
+            self._active[slot] = False
+            self._block_tables[slot, :] = 0
+            self._positions[slot] = 0
+            self._tokens[slot] = 0
+            self._cond.notify_all()
+        if r.pages:
+            self.predictor.pool.free(r.pages)
+            r.pages = []
+        self._record_pool()
+
+    def _flush_stream(self, r, final=False):
+        if r.stream_fn is None:
+            r.unflushed = []
+            return
+        if r.unflushed and (final or len(r.unflushed) >= self._flush_every):
+            chunk, r.unflushed = r.unflushed, []
+            try:
+                r.stream_fn(chunk)
+            except Exception:
+                pass     # a broken stream consumer must not kill the loop
+
+    def _finish(self, r, reason):
+        self._vacate(r)
+        self._flush_stream(r, final=True)
+        profiler.generate_record(finished=1, **{reason: 1})
+        r.future.set_result({
+            "tokens": list(r.out),
+            "finish_reason": reason,
+            "prompt_tokens": int(r.tokens.shape[0]),
+            "ttft_s": r.ttft,
+            "latency_s": time.perf_counter() - r.t_submit,
+        })
+
+    def _fail(self, r, exc, counter=None):
+        self._vacate(r)
+        self._flush_stream(r, final=True)
+        profiler.generate_record(finished=1, **{counter or "errors": 1})
+        if not r.future.done():
+            r.future.set_exception(exc)
+
+    def _check_done(self, r, tok):
+        """EOS / length / deadline disposition for a just-produced
+        token; returns True when the request left its slot."""
+        if (r.eos_id is not None and tok == r.eos_id and not r.no_eos):
+            self._finish(r, self.FINISH_EOS)
+            return True
+        if len(r.out) >= r.max_new:
+            self._finish(r, self.FINISH_LENGTH)
+            return True
+        if r.deadline is not None and time.monotonic() > r.deadline:
+            self._fail(r, DeadlineExceeded(
+                "generate: deadline expired after %d token(s); slot and "
+                "pages recycled" % len(r.out)), counter="deadline")
+            return True
+        return False
+
+    def _prefill_one(self, r):
+        pred = self.predictor
+        if chaos.generate_fault() == "stall":
+            r.no_eos = True    # the request that never emits EOS
+        t0 = time.perf_counter()
+        try:
+            logits = pred.prefill(r.tokens, r.pages)
+        except BaseException as e:
+            self._fail(r, e)
+            return
+        now = time.perf_counter()
+        profiler.generate_record(busy_seconds=now - t0)
+        r.ttft = now - r.t_submit
+        tok = int(np.argmax(logits))
+        r.out.append(tok)
+        r.unflushed.append(tok)
+        # tokens counts every GENERATED token; the first one comes out
+        # of prefill, the rest out of decode steps
+        profiler.generate_record(prefills=1, tokens=1,
+                                 prefill_tokens=int(r.tokens.shape[0]),
+                                 ttfts=[r.ttft])
+        self._record_pool()
+        slot = r.slot
+        self._block_tables[slot, :len(r.pages)] = r.pages
+        self._positions[slot] = r.tokens.shape[0]
+        self._tokens[slot] = tok
+        self._flush_stream(r)
+        if not self._check_done(r, tok):
+            self._active[slot] = True
+
+    def _grow_pages(self):
+        """Before a decode step, make sure every active slot owns the
+        page its write position lands in; a pool that cannot grow a
+        mid-flight request fails it typed (never a silent stall)."""
+        pred = self.predictor
+        for slot in np.flatnonzero(self._active):
+            r = self._slot_req[slot]
+            pidx = int(self._positions[slot]) // pred.page_size
+            if self._block_tables[slot, pidx] != 0:
+                continue
+            try:
+                page, = pred.pool.alloc(1)
+            except PagePoolExhausted as e:
+                self._fail(r, PagePoolExhausted(
+                    "generate: pool exhausted growing a mid-flight "
+                    "request past %d token(s): %s" % (len(r.out), e)),
+                    counter="exhausted")
+                continue
+            r.pages.append(page)
+            self._block_tables[slot, pidx] = page
+
+    def _decode_step(self):
+        pred = self.predictor
+        if self._step_hook is not None:
+            self._step_hook()
+        t0 = time.perf_counter()
+        logits = pred.decode(self._tokens, self._positions,
+                             self._block_tables, self._active)
+        active = np.flatnonzero(self._active)
+        self._positions[active] += 1
+        profiler.generate_record(decode_steps=1, tokens=len(active),
+                                 slot_steps=pred.slots,
+                                 active_slot_steps=len(active),
+                                 busy_seconds=time.perf_counter() - t0)
+        for slot in active:
+            r = self._slot_req[slot]
+            tok = int(np.argmax(logits[slot]))
+            r.out.append(tok)
+            r.unflushed.append(tok)
+            self._tokens[slot] = tok
+            self._flush_stream(r)
+            self._check_done(r, tok)
+
+    def _run(self):
+        try:
+            while True:
+                with self._cond:
+                    while (not self._q and not self._active_count()
+                           and not self._stopped):
+                        self._cond.wait()
+                    if self._stopped:
+                        return
+                    admitted, shed, starved = self._admit_locked()
+                if shed:
+                    exc = DeadlineExceeded(
+                        "generate: deadline expired before admission "
+                        "(shed at dequeue)")
+                    for r in shed:
+                        if not r.future.done():
+                            r.future.set_exception(exc)
+                    profiler.generate_record(shed=len(shed))
+                if starved is not None:
+                    self._fail(starved, PagePoolExhausted(
+                        "generate: prompt of %d token(s) cannot be "
+                        "admitted — pool empty with no requests in "
+                        "flight to recycle from"
+                        % starved.tokens.shape[0]), counter="exhausted")
+                for r in admitted:
+                    self._prefill_one(r)
+                if not self._active_count():
+                    continue
+                self._grow_pages()
+                if self._active_count():
+                    self._decode_step()
+        except BaseException as e:   # loop death: sticky, fail everything
+            with self._cond:
+                self._error = e
+                self._stopped = True
+                pending = list(self._q)
+                self._q.clear()
+                inflight = [r for r in self._slot_req if r is not None]
+                self._cond.notify_all()
+            for r in pending:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            for r in inflight:
+                self._fail(r, e)
+
+    # -- observability / lifecycle -------------------------------------------
+    def stats(self, reset=False):
+        """Generative-serving counters (see profiler.generate_stats)."""
+        return profiler.generate_stats(reset=reset)
+
+    @property
+    def admit_policy(self):
+        return self._policy
+
+    def pending(self):
+        with self._cond:
+            return len(self._q) + sum(1 for r in self._slot_req
+                                      if r is not None)
+
+    def close(self, timeout=5.0):
+        """Stop the decode loop, fail queued AND in-flight requests
+        with the typed :class:`ServerClosed` (a router may retry them
+        elsewhere), recycle every page. Idempotent."""
+        with self._cond:
+            if self._stopped and self._error is None and \
+                    not any(self._slot_req) and not self._q:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        exc = ServerClosed("GenerateServer closed before the request "
+                           "finished")
+        with self._cond:
+            pending = list(self._q)
+            self._q.clear()
+            inflight = [r for r in self._slot_req if r is not None]
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(exc)
+        for r in inflight:
+            self._fail(r, exc)
 
     def __enter__(self):
         return self

@@ -1,0 +1,344 @@
+"""Paged KV-cache machinery for generative serving (ISSUE 12).
+
+The workload nncase targets (PAPERS.md, arXiv:2512.21571) —
+autoregressive LLM decoding — differs structurally from the one-shot
+forwards the serving tier batched so far: every request carries
+device-resident state (its KV cache), sequence lengths vary wildly, and
+requests finish at different decode steps. Two pieces live here; the
+continuous-batching decode loop (:class:`~.broker.GenerateServer`) owns
+them from ``serving/broker.py``:
+
+- :class:`PagePool` — an exact-accounting fixed-size-block allocator
+  for KV-cache memory (vLLM's PagedAttention idea): a finished
+  request's pages are recycled the moment it completes instead of
+  pinning ``max_seq_len`` per batch slot. Exhaustion raises the typed
+  :class:`PagePoolExhausted` — backpressure, never an OOM or a silent
+  stall — and the accounting is asserted leak-free in tests.
+- :class:`GenerativePredictor` — one transformer bound for incremental
+  decode: a ladder of prefill programs (prompt padded to page-aligned
+  power-of-two buckets, the PR 6 ladder idea) that fill per-layer K/V
+  pages, plus ONE decode program (``slots`` queries, 1 token each)
+  that attends against the pages named by each slot's block table.
+  The big cache buffer is donated to every call on accelerators (the
+  PR 6 donation rule: skipped on CPU where it only warns), compiled
+  programs share the serving tier's :class:`ExecutableCache`, and the
+  decode attention's ``block_k`` is consulted from the PR 10 schedule
+  table at trace time (``tools/tune_kernels.py`` sweeps the
+  decode shape).
+
+Page 0 of the cache is the scratch page: never handed out, it absorbs
+writes from inactive slots and padded prompt tails so the compiled
+programs stay shape-static without ever corrupting live pages.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import config
+from ..base import MXNetError
+from .predictor import ExecutableCache, ServingError
+
+
+class GenerateError(ServingError):
+    """Generative-serving failure (bad knob, bad request, dead loop)."""
+
+
+class PagePoolExhausted(GenerateError):
+    """The KV page pool has no free page for this allocation. Typed
+    backpressure: at admission the request simply waits in the queue
+    for completions to recycle pages; a request that could NEVER fit
+    (or a mid-decode growth the pool cannot serve) fails fast with
+    this error instead of stalling silently or OOMing the device."""
+
+
+def _env_positive_int(name):
+    if config.get(name) is None:
+        raise GenerateError("unknown knob %s" % name)
+    try:
+        return config.get_positive_int(name)
+    except MXNetError as e:
+        raise GenerateError(str(e))
+
+
+def _env_nonneg_int(name):
+    try:
+        return config.get_nonneg_int(name)
+    except MXNetError as e:
+        raise GenerateError(str(e))
+
+
+class PagePool:
+    """Fixed-size-block allocator with exact accounting.
+
+    Page ids run 1..num_pages (0 is the cache's scratch page). ``alloc``
+    raises :class:`PagePoolExhausted` when the request cannot be
+    satisfied — it never partially allocates. ``free`` rejects
+    double-frees and foreign ids loudly: a page leak (or double
+    recycle) silently corrupts another request's KV state, so the
+    accounting must be exact by construction."""
+
+    def __init__(self, num_pages):
+        num_pages = int(num_pages)
+        if num_pages < 1:
+            raise GenerateError("PagePool: need >= 1 page, got %d"
+                                % num_pages)
+        self.num_pages = num_pages
+        self._free = list(range(num_pages, 0, -1))  # pop() hands out 1 first
+        self._in_use = set()
+        self._lock = threading.Lock()
+        self.high_water = 0
+        self.allocs = 0
+        self.frees = 0
+
+    def alloc(self, n):
+        """n pages as a list of ids, or PagePoolExhausted (all-or-nothing)."""
+        n = int(n)
+        if n < 0:
+            raise GenerateError("PagePool.alloc: n must be >= 0, got %d" % n)
+        with self._lock:
+            if n > len(self._free):
+                raise PagePoolExhausted(
+                    "page pool exhausted: need %d page(s), %d free of %d "
+                    "(MXNET_GENERATE_POOL_BYTES)"
+                    % (n, len(self._free), self.num_pages))
+            pages = [self._free.pop() for _ in range(n)]
+            self._in_use.update(pages)
+            self.allocs += n
+            if len(self._in_use) > self.high_water:
+                self.high_water = len(self._in_use)
+            return pages
+
+    def free(self, pages):
+        with self._lock:
+            for p in pages:
+                if p not in self._in_use:
+                    raise GenerateError(
+                        "PagePool.free: page %r is not allocated "
+                        "(double free or foreign id)" % (p,))
+            for p in pages:
+                self._in_use.discard(p)
+                self._free.append(p)
+                self.frees += 1
+
+    @property
+    def in_use(self):
+        with self._lock:
+            return len(self._in_use)
+
+    @property
+    def free_pages(self):
+        with self._lock:
+            return len(self._free)
+
+    def stats(self):
+        with self._lock:
+            return {"num_pages": self.num_pages,
+                    "in_use": len(self._in_use),
+                    "free": len(self._free),
+                    "high_water": self.high_water,
+                    "allocs": self.allocs, "frees": self.frees}
+
+
+class GenerativePredictor:
+    """One transformer bound for prefill + single-token decode.
+
+    Parameters
+    ----------
+    config_ : models.transformer.TransformerConfig
+        The model architecture (``dtype`` is the cache/compute dtype).
+    params : dict
+        ``init_params``-layout arrays (numpy or jax); frozen onto the
+        device once.
+    slots : int, optional
+        Batch-slot count of the decode program
+        (``MXNET_GENERATE_SLOTS``).
+    page_size : int, optional
+        Tokens per KV page (``MXNET_GENERATE_PAGE_SIZE``).
+    pool_bytes : int, optional
+        KV page-pool budget in bytes (``MXNET_GENERATE_POOL_BYTES``);
+        0/None auto-sizes to ``slots * max_pages_per_slot`` pages —
+        every slot can hold a full-context request, so decode-time
+        exhaustion is impossible and paging only buys recycling speed.
+        A smaller explicit budget oversubscribes: admission
+        backpressures on :class:`PagePoolExhausted`.
+    max_ctx : int, optional
+        Per-slot context bound (prompt + generated), default
+        ``config.max_len``; rounded down to a whole page count.
+    block_k : int, optional
+        Decode attention chunk override; default consults the schedule
+        table at :func:`models.transformer.decode_schedule_shape`.
+    cache : ExecutableCache, optional
+        Shared compiled-program LRU (the serving tier's); private
+        unbounded cache by default.
+    """
+
+    def __init__(self, config_, params, *, slots=None, page_size=None,
+                 pool_bytes=None, max_ctx=None, block_k=None, device=None,
+                 cache=None, model_name=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import transformer as tfm
+
+        self.config = config_
+        self.slots = _env_positive_int("MXNET_GENERATE_SLOTS") \
+            if slots is None else int(slots)
+        if self.slots < 1:
+            raise GenerateError("GenerativePredictor: slots must be >= 1, "
+                                "got %d" % self.slots)
+        self.page_size = _env_positive_int("MXNET_GENERATE_PAGE_SIZE") \
+            if page_size is None else int(page_size)
+        if self.page_size < 1:
+            raise GenerateError("GenerativePredictor: page_size must be "
+                                ">= 1, got %d" % self.page_size)
+        ctx_bound = config_.max_len if max_ctx is None \
+            else min(int(max_ctx), config_.max_len)
+        self.max_pages_per_slot = ctx_bound // self.page_size
+        if self.max_pages_per_slot < 1:
+            raise GenerateError(
+                "GenerativePredictor: page_size %d exceeds the context "
+                "bound %d" % (self.page_size, ctx_bound))
+        self.max_ctx = self.max_pages_per_slot * self.page_size
+
+        c = config_
+        dh = c.d_model // c.n_heads
+        cdt = jnp.dtype(c.dtype)
+        self.page_bytes = (c.n_layers * 2 * self.page_size * c.n_heads * dh
+                           * cdt.itemsize)
+        if pool_bytes is None:
+            pool_bytes = _env_nonneg_int("MXNET_GENERATE_POOL_BYTES")
+        pool_bytes = int(pool_bytes or 0)
+        if pool_bytes > 0:
+            num_pages = pool_bytes // self.page_bytes
+            if num_pages < self.max_pages_per_slot:
+                raise GenerateError(
+                    "MXNET_GENERATE_POOL_BYTES=%d holds %d page(s) of %d "
+                    "bytes — smaller than one full-context request "
+                    "(%d pages); raise the budget or shrink max_ctx/"
+                    "page_size" % (pool_bytes, num_pages, self.page_bytes,
+                                   self.max_pages_per_slot))
+        else:
+            num_pages = self.slots * self.max_pages_per_slot
+        self.pool = PagePool(num_pages)
+
+        if device is not None and hasattr(device, "jax_device"):
+            device = device.jax_device()
+        self._device = device
+        platform = device.platform if device is not None \
+            else jax.default_backend()
+        self._donate = platform != "cpu"
+        self._exec_cache = cache if cache is not None \
+            else ExecutableCache(None)
+        self._cache_key = model_name if model_name is not None \
+            else "gen-%d" % id(self)
+        self._dtype_name = str(cdt)
+
+        def put(a):
+            a = jnp.asarray(np.asarray(a))
+            return jax.device_put(a, device) if device is not None else a
+
+        self._params = {k: put(v) for k, v in params.items()}
+        self._kv = put(tfm.init_kv_cache(c, num_pages, self.page_size))
+        self.block_k = int(block_k) if block_k is not None \
+            else tfm._decode_block_k(c, self.slots, self.max_ctx)
+
+        # prefill bucket ladder: page-aligned powers of two up to the
+        # context bound (the PR 6 ladder idea at page granularity)
+        buckets, b = [], self.page_size
+        while b < self.max_ctx:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_ctx)
+        self.prefill_buckets = tuple(buckets)
+        self._lock = threading.Lock()
+
+    # -- compiled programs ---------------------------------------------------
+    def _jit(self, fn):
+        import jax
+
+        return jax.jit(fn, donate_argnums=(1,) if self._donate else ())
+
+    def _config_fingerprint(self):
+        """Everything a compiled program's closure bakes in besides the
+        bucket/slot tag: the model architecture and the page geometry.
+        Part of every cache key so two predictors sharing one
+        ExecutableCache under the same model name can never reuse each
+        other's programs."""
+        import dataclasses
+
+        return (tuple(sorted(dataclasses.asdict(self.config).items())),
+                self.page_size, self.max_pages_per_slot, self.block_k)
+
+    def _prefill_exec(self, bucket):
+        from ..models import transformer as tfm
+
+        key = (self._cache_key, ("prefill", bucket),
+               self._config_fingerprint(), self._dtype_name)
+        return self._exec_cache.get_or_build(
+            key, lambda: self._jit(tfm.make_prefill_fn(self.config,
+                                                       self.page_size)))
+
+    def _decode_exec(self):
+        from ..models import transformer as tfm
+
+        key = (self._cache_key, ("decode", self.slots),
+               self._config_fingerprint(), self._dtype_name)
+        return self._exec_cache.get_or_build(
+            key, lambda: self._jit(tfm.make_decode_fn(
+                self.config, self.slots, self.max_pages_per_slot,
+                self.page_size, block_k=self.block_k)))
+
+    # -- request surface -----------------------------------------------------
+    def pages_needed(self, prompt_len):
+        return -(-int(prompt_len) // self.page_size)
+
+    def pick_bucket(self, prompt_len):
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise GenerateError(
+            "prompt of %d tokens exceeds the per-slot context bound %d"
+            % (prompt_len, self.max_ctx))
+
+    def prefill(self, tokens, pages):
+        """Run one prompt (1-D int array) through the prefill program,
+        scattering K/V into ``pages`` (ids from :attr:`pool`); returns
+        the last position's logits as numpy (V,)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        length = int(tokens.shape[0])
+        if length < 1:
+            raise GenerateError("prefill: empty prompt")
+        if self.pages_needed(length) != len(pages):
+            raise GenerateError(
+                "prefill: %d-token prompt needs %d page(s), got %d"
+                % (length, self.pages_needed(length), len(pages)))
+        bucket = self.pick_bucket(length)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :length] = tokens
+        page_arr = np.zeros((bucket // self.page_size,), np.int32)
+        page_arr[:len(pages)] = pages   # tail pages hit scratch (0)
+        fn = self._prefill_exec(bucket)
+        with self._lock:
+            self._kv, logits = fn(self._params, self._kv, padded,
+                                  np.int32(length), page_arr)
+        return np.asarray(logits)
+
+    def decode(self, tokens, positions, block_tables, active):
+        """One decode step over all ``slots``; returns numpy logits
+        (slots, V). ``tokens[b]`` is written at ``positions[b]`` into
+        the page its slot's ``block_tables`` row names; inactive slots
+        write to scratch and return zero logits."""
+        fn = self._decode_exec()
+        with self._lock:
+            self._kv, logits = fn(
+                self._params, self._kv,
+                np.asarray(tokens, np.int32),
+                np.asarray(positions, np.int32),
+                np.asarray(block_tables, np.int32),
+                np.asarray(active, bool))
+        return np.asarray(logits)
+
+    def pool_stats(self):
+        return self.pool.stats()

@@ -135,14 +135,23 @@ def flash_candidates(seq_q, seq_k, blocks=None):
     """Search trajectory for flash attention block sizes. Blocks are
     clamped/rounded exactly the way ``flash_attention`` does, so two
     grid points resolving to the same effective pair dedupe; a block
-    larger than the (16-rounded) sequence is illegal (it would clamp
-    into another candidate's program)."""
+    larger than the clamped sequence is illegal (it would clamp into
+    another candidate's program). Decode shapes (ISSUE 12: seq_q == 1)
+    collapse every fixed-grid block_q to 1, so the smallest LEGAL
+    block per axis joins the grid — a decode sweep then searches the
+    block_k axis at block_q == 1 instead of pruning everything."""
     from ..kernels.flash_attention import effective_blocks
 
     if blocks is None:
+        # the smallest legal block per axis: 16 at normal shapes
+        # (already on the grid), the exact sequence below the 16-row
+        # tile — where every fixed-grid block clamps to it
+        min_bq = seq_q if 0 < seq_q < 16 else 16
+        min_bk = seq_k if 0 < seq_k < 16 else 16
         blocks = [dict(block_q=bq, block_k=bk)
-                  for bq, bk in itertools.product(FLASH_BLOCKS,
-                                                  FLASH_BLOCKS)]
+                  for bq, bk in itertools.product(
+                      _axis_values(FLASH_BLOCKS, min_bq),
+                      _axis_values(FLASH_BLOCKS, min_bk))]
     default_bq, default_bk = effective_blocks(128, 128, seq_q, seq_k)
     seen = {(default_bq, default_bk)}
     entries = [{"schedule": dict(block_q=default_bq, block_k=default_bk),

@@ -190,6 +190,30 @@ def test_replica_stall_wedges_from_request_on():
     assert again.replica_request() == "stall"
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 12: generative-serving fault kind
+# ---------------------------------------------------------------------------
+def test_generate_stall_fires_for_exactly_the_nth_request():
+    eng = ChaosEngine("generate:stall@req=3", role="worker", rank=0)
+    assert [eng.generate_request() for _ in range(5)] == \
+        [None, None, "stall", None, None], \
+        "exactly ONE request must lose its EOS, not every one after N"
+    # restart defaults to any (the serving loop has no incarnations)
+    again = ChaosEngine("generate:stall@req=1", role="replica", rank=2,
+                        restart=3)
+    assert again.generate_request() == "stall"
+
+
+def test_generate_spec_grammar():
+    parse_spec("generate:stall@req=2")
+    with pytest.raises(FaultSpecError):
+        parse_spec("generate:stall@step=2")   # req=N is required
+    with pytest.raises(FaultSpecError):
+        parse_spec("generate:crash@req=2")    # only stall is defined
+    with pytest.raises(FaultSpecError):
+        parse_spec("generate:0:stall@req=2")  # rank-free target
+
+
 def test_router_drop_count_and_phase():
     eng = ChaosEngine("router:drop@n=2,phase=reply", role="worker",
                       rank=0)

@@ -463,7 +463,24 @@ def _run_tuner(table, extra=()):
 def test_tune_kernels_cli_end_to_end(tmp_path):
     table = str(tmp_path / "table.json")
     rep = _run_tuner(table)
-    assert len(rep["tune"]) == 2
+    # fused_fwd + flash at the bench shape + flash at the ISSUE 12
+    # decode shape (seq_q=1 — part of the default sweep so decode
+    # blocks are tunable)
+    assert len(rep["tune"]) == 3
+    decode_keys = [k for k, r in rep["tune"].items()
+                   if r["kernel"] == "flash_attention"
+                   and r["shape"][2] == 1]
+    assert len(decode_keys) == 1
+    dec = rep["tune"][decode_keys[0]]
+    assert dec["shape"][5] == 0  # causal=0: decode masks by length
+    # block_q clamps to 1 at seq_q=1 (the effective_blocks fix); the
+    # search space is the block_k axis
+    assert dec["winner"]["schedule"]["block_q"] == 1
+    assert any(e["status"] in ("timed", "skipped_budget", "candidate")
+               and e["schedule"]["block_q"] == 1
+               and e["schedule"]["block_k"]
+               != dec["winner"]["default_schedule"]["block_k"]
+               for e in dec["trajectory"])
     for r in rep["tune"].values():
         assert not r["cache_hit"]
         assert any(e["status"] == "pruned_illegal" for e in r["trajectory"])
@@ -489,5 +506,6 @@ def test_tune_kernels_full_sweep(tmp_path):
         capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rep = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert len(rep["tune"]) == 4
+    # 3 fused kinds + flash at the bench shape + flash decode shape
+    assert len(rep["tune"]) == 5
     assert all(not r["cache_hit"] for r in rep["tune"].values())

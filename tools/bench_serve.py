@@ -19,6 +19,14 @@ dynamic batch-fill ratio in ONE bench.py-style JSON line.
 
 Acceptance (ISSUE 6): dynamic >= 2x sequential req/s at equal-or-better
 p99, swap completes with dropped == errors == 0.
+
+``--fleet`` (ISSUE 11) measures req/s scaling across replica processes;
+``--generate`` (ISSUE 12) measures the autoregressive-decode workload:
+the same Poisson arrival trace (sampled prompt/output lengths) replayed
+under continuous batching and under drain-whole-batch admission,
+reporting tokens/s, p99 time-to-first-token, and slot occupancy —
+acceptance is continuous >= 2x drain tokens/s at equal-or-better p99
+TTFT with every KV page returned.
 """
 import argparse
 import json
@@ -398,6 +406,120 @@ def measure_fleet(replicas=3, clients=24, seconds=6.0, think_ms=1.0,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# generate mode (ISSUE 12): continuous batching vs drain-whole-batch on
+# an autoregressive decode workload — Poisson arrivals, sampled
+# prompt/output lengths, tokens/s + p99 TTFT + slot occupancy.
+# ---------------------------------------------------------------------------
+def _sample_generate_workload(requests, rate, seed, max_prompt=32):
+    """Poisson arrival times + heavy-tailed lengths. Output lengths are
+    bimodal (mostly short, a long tail) — the realistic LLM shape, and
+    exactly the regime where drain-whole-batch wastes slots: a batch
+    runs as long as its LONGEST request while the short ones sit
+    finished."""
+    rng = random.Random(seed)
+    t, work = 0.0, []
+    for _ in range(requests):
+        t += rng.expovariate(rate)
+        prompt_len = rng.randint(4, max_prompt)
+        out_len = rng.randint(4, 12) if rng.random() < 0.75 \
+            else rng.randint(40, 64)
+        work.append((t, prompt_len, out_len))
+    return work
+
+
+def run_generate_mode(policy, config, params, workload, slots, page_size,
+                      seed=0):
+    """Replay one arrival trace against a fresh GenerateServer with the
+    given admission policy; returns the mode record."""
+    import numpy as np
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import GenerateServer
+
+    prompt_rng = random.Random(10_000 + seed)
+    profiler.generate_reset()
+    with GenerateServer(config, params, slots=slots, page_size=page_size,
+                        admit_policy=policy, name="bench-%s" % policy) as srv:
+        # warm every compiled program outside the clock: each prefill
+        # bucket the workload's prompt lengths can land in, plus the
+        # decode step (ridden by the warm requests' generated tokens)
+        need = {srv.predictor.pick_bucket(p) for _t, p, _o in workload}
+        for bucket in sorted(need):
+            warm_len = min(bucket, srv.predictor.max_ctx - 1)
+            srv.generate(np.ones((warm_len,), np.int32), max_new_tokens=2)
+        profiler.generate_reset()
+        futures = []
+        t0 = time.perf_counter()
+        for t_arrive, prompt_len, out_len in workload:
+            now = time.perf_counter() - t0
+            if now < t_arrive:
+                time.sleep(t_arrive - now)
+            prompt = np.asarray(
+                [prompt_rng.randrange(config.vocab)
+                 for _ in range(prompt_len)], np.int32)
+            futures.append(srv.submit(prompt, max_new_tokens=out_len))
+        results = [f.result(timeout=600) for f in futures]
+        wall = time.perf_counter() - t0
+        stats = profiler.generate_stats(reset=True)
+    tokens = sum(len(r["tokens"]) for r in results)
+    ttfts = sorted(r["ttft_s"] for r in results)
+    return {
+        "policy": policy,
+        "tokens_s": round(tokens / wall, 1),
+        "tokens": tokens,
+        "requests": len(results),
+        "wall_s": round(wall, 2),
+        "ttft_p50_ms": round(_pctl(ttfts, 0.50) * 1e3, 2),
+        "ttft_p99_ms": round(_pctl(ttfts, 0.99) * 1e3, 2),
+        "slot_occupancy": stats.get("slot_occupancy"),
+        "decode_steps": stats.get("decode_steps"),
+        "server_tokens_s": stats.get("tokens_s"),  # compute-time gauge
+        "pages_high_water": stats.get("pages_high_water"),
+        "pages_in_use_after": stats.get("pages_in_use"),
+    }
+
+
+def measure_generate(requests=64, rate=400.0, slots=8, page_size=16,
+                     seed=0, vocab=128, d_model=64, n_heads=4, n_layers=2,
+                     d_ff=128, max_len=256):
+    """The --generate record: the SAME Poisson arrival trace replayed
+    under continuous batching and under drain-whole-batch admission.
+    Acceptance (ISSUE 12): continuous >= 2x tokens/s at equal-or-better
+    p99 time-to-first-token, and every page returned after each run."""
+    import jax
+
+    from mxnet_tpu.models import transformer as tfm
+
+    config = tfm.TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=d_ff, max_len=max_len,
+        dtype="float32" if jax.default_backend() == "cpu" else "bfloat16")
+    params = tfm.init_params(config, seed=seed)
+    workload = _sample_generate_workload(requests, rate, seed)
+    drain = run_generate_mode("drain", config, params, workload, slots,
+                              page_size, seed=seed)
+    cont = run_generate_mode("continuous", config, params, workload,
+                             slots, page_size, seed=seed)
+    rec = {
+        "metric": "generate_throughput",
+        "value": cont["tokens_s"],
+        "unit": "tokens/s",
+        "speedup_vs_drain": round(cont["tokens_s"] / drain["tokens_s"], 2)
+        if drain["tokens_s"] else None,
+        "continuous": cont,
+        "drain": drain,
+        "requests": requests,
+        "arrival_rate": rate,
+        "slots": slots,
+        "page_size": page_size,
+        "model": {"vocab": vocab, "d_model": d_model, "n_heads": n_heads,
+                  "n_layers": n_layers, "d_ff": d_ff, "max_len": max_len},
+        "backend": jax.default_backend(),
+    }
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=32)
@@ -418,8 +540,29 @@ def main():
                          "--replicas replica PROCESSES behind a "
                          "FleetRouter, with a mid-run replica SIGKILL")
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--generate", action="store_true",
+                    help="generate mode (ISSUE 12): autoregressive "
+                         "decode under Poisson arrivals — continuous "
+                         "batching vs drain-whole-batch tokens/s, p99 "
+                         "TTFT, slot occupancy")
+    ap.add_argument("--requests", type=int, default=64,
+                    help="generate mode: arrivals per measured window")
+    ap.add_argument("--rate", type=float, default=400.0,
+                    help="generate mode: Poisson arrival rate (req/s) — "
+                         "the default offered load exceeds this host "
+                         "class's decode capacity on purpose: the "
+                         "continuous-vs-drain gap is an occupancy "
+                         "property, visible only when the decode loop, "
+                         "not the arrival process, is the bottleneck")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="generate mode: decode batch slots")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="generate mode: tokens per KV page")
     args = ap.parse_args()
-    if args.fleet:
+    if args.generate:
+        rec = measure_generate(requests=args.requests, rate=args.rate,
+                               slots=args.slots, page_size=args.page_size)
+    elif args.fleet:
         rec = measure_fleet(replicas=args.replicas, clients=args.clients,
                             seconds=args.seconds, think_ms=args.think_ms,
                             dim=args.dim, hidden=args.hidden,

@@ -43,6 +43,14 @@ driving requests instead of a training job):
 - ``router:drop@...``: injected router→replica connection drops
   (driver-side spec) — dropped forwards are retried, zero failed.
 
+Generative-serving kind (ISSUE 12; in-process GenerateServer):
+
+- ``generate:stall@req=N``: the N-th admitted generate request never
+  emits EOS — the ``MXNET_GENERATE_MAX_STEPS`` cap must finish it
+  (reason ``length``), its batch slot and KV pages must be reclaimed
+  (pool drains to zero), and the requests behind it must still finish
+  by EOS.
+
 Usage:
     python tools/chaos_check.py                      # worker crash
     python tools/chaos_check.py --spec 'server:0:crash@step=130'
@@ -81,6 +89,13 @@ SERVE_MATRIX = [
     "router:drop@n=2,phase=reply",
 ]
 
+#: generative-serving fault kind (ISSUE 12): an in-process
+#: GenerateServer — the request that never emits EOS must be finished
+#: by the max-decode-steps cap and its slot + KV pages reclaimed
+GENERATE_MATRIX = [
+    "generate:stall@req=2",
+]
+
 
 def _kind(spec):
     m = re.search(r":(crash|nan|preempt)@", spec)
@@ -89,6 +104,81 @@ def _kind(spec):
 
 def _is_serve_spec(spec):
     return spec.startswith(("replica:", "router:"))
+
+
+def _is_generate_spec(spec):
+    return spec.startswith("generate:")
+
+
+def run_generate_case(args, spec):
+    """One generative-serving fault case, fully in-process: a tiny
+    GenerateServer under ``generate:stall@req=N`` (the request that
+    never emits EOS). Passes only when the wedged request was finished
+    by the MXNET_GENERATE_MAX_STEPS cap (reason ``length``), every
+    OTHER request still finished by EOS (the reclaimed slot served
+    them), and the page pool drained back to zero — the reaction path
+    the cap + paged recycling exist for."""
+    import numpy as np
+
+    from mxnet_tpu import chaos, profiler
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.serving import GenerateServer
+
+    max_steps = 8
+    failures = []
+    os.environ["MXNET_FAULT_SPEC"] = spec
+    chaos.reset_engine()
+    profiler.generate_reset()
+    print("chaos_check[generate]: in-process GenerateServer "
+          "(MXNET_FAULT_SPEC=%s, max_steps=%d)" % (spec, max_steps),
+          flush=True)
+    try:
+        cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                    n_layers=2, d_ff=64, max_len=64,
+                                    dtype="float32")
+        params = tfm.init_params(cfg, seed=0)
+        prompt = np.arange(1, 9, dtype=np.int32)
+        with GenerateServer(cfg, params, slots=2, page_size=8,
+                            max_steps=max_steps) as srv:
+            # greedy decoding is deterministic: the first generated
+            # token doubles as the EOS id, so a HEALTHY request
+            # finishes after exactly one token
+            eos = srv.generate(prompt)["tokens"][0]
+            chaos.reset_engine()  # the probe request must not count
+            futs = [srv.submit(prompt, eos_id=eos) for _ in range(4)]
+            results = [f.result(timeout=120) for f in futs]
+            stats = profiler.generate_stats()
+        reasons = [r["finish_reason"] for r in results]
+        stalled = [i for i, r in enumerate(results)
+                   if r["finish_reason"] == "length"]
+        if stalled != [1]:
+            failures.append("expected exactly request 2 (index 1) to be "
+                            "capped, got reasons %s" % (reasons,))
+        elif len(results[1]["tokens"]) != max_steps:
+            failures.append("capped request generated %d tokens, cap is "
+                            "%d" % (len(results[1]["tokens"]), max_steps))
+        if sum(1 for r in reasons if r == "eos") != 3:
+            failures.append("healthy requests did not all finish by EOS "
+                            "after the wedged one's slot was reclaimed: "
+                            "%s" % (reasons,))
+        if stats.get("pages_in_use") != 0:
+            failures.append("page pool did not drain: pages_in_use=%r"
+                            % stats.get("pages_in_use"))
+        engine = chaos.engine()
+        if not (engine and any(r.fired for r in engine.rules)):
+            failures.append("fault spec never fired")
+    except Exception as e:
+        failures.append("driver failed: %s: %s" % (type(e).__name__, e))
+    finally:
+        os.environ.pop("MXNET_FAULT_SPEC", None)
+        chaos.reset_engine()
+    if failures:
+        print("chaos_check[generate]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[generate]: OK — cap finished the wedged request, "
+          "slot + pages reclaimed, healthy requests unharmed")
+    return 0
 
 
 def run_serve_case(args, spec):
@@ -349,10 +439,13 @@ def main():
                     help="launch.py watchdog per case (seconds)")
     args = ap.parse_args()
 
-    specs = (MATRIX + SERVE_MATRIX) if args.matrix else [args.spec]
+    specs = (MATRIX + SERVE_MATRIX + GENERATE_MATRIX) if args.matrix \
+        else [args.spec]
     rc = 0
     for spec in specs:
-        if _is_serve_spec(spec):
+        if _is_generate_spec(spec):
+            rc |= run_generate_case(args, spec)
+        elif _is_serve_spec(spec):
             rc |= run_serve_case(args, spec)
         else:
             rc |= run_case(args, spec)

@@ -54,29 +54,42 @@ def run_sweeps(args, on_tpu):
     w_shape = (3, 3, args.ci, args.co)
     for kernel in kernels:
         if kernel in tune.FUSED_KINDS:
-            rep = tune.sweep_fused(kernel, x_shape, w_shape,
-                                   stride=args.stride, dtype=args.dtype,
-                                   **common)
+            reps = [tune.sweep_fused(kernel, x_shape, w_shape,
+                                     stride=args.stride, dtype=args.dtype,
+                                     **common)]
         elif kernel == "flash_attention":
-            rep = tune.sweep_flash(args.flash_batch, args.heads, args.seq,
-                                   args.seq, args.head_dim,
-                                   causal=args.causal,
-                                   dtype=args.flash_dtype, **common)
+            reps = [tune.sweep_flash(args.flash_batch, args.heads,
+                                     args.seq, args.seq, args.head_dim,
+                                     causal=args.causal,
+                                     dtype=args.flash_dtype, **common)]
+            if args.decode:
+                # the generate-serving decode shape (ISSUE 12): one
+                # query per batch slot against the whole cached
+                # sequence. seq_q=1 clamps block_q to 1, so the sweep
+                # searches the block_k axis; causal=False because the
+                # decode query attends to ALL cached keys
+                # (length-masked), matching the consult key in
+                # models/transformer.decode_schedule_shape
+                reps.append(tune.sweep_flash(
+                    args.decode_slots, args.heads, 1, args.seq,
+                    args.head_dim, causal=False,
+                    dtype=args.flash_dtype, **common))
         else:
             raise SystemExit("unknown kernel %r (choose from %s)"
                              % (kernel, ",".join(tune.FUSED_KINDS
                                                  + ("flash_attention",))))
-        reports[rep["key"]] = rep
-        if rep["cache_hit"]:
-            print("%-50s cache hit  schedule=%s"
-                  % (rep["key"], rep["winner"]["schedule"]))
-        else:
-            w = rep["winner"]
-            print("%-50s timed %d/%d (pruned %d)  winner=%s  "
-                  "%.4f ms/iter (default %.4f, %.2fx)"
-                  % (rep["key"], rep["n_timed"], rep["n_candidates"],
-                     rep["n_pruned"], w["schedule"], w["ms_per_iter"],
-                     w["default_ms_per_iter"], w["speedup_vs_default"]))
+        for rep in reps:
+            reports[rep["key"]] = rep
+            if rep["cache_hit"]:
+                print("%-50s cache hit  schedule=%s"
+                      % (rep["key"], rep["winner"]["schedule"]))
+            else:
+                w = rep["winner"]
+                print("%-50s timed %d/%d (pruned %d)  winner=%s  "
+                      "%.4f ms/iter (default %.4f, %.2fx)"
+                      % (rep["key"], rep["n_timed"], rep["n_candidates"],
+                         rep["n_pruned"], w["schedule"], w["ms_per_iter"],
+                         w["default_ms_per_iter"], w["speedup_vs_default"]))
     return {"tune": reports, "backend": jax.default_backend(),
             "table": tune.default_table_path(),
             "tuning_stats": profiler.tuning_stats()}
@@ -109,6 +122,14 @@ def main(argv=None):
                     help="flash sweep dtype; must match the consumer's "
                          "compute dtype (the table key includes it) — "
                          "TransformerConfig defaults to bfloat16")
+    ap.add_argument("--no-decode", dest="decode", action="store_false",
+                    help="skip the generate-serving decode-shape flash "
+                         "sweep (seq_q=1, causal=0 — the key "
+                         "GenerativePredictor's paged decode consults)")
+    ap.set_defaults(decode=True)
+    ap.add_argument("--decode-slots", type=int, default=None,
+                    help="batch dim of the decode-shape sweep (default: "
+                         "MXNET_GENERATE_SLOTS's default, 8)")
     ap.add_argument("--budget", type=int, default=8,
                     help="max timed programs per kernel, default "
                          "baseline included (the rest of the legal "
@@ -157,6 +178,8 @@ def main(argv=None):
         args.seq = 1024 if on_tpu else 64
     if args.head_dim is None:
         args.head_dim = 128 if on_tpu else 16
+    if args.decode_slots is None:
+        args.decode_slots = 8 if on_tpu else 4
     if args.target_sec is None:
         args.target_sec = 0.5 if on_tpu else 0.1
 
