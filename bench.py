@@ -74,6 +74,8 @@ def _measure(variant):
         return _measure_fleet()
     if variant == "generate":
         return _measure_generate()
+    if variant == "quant":
+        return _measure_quant()
     if variant == "tune":
         return _measure_tune()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
@@ -296,6 +298,32 @@ def _measure_generate():
         print(json.dumps({"error": "generate: %s" % str(e)[:500]}))
 
 
+def _measure_quant():
+    """Quantized-serving variant (ISSUE 13): int8 post-training-
+    quantized serving vs bf16 on the same closed-loop Poisson trace
+    (tools/bench_serve.py --quant int8). The trajectory tracks int8
+    req/s, the speedup over bf16, both p99s, and the fixed-corpus
+    top-1 agreement — the acceptance pair is speedup > 1 at
+    equal-or-better p99 with agreement >= 99%."""
+    try:
+        from tools.bench_serve import measure_quant
+
+        rec = measure_quant(seconds=4.0)
+        print(json.dumps({
+            "variant": "quant",
+            "req_s": rec["int8"]["req_s"],
+            "speedup_vs_bf16": rec["speedup_vs_bf16"],
+            "p99_ms": rec["int8"]["p99_ms"],
+            "bf16_p99_ms": rec["bf16"]["p99_ms"],
+            "bf16_req_s": rec["bf16"]["req_s"],
+            "agreement_top1": rec["agreement_top1"],
+            "quantized_ops": rec["quantized_ops"],
+            "calib_batches": rec["calib_batches"],
+        }))
+    except Exception as e:
+        print(json.dumps({"error": "quant: %s" % str(e)[:500]}))
+
+
 def _measure_tune():
     """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
     space at the bench shapes (tools/tune_kernels.py) and record the
@@ -374,6 +402,9 @@ def _report(results, kernels=None):
     if "generate" in results:
         rec["generate"] = {k: v for k, v in results["generate"].items()
                            if k != "variant"}
+    if "quant" in results:
+        rec["quant"] = {k: v for k, v in results["quant"].items()
+                        if k != "variant"}
     if "tune" in results:
         rec["tune"] = {k: v for k, v in results["tune"].items()
                        if k != "variant"}
@@ -436,9 +467,9 @@ def main():
     # if it kills this process mid-attempt the round still lands a
     # number.
     for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "tune",
+                    "generate", "quant", "tune",
                     "unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "tune"):
+                    "generate", "quant", "tune"):
         if variant in results:
             continue
         if time.time() > deadline - 60:

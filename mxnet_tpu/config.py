@@ -308,6 +308,30 @@ KNOBS = {
         "60", "honored",
         "seconds submit() may block on backpressure before raising "
         "(serving/broker.py)"),
+    # --- graph IR passes + quantized serving (ISSUE 13) ---
+    "MXNET_IR_PASSES": (
+        "fusion", "honored",
+        "default pass pipeline for ir.apply_passes(passes=None): a "
+        "comma list of registered pass names (fusion|residual|"
+        "quantize); unknown names raise naming this knob "
+        "(ir/passes.py)"),
+    "MXNET_IR_FUSE": (
+        "1", "honored",
+        "kill switch for rule-based fusion in the model builders: "
+        "build_resnet(fused=True) applies the IR fusion pass when 1, "
+        "returns the unfused graph when 0 (models/resnet.py); 0|1, "
+        "anything else raises"),
+    "MXNET_SERVE_QUANT": (
+        "none", "honored",
+        "default serving quantization mode when AOTPredictor "
+        "quant=None: 'none' or 'int8' (int8 needs calib_data= — "
+        "asking without it raises CalibrationError) "
+        "(serving/predictor.py, ir/quantize.py)"),
+    "MXNET_QUANT_CALIB_BATCHES": (
+        "8", "honored",
+        "max calibration batches the int8 quantization pass consumes "
+        "from the provided calibration data; integer >= 1 "
+        "(ir/quantize.py)"),
     # --- serving fleet (ISSUE 11) ---
     "MXNET_FLEET_RETRIES": (
         "2", "honored",

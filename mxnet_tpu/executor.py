@@ -208,7 +208,18 @@ def _solve_shapes(symbol, known_shapes, type_dict, partial=False):
             if hints:
                 for pname, shape in hints.items():
                     for an, (inode, _) in zip(node._arity or (), node.inputs):
-                        if an == pname and inode.is_variable() and inode.name not in shapes:
+                        if an != pname:
+                            continue
+                        # the int8 serving path routes weights through
+                        # an in-graph _quantize_rows_int8 node (shape-
+                        # preserving on output 0): the hint lands on
+                        # the variable BEHIND it
+                        if (not inode.is_variable()
+                                and inode.op.name == "_quantize_rows_int8"
+                                and inode.inputs
+                                and inode.inputs[0][0].is_variable()):
+                            inode = inode.inputs[0][0]
+                        if inode.is_variable() and inode.name not in shapes:
                             shapes[inode.name] = shape
                             in_structs = get_in_structs(node)
                             progress = True
@@ -278,13 +289,18 @@ def _param_shape_hints(node, in_shapes):
     if data is None:
         return {}
     hints = {}
-    if op in ("Convolution", "Convolution_v1"):
+    if op in ("Convolution", "Convolution_v1", "_ConvResidualAdd",
+              "_int8_convolution"):
+        # the IR rewrites (_ConvResidualAdd, the int8 serving conv)
+        # keep Convolution's weight contract exactly
         kernel = tuple(int(k) for k in attrs.get("kernel", ()))
         nf = int(attrs.get("num_filter", 1))
         ng = int(attrs.get("num_group", 1))
         hints["weight"] = (nf, data[1] // ng) + kernel
         if not attrs.get("no_bias"):
             hints["bias"] = (nf,)
+        if op == "_int8_convolution":
+            hints["wscale"] = (nf,)
     elif op == "FusedBottleneckUnit":
         # data is NHWC; weights keep the unfused OIHW checkpoint shapes
         nf = int(attrs.get("num_filter", 1))
@@ -306,7 +322,7 @@ def _param_shape_hints(node, in_shapes):
         hints["weight"] = (data[1], nf // ng) + kernel
         if not attrs.get("no_bias", True):
             hints["bias"] = (nf,)
-    elif op == "FullyConnected":
+    elif op in ("FullyConnected", "_int8_fully_connected"):
         nh = int(attrs.get("num_hidden", 1))
         flatten = attrs.get("flatten", True)
         in_dim = 1
@@ -318,6 +334,8 @@ def _param_shape_hints(node, in_shapes):
         hints["weight"] = (nh, in_dim)
         if not attrs.get("no_bias"):
             hints["bias"] = (nh,)
+        if op == "_int8_fully_connected":
+            hints["wscale"] = (nh,)
     elif op in ("BatchNorm", "BatchNorm_v1", "batch_norm"):
         ax = int(attrs.get("axis", 1)) % len(data)
         c = data[ax]

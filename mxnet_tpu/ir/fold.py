@@ -1,0 +1,142 @@
+"""Bind-time constant folding: ONE split shared by every binder.
+
+Reference counterpart: nnvm's constant-folding pass as deployed by
+Relay (arXiv:1810.00952) at compile time. This used to live inside
+``serving/predictor.py`` as a bespoke trio of AOTPredictor methods;
+hoisted here (ISSUE 13) so the serving tier, the C-predict ABI
+(``c_predict.py`` binds through AOTPredictor) and any future binder
+split the graph the same way:
+
+- :meth:`FoldPlan` partitions the graph on data dependence
+  (``Symbol.data_dependent_nodes``): every node that is a pure function
+  of the weights is assigned to a jitted *fold* program evaluated once
+  per parameter set; its outputs cross into the per-request program as
+  plain array arguments (``const_specs``), so a request executes only
+  the data-dependent suffix.
+- The int8 quantization pass (``ir/quantize.py``) leans on exactly this
+  split: it rewrites weights into ``weight -> quantize`` subgraphs and
+  the fold plan evaluates them ahead of time — weight quantization at
+  bind/swap time falls out of the shared pass instead of needing its
+  own machinery.
+
+Each plan records into ``profiler.pass_stats`` (pass name ``fold``:
+folded node count) so ``dump_profile``'s ``passStats`` shows what bind
+time precomputed.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class FoldPlan:
+    """The bind-time fold/dynamic split of one symbol graph.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The inference graph.
+    dynamic_names : iterable of str
+        Variable names whose values change per request (data inputs and
+        zero-filled extras). Everything else is a weight: nodes
+        untouched by dynamic variables fold.
+    """
+
+    def __init__(self, symbol, dynamic_names):
+        from .. import profiler
+
+        self.symbol = symbol
+        self.nodes = symbol._topo()
+        self.node_ids = {id(n): i for i, n in enumerate(self.nodes)}
+        self.entries = list(symbol._entries)
+        self.dynamic_names = set(dynamic_names)
+        self.dyn = symbol.data_dependent_nodes(self.dynamic_names)
+        self.const_specs, self.const_index = self._collect_const_specs()
+        self.fold_order = self._collect_fold_order()
+        profiler.pass_record("fold", hits=1,
+                             folded=len(self.fold_order))
+
+    @property
+    def folded_nodes(self):
+        return len(self.fold_order)
+
+    @property
+    def dynamic_nodes(self):
+        return len([i for i in self.dyn
+                    if not self.nodes[i].is_variable()])
+
+    def provenance(self):
+        return {"pass": "fold", "folded_nodes": self.folded_nodes,
+                "dynamic_nodes": self.dynamic_nodes,
+                "const_specs": len(self.const_specs)}
+
+    # -- the split -----------------------------------------------------------
+    def _collect_const_specs(self):
+        """Ordered, deduped list of values that cross from the fold
+        side into the per-request program: ('var', name) for frozen
+        weights consumed directly, ('node', i, idx) for folded node
+        outputs."""
+        specs, index = [], {}
+
+        def add(spec):
+            if spec not in index:
+                index[spec] = len(specs)
+                specs.append(spec)
+
+        def classify(inp, idx):
+            if inp.is_variable():
+                if inp.name not in self.dynamic_names:
+                    add(("var", inp.name))
+                return
+            nid = self.node_ids[id(inp)]
+            if nid not in self.dyn:
+                add(("node", nid, idx))
+
+        for i, node in enumerate(self.nodes):
+            if node.is_variable() or i not in self.dyn:
+                continue
+            for inp, idx in node.inputs:
+                classify(inp, idx)
+        for node, idx in self.entries:
+            classify(node, idx)
+        return specs, index
+
+    def _collect_fold_order(self):
+        """Topo-ordered indices of the non-dynamic compute nodes the
+        fold program must evaluate (the backward closure of the node
+        const specs)."""
+        needed = set()
+        stack = [s[1] for s in self.const_specs if s[0] == "node"]
+        while stack:
+            i = stack.pop()
+            if i in needed:
+                continue
+            needed.add(i)
+            for inp, _ in self.nodes[i].inputs:
+                if not inp.is_variable():
+                    stack.append(self.node_ids[id(inp)])
+        return sorted(needed)
+
+    def make_fold_fn(self, key):
+        """The fold program: ``params dict -> tuple`` of const values
+        in ``const_specs`` order. Jitted when there is anything to
+        compute; a pure reshuffle of frozen weights stays eager."""
+        from ..executor import eval_node
+
+        specs = self.const_specs
+        order = self.fold_order
+        nodes, node_ids = self.nodes, self.node_ids
+
+        def fold(params):
+            results = {}
+            for i in order:
+                node = nodes[i]
+                ins = [params[inp.name] if inp.is_variable()
+                       else results[node_ids[id(inp)]][idx]
+                       for inp, idx in node.inputs]
+                results[i] = eval_node(node, ins, key, i, False)
+            return tuple(params[s[1]] if s[0] == "var"
+                         else results[s[1]][s[2]] for s in specs)
+
+        if order:
+            return jax.jit(fold)
+        return fold

@@ -1,0 +1,257 @@
+"""Int8 post-training quantization for the serving path (ISSUE 13).
+
+The nncase lesson (arXiv:2512.21571): post-training int8 is the
+serving-throughput lever, and it composes as a *pass* (Relay,
+arXiv:1810.00952) rather than a parallel model format. The pipeline:
+
+1. **Calibrate** — run the unmodified graph over a handful of
+   representative batches (``MXNET_QUANT_CALIB_BATCHES`` caps how many
+   are consumed) and record the absmax of every activation entering a
+   quantizable op. One symmetric per-tensor scale per boundary;
+   per-output-channel scales for weights come later, in-graph.
+2. **Rewrite** — a :class:`~.passes.RulePass` over the same rule
+   machinery as fusion: ``FullyConnected``/``Convolution`` become
+   ``_quantize_int8(data) -> _int8_*`` with the weight routed through
+   an in-graph ``_quantize_rows_int8`` node. Because that node is a
+   pure function of the weight variable, the shared bind-time fold
+   pass (``ir/fold.py``) evaluates it ONCE per parameter set: weights
+   are quantized ahead of time, activations at the bound boundaries,
+   and a hot swap re-runs the fold, requantizing the WEIGHTS (with
+   fresh per-channel scales) automatically. The activation scales are
+   calibration-time constants baked into the compiled programs: a swap
+   to weights whose activation distribution shifted materially (a much
+   later epoch, different regularization) should rebind with fresh
+   calibration data instead — stale activation scales clip at the old
+   range.
+3. **Bind** — the rewritten symbol has the SAME argument/aux names as
+   the original, so it binds through the existing ``AOTPredictor``
+   ladder untouched: bucket padding, executable cache, swap, server,
+   fleet and C-ABI machinery all work unchanged.
+
+Numerically-sensitive ops (softmax, BatchNorm statistics, everything
+that is not an FC/conv MAC) are never rewritten — they keep the
+serving float dtype. Calibration ranges land in
+``profiler.pass_stats`` as per-tensor-group gauges; asking for
+quantization with no/empty calibration data raises
+:class:`CalibrationError`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import config
+from ..base import MXNetError
+from ..symbol.symbol import Symbol
+from .match import Pat
+from .passes import RulePass
+from .rules import Rule, _sym
+
+_SCALE_FLOOR = 1e-12
+
+# ops the pass may rewrite; everything else stays float on purpose
+QUANTIZABLE_OPS = ("FullyConnected", "Convolution")
+
+
+class CalibrationError(MXNetError):
+    """Quantization was asked for without usable calibration data
+    (none, empty, or batches missing a model input)."""
+
+
+def _target_nodes(symbol, exclude=()):
+    """Topo-ordered (node, data_entry) for every quantizable op whose
+    data and weight wiring the rewrite understands."""
+    from .match import node_attr
+
+    out = []
+    for node in symbol._topo():
+        if node.is_variable() or node.op.name not in QUANTIZABLE_OPS:
+            continue
+        if node.name in exclude:
+            continue
+        if len(node.inputs) < 2 or not node.inputs[1][0].is_variable():
+            continue  # computed weights: leave float
+        if len(node.inputs) > 2 and not node.inputs[2][0].is_variable():
+            continue  # computed bias: the rewrite patterns require a
+            # variable — don't calibrate what can't be rewritten
+        if node.op.name == "Convolution" \
+                and len(tuple(node_attr(node, "kernel") or ())) != 2:
+            continue  # _int8_convolution is 2-D (NCHW/OIHW) only;
+            # 1-D/3-D convs stay float rather than crash at bind
+        out.append((node, node.inputs[0]))
+    return out
+
+
+def normalize_calib_batches(calib_data, data_names):
+    """Accept a list of ``{input: array}`` dicts, a single dict, or —
+    for single-input models — a list of arrays / one array. Returns a
+    non-empty list of dicts or raises :class:`CalibrationError`."""
+    if calib_data is None:
+        raise CalibrationError(
+            "int8 quantization needs calibration data (a list of "
+            "{input: array} batches); got None")
+    if isinstance(calib_data, dict):
+        calib_data = [calib_data]
+    elif isinstance(calib_data, np.ndarray):
+        calib_data = [calib_data]
+    batches = []
+    for b in calib_data:
+        if not isinstance(b, dict):
+            if len(data_names) != 1:
+                raise CalibrationError(
+                    "model has inputs %s: calibration batches must be "
+                    "{name: array} dicts" % list(data_names))
+            b = {data_names[0]: b}
+        missing = sorted(set(data_names) - set(b))
+        if missing:
+            raise CalibrationError(
+                "calibration batch is missing model inputs %s" % missing)
+        batches.append({k: np.asarray(b[k]) for k in data_names})
+    if not batches:
+        raise CalibrationError(
+            "int8 quantization needs at least one calibration batch; "
+            "got an empty list")
+    return batches
+
+
+def calibrate(symbol, params, calib_batches, exclude=()):
+    """Per-boundary activation scales from representative batches.
+
+    Returns ``(scales, report)``: ``scales`` maps quantizable-node name
+    -> float scale; ``report`` carries the absmax/scale per tensor
+    group (also published as profiler gauges)."""
+    import jax
+
+    from .. import profiler
+    from ..executor import _graph_closure
+
+    targets = _target_nodes(symbol, exclude)
+    if not targets:
+        return {}, {}
+    batches = calib_batches
+    entries, owners = [], []
+    for node, entry in targets:
+        entries.append(entry)
+        owners.append(node.name)
+    sub = Symbol(entries)
+    closure = jax.jit(_graph_closure(sub, is_train=False))
+    key = jax.random.PRNGKey(0)
+    values = {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+              for k, v in params.items()}
+    needed = set(sub.list_inputs())
+    absmax = {name: 0.0 for name in owners}
+    for batch in batches:
+        vals = {k: v for k, v in values.items() if k in needed}
+        vals.update({k: v for k, v in batch.items() if k in needed})
+        missing = sorted(needed - set(vals))
+        if missing:
+            raise CalibrationError(
+                "calibration cannot evaluate the graph: unbound "
+                "variables %s (not in params or the batch)" % missing)
+        outs, _aux = closure(vals, key)
+        for name, out in zip(owners, outs):
+            m = float(np.max(np.abs(np.asarray(out, np.float32))))
+            if m > absmax[name]:
+                absmax[name] = m
+    scales, report = {}, {}
+    for name in owners:
+        scale = max(absmax[name] / 127.0, _SCALE_FLOOR)
+        scales[name] = scale
+        report[name] = {"absmax": round(absmax[name], 6),
+                        "scale": scale, "bits": 8}
+        profiler.pass_calibration(name, absmax=absmax[name], scale=scale)
+    return scales, report
+
+
+class _QuantizeRule(Rule):
+    """FC/conv -> int8 pipeline, scale looked up by node name (names
+    survive the splice, node identities do not)."""
+
+    name = "int8_rewrite"
+
+    def __init__(self, scales):
+        self._scales = scales
+
+        def op_pat(opname, with_bias):
+            ins = [Pat(name="x"), Pat.var("w")]
+            if with_bias:
+                ins.append(Pat.var("b"))
+            return Pat(opname, inputs=ins, name="op",
+                       where=lambda n: n.name in scales)
+
+        self._patterns = tuple(
+            op_pat(opname, wb)
+            for opname in QUANTIZABLE_OPS for wb in (False, True))
+
+    @property
+    def pattern(self):
+        return self._patterns[0]
+
+    @property
+    def patterns(self):
+        return self._patterns
+
+    def rewrite(self, m):
+        from .. import symbol as sym
+        from .match import node_attr
+
+        node = m.node("op")
+        scale = self._scales[node.name]
+        xq = sym._quantize_int8(_sym(m["x"]), scale=scale,
+                                name=node.name + "_xq")
+        wq = sym._quantize_rows_int8(_sym(m["w"]),
+                                     name=node.name + "_wq")
+        kwargs = dict(data=xq, weight=wq[0], wscale=wq[1],
+                      scale=scale, name=node.name)
+        if "b" in m:
+            kwargs["bias"] = _sym(m["b"])
+        if node.op.name == "FullyConnected":
+            for k in ("num_hidden", "no_bias", "flatten"):
+                kwargs[k] = node_attr(node, k)
+            return sym._int8_fully_connected(**kwargs)
+        for k in ("kernel", "stride", "dilate", "pad", "num_filter",
+                  "num_group", "no_bias"):
+            kwargs[k] = node_attr(node, k)
+        return sym._int8_convolution(**kwargs)
+
+
+class QuantizePass(RulePass):
+    def __init__(self, scales):
+        super().__init__("quantize", [_QuantizeRule(scales)])
+
+    def apply(self, symbol):
+        from .. import profiler
+
+        symbol, prov = super().apply(symbol)
+        if prov["rewrites"]:
+            profiler.pass_record("quantize",
+                                 quantized=prov["rewrites"])
+        prov["quantized_ops"] = prov["rewrites"]
+        return symbol, prov
+
+
+def quantize_for_serving(symbol, params, calib_data, data_names,
+                         exclude=()):
+    """The serving entry point: calibrate + rewrite.
+
+    ``params`` is the full ``{name: array}`` weight+aux dict of the
+    UNquantized graph; ``calib_data`` a list of representative input
+    batches (see :func:`normalize_calib_batches`). Returns
+    ``(quantized_symbol, report)`` — the symbol has identical
+    argument/aux names, so any existing binder accepts it."""
+    batches = normalize_calib_batches(calib_data, data_names)
+    # the knob caps how many provided batches are consumed; validated
+    # (and read) unconditionally so a malformed value raises even for
+    # a single-batch calibration
+    max_batches = config.get_positive_int("MXNET_QUANT_CALIB_BATCHES")
+    batches = batches[:max_batches]
+    exclude = set(exclude or ())
+    scales, calib_report = calibrate(symbol, params, batches, exclude)
+    if not scales:
+        return symbol, {"quantized_ops": 0, "calibration": {},
+                        "note": "no quantizable ops in the graph"}
+    qsym, prov = QuantizePass(scales).apply(symbol)
+    report = {"quantized_ops": prov["quantized_ops"],
+              "calibration": calib_report,
+              "calib_batches": len(batches),
+              "provenance": prov}
+    return qsym, report

@@ -10,15 +10,7 @@ from .. import symbol as sym
 
 
 def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
-                  bn_mom=0.9, fused=False):
-    if fused and bottle_neck:
-        # whole unit as one Pallas-fused op in NHWC — same math and the
-        # same parameter names/shapes as the unfused graph below
-        # (kernels/fused_block.py); stride arrives as an (s, s) pair
-        s = stride[0] if isinstance(stride, (tuple, list)) else stride
-        return sym.FusedBottleneckUnit(
-            data, num_filter=num_filter, stride=int(s),
-            dim_match=bool(dim_match), eps=2e-5, momentum=bn_mom, name=name)
+                  bn_mom=0.9):
     if bottle_neck:
         bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
@@ -58,7 +50,6 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
            bottle_neck=True, bn_mom=0.9, fused=False):
     num_unit = len(units)
     assert num_unit == num_stages
-    fused = fused and bottle_neck
     data = sym.var("data")
     nchannel, height, _ = image_shape
     data = sym.identity(data=data, name="id")
@@ -73,28 +64,38 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Activation(data=body, act_type="relu", name="relu0")
         body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max")
 
-    if fused:
-        # the whole residual stack runs NHWC (channels on the TPU lane
-        # dim); two transposes bracket it — negligible next to the
-        # per-unit HBM passes they eliminate
-        body = sym.transpose(body, axes=(0, 2, 3, 1), name="to_nhwc")
     for i in range(num_stages):
         stride = (1, 1) if i == 0 else (2, 2)
         body = residual_unit(body, filter_list[i + 1], stride, False,
                              name="stage%d_unit%d" % (i + 1, 1), bottle_neck=bottle_neck,
-                             bn_mom=bn_mom, fused=fused)
+                             bn_mom=bn_mom)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
-                                 bottle_neck=bottle_neck, bn_mom=bn_mom, fused=fused)
-    if fused:
-        body = sym.transpose(body, axes=(0, 3, 1, 2), name="to_nchw")
+                                 bottle_neck=bottle_neck, bn_mom=bn_mom)
     bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5, momentum=bn_mom, name="bn1")
     relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
     pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7), pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
-    return sym.SoftmaxOutput(data=fc1, name="softmax")
+    out = sym.SoftmaxOutput(data=fc1, name="softmax")
+    if fused and bottle_neck and _fuse_enabled():
+        # rule-based fusion (ISSUE 13): the builder always emits the
+        # unfused graph; the IR fusion pass recognizes each bottleneck
+        # unit and rewrites it to FusedBottleneckUnit, with the
+        # transpose-cancel rule merging the per-unit NHWC brackets
+        # into one pair around the whole residual stack — bit-exactly
+        # the graph the old fused=True branch emitted by hand.
+        from .. import ir
+
+        out = ir.apply_passes(out, passes=("fusion",))
+    return out
+
+
+def _fuse_enabled():
+    from .. import config
+
+    return config.get_strict_bool("MXNET_IR_FUSE")
 
 
 _IMAGENET_DEPTHS = {

@@ -8,4 +8,5 @@ from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
 from . import custom  # noqa: F401
 from . import fused  # noqa: F401
+from . import quantized  # noqa: F401
 from .registry import get, list_ops, register  # noqa: F401

@@ -4,8 +4,10 @@ Reference counterpart: none as an *op* — the reference reaches these
 fusion boundaries with cuDNN/NNVM graph passes (conv+BN folding is an
 inference-only trick there, src/operator/nn/batch_norm.cc keeps training
 unfused). On TPU the training-time fusion is the single remaining perf
-lever (PROFILE.md), so the framework exposes it as a first-class op the
-symbolic ResNet builder emits when ``fused=True``.
+lever (PROFILE.md), so the framework exposes it as a first-class op that
+the IR fusion pass (``mxnet_tpu/ir/rules.py`` ``bottleneck_fuse``)
+emits when rewriting the unfused builder graph (``fused=True`` routes
+through that pass since ISSUE 13).
 
 Checkpoint parity: parameter names and OIHW weight shapes match the
 unfused builder exactly ("stageX_unitY_conv1_weight",
@@ -107,6 +109,40 @@ def fused_bottleneck_unit(
             data, w1, w2, w3, wsc, bn1_gamma, bn1_beta, bn2_gamma, bn2_beta,
             bn3_gamma, bn3_beta, *moving, stride=s, eps=float(eps))
     return (out,) + moving
+
+
+@register(name="_ConvResidualAdd")
+def _conv_residual_add(
+    data,
+    weight,
+    residual,
+    bias=None,
+    kernel=(),
+    stride=(),
+    dilate=(),
+    pad=(),
+    num_filter=1,
+    num_group=1,
+    workspace=1024,
+    no_bias=False,
+    layout=None,
+):
+    """Convolution with the residual add fused into its epilogue.
+
+    Emitted by the ``residual_conv_epilogue`` IR rule
+    (``mxnet_tpu/ir/rules.py``): ``Convolution(x, w[, b]) + residual``
+    becomes one op, so the add rides the convolution's epilogue (XLA
+    fuses the elementwise tail into the conv consumer; the Pallas
+    conv-family schedule applies — the rule names ``fused_fwd`` in the
+    autotuner's sweepable set). Same math as the unfused pair, exactly.
+    """
+    from .nn import convolution
+
+    out = convolution(data, weight, bias, kernel=kernel, stride=stride,
+                      dilate=dilate, pad=pad, num_filter=num_filter,
+                      num_group=num_group, workspace=workspace,
+                      no_bias=no_bias, layout=layout)
+    return out + residual
 
 
 _POST_REGISTERED = False

@@ -110,6 +110,9 @@ def dump_profile():
     gen = generate_stats()
     if gen:
         payload["generateStats"] = gen
+    passes = pass_stats()
+    if passes:
+        payload["passStats"] = passes
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -591,6 +594,77 @@ def generate_reset():
         _GEN.update(_GEN_ZERO)
         _GEN_PAGES.clear()
         _GEN_TTFT = None
+
+
+# ---------------------------------------------------------------------------
+# IR-pass observability (ISSUE 13): always-on counters for the graph
+# pass framework — per-pass rule hits and nodes rewritten (fusion),
+# folded-node counts (the shared bind-time constant-fold split),
+# quantized-op counts, and a per-tensor-group calibration GAUGE
+# (absmax + chosen int8 scale, latest calibration wins). Always-on
+# like comm_record; rides dump_profile as passStats. Unknown counter
+# names raise (the fleet_record rule).
+# ---------------------------------------------------------------------------
+_PASS_LOCK = threading.Lock()
+_PASS_COUNTERS = ("hits", "rewritten", "folded", "quantized")
+_PASS = {}
+_PASS_CALIB = {}
+
+
+def pass_record(pass_name, rule=None, **adds):
+    """Accumulate IR-pass counters (thread-safe). ``rule`` attributes
+    ``hits`` to that rule's split under the pass. Unknown counter
+    names raise — a typo'd counter would silently vanish from the
+    acceptance evidence."""
+    with _PASS_LOCK:
+        s = _PASS.get(pass_name)
+        if s is None:
+            s = _PASS[pass_name] = {k: 0 for k in _PASS_COUNTERS}
+            s["rules"] = {}
+        for k, v in adds.items():
+            if k not in _PASS_COUNTERS:
+                raise ValueError("pass_record: unknown counter %r" % k)
+            s[k] += int(v)
+        if rule is not None and adds.get("hits"):
+            s["rules"][rule] = s["rules"].get(rule, 0) \
+                + int(adds["hits"])
+
+
+def pass_calibration(group, **fields):
+    """Replace one tensor-group's calibration gauge (absmax, scale)."""
+    with _PASS_LOCK:
+        _PASS_CALIB[group] = dict(fields)
+
+
+def pass_stats(reset=False):
+    """{"passes": {name: {hits, nodes_rewritten, folded_nodes,
+    quantized_ops, rules}}, "calibration": {group: gauge}}; empty dict
+    when no pass ever ran."""
+    with _PASS_LOCK:
+        snap = {name: dict(s, rules=dict(s["rules"]))
+                for name, s in _PASS.items()}
+        calib = {g: dict(v) for g, v in _PASS_CALIB.items()}
+        if reset:
+            _PASS.clear()
+            _PASS_CALIB.clear()
+    if not (snap or calib):
+        return {}
+    passes = {}
+    for name, s in snap.items():
+        passes[name] = {
+            "hits": s["hits"], "nodes_rewritten": s["rewritten"],
+            "folded_nodes": s["folded"], "quantized_ops": s["quantized"],
+            "rules": s["rules"]}
+    out = {"passes": passes}
+    if calib:
+        out["calibration"] = calib
+    return out
+
+
+def pass_reset():
+    with _PASS_LOCK:
+        _PASS.clear()
+        _PASS_CALIB.clear()
 
 
 def pause():

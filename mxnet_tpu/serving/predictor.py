@@ -108,6 +108,22 @@ def env_positive_int(name, default):
     return value
 
 
+def _resolve_quant(quant):
+    """Serving quantization mode: the explicit argument wins, else the
+    MXNET_SERVE_QUANT knob; 'none'/'int8' only, anything else raises
+    naming its source."""
+    from .. import config
+
+    if quant is None:
+        return config.get_choice("MXNET_SERVE_QUANT", ("none", "int8"))
+    mode = str(quant).strip().lower()
+    if mode not in ("none", "int8"):
+        raise ServingError(
+            "quant=%r: serving quantization must be 'none' or 'int8'"
+            % (quant,))
+    return mode
+
+
 def env_positive_float(name, default):
     raw = os.environ.get(name)
     if raw is None or raw == "":
@@ -218,12 +234,14 @@ class AOTPredictor:
     def __init__(self, symbol, arg_params=None, aux_params=None,
                  data_shapes=None, ladder=DEFAULT_LADDER, dtype="float32",
                  device=None, output_names=None, cache=None,
-                 model_name=None, rng_seed=0):
+                 model_name=None, rng_seed=0, quant=None, calib_data=None,
+                 quant_exclude=()):
         if not data_shapes:
             raise ServingError("AOTPredictor: data_shapes is required "
                                "({input_name: shape})")
         if output_names:
             symbol = _pick_internals(symbol, output_names)
+        self._quant = _resolve_quant(quant)
         self._sym = symbol
         self._data_shapes = {k: tuple(v) for k, v in data_shapes.items()}
         self._data_names = sorted(self._data_shapes)
@@ -268,6 +286,44 @@ class AOTPredictor:
                 "AOTPredictor: zero-filling arguments absent from the "
                 "params: %s" % self._extra_names, stacklevel=2)
 
+        # ---- int8 post-training quantization (ISSUE 13) -------------------
+        # Applied as an IR pass BEFORE the fold split: weights route
+        # through in-graph _quantize_rows_int8 nodes, which are pure
+        # functions of the params — the shared fold pass below
+        # evaluates them once per parameter set (and again on every
+        # swap, requantizing the WEIGHTS), so weights are quantized
+        # ahead of time while activations quantize at the bound
+        # boundary inside the per-request program. Activation scales
+        # are calibration-time constants: a swap to a distribution-
+        # shifted checkpoint should rebind with fresh calib_data.
+        # Argument/aux names are unchanged, so the ladder/cache/swap
+        # machinery runs untouched.
+        self.quant_report = None
+        self._quant_fingerprint = "none"
+        if self._quant == "int8":
+            import hashlib
+            import json as _json
+
+            from .. import ir
+
+            merged = {n: arg_params[n] for n in self._weight_names}
+            merged.update({n: aux_params[n] for n in self._bound_aux})
+            symbol, self.quant_report = ir.quantize_for_serving(
+                symbol, merged, calib_data, self._data_names,
+                exclude=quant_exclude)
+            self._sym = symbol
+            # the calibrated activation scales are baked into the
+            # traced programs as graph attrs — two int8 binds with
+            # different calibration (or an int8 and a float bind)
+            # under one shared-cache model name must never resolve to
+            # each other's executables (the PR 12 GenerativePredictor
+            # key lesson)
+            scales = {k: v["scale"] for k, v in
+                      self.quant_report.get("calibration", {}).items()}
+            self._quant_fingerprint = "int8-" + hashlib.sha1(
+                _json.dumps(sorted(scales.items())).encode()
+            ).hexdigest()[:12]
+
         # shape validation against one representative bind (weight/aux
         # shapes are batch-independent, so any bucket works)
         shapes0 = self._bucket_shapes(
@@ -286,97 +342,30 @@ class AOTPredictor:
                                   tuple(inferred[name])))
             params[name] = arr
 
-        # ---- constant-fold split ------------------------------------------
-        self._nodes = symbol._topo()
-        self._node_ids = {id(n): i for i, n in enumerate(self._nodes)}
-        self._entries = list(symbol._entries)
+        # ---- constant-fold split (ir/fold.py — ONE pass shared with
+        # the C-predict ABI, which binds through this class) ----------------
         # extras are zero-filled per bucket IN the traced program (their
         # shapes may carry the batch dim), so for folding purposes they
         # are dynamic, exactly like real data
-        self._dyn = symbol.data_dependent_nodes(
-            set(self._data_names) | set(self._extra_names))
-        self._const_specs, self._const_index = self._collect_const_specs()
-        self._fold_order = self._collect_fold_order()
-        self._fold_fn = self._make_fold_fn()
+        from ..ir import FoldPlan
+
+        self._plan = FoldPlan(
+            symbol, set(self._data_names) | set(self._extra_names))
+        self._fold_fn = self._plan.make_fold_fn(self._key)
         self._params = params
         self._consts = self._fold_fn(params)
         self.bind_stats = {
-            "folded_nodes": len(self._fold_order),
-            "dynamic_nodes": len([i for i in self._dyn
-                                  if not self._nodes[i].is_variable()]),
+            "folded_nodes": self._plan.folded_nodes,
+            "dynamic_nodes": self._plan.dynamic_nodes,
             "frozen_params": len(params),
             "zero_filled": list(self._extra_names),
             "ladder": self._ladder,
             "dtype": self._dtype_name,
+            "quant": self._quant,
         }
-
-    # -- bind-time graph split ----------------------------------------------
-    def _collect_const_specs(self):
-        """Ordered, deduped list of values that cross from the fold
-        side into the per-request program: ('var', name) for frozen
-        weights consumed directly, ('node', i, idx) for folded node
-        outputs."""
-        specs, index = [], {}
-
-        def add(spec):
-            if spec not in index:
-                index[spec] = len(specs)
-                specs.append(spec)
-
-        def classify(inp, idx):
-            if inp.is_variable():
-                if (inp.name not in self._data_shapes
-                        and inp.name not in self._extra_names):
-                    add(("var", inp.name))
-                return
-            nid = self._node_ids[id(inp)]
-            if nid not in self._dyn:
-                add(("node", nid, idx))
-
-        for i, node in enumerate(self._nodes):
-            if node.is_variable() or i not in self._dyn:
-                continue
-            for inp, idx in node.inputs:
-                classify(inp, idx)
-        for node, idx in self._entries:
-            classify(node, idx)
-        return specs, index
-
-    def _collect_fold_order(self):
-        """Topo-ordered indices of the non-dynamic compute nodes the
-        fold program must evaluate (the backward closure of the node
-        const specs)."""
-        needed = set()
-        stack = [s[1] for s in self._const_specs if s[0] == "node"]
-        while stack:
-            i = stack.pop()
-            if i in needed:
-                continue
-            needed.add(i)
-            for inp, _ in self._nodes[i].inputs:
-                if not inp.is_variable():
-                    stack.append(self._node_ids[id(inp)])
-        return sorted(needed)
-
-    def _make_fold_fn(self):
-        specs = self._const_specs
-        order = self._fold_order
-        nodes, node_ids, key = self._nodes, self._node_ids, self._key
-
-        def fold(params):
-            results = {}
-            for i in order:
-                node = nodes[i]
-                ins = [params[inp.name] if inp.is_variable()
-                       else results[node_ids[id(inp)]][idx]
-                       for inp, idx in node.inputs]
-                results[i] = eval_node(node, ins, key, i, False)
-            return tuple(params[s[1]] if s[0] == "var"
-                         else results[s[1]][s[2]] for s in specs)
-
-        if order:
-            return jax.jit(fold)
-        return fold  # pure reshuffle of frozen weights — nothing to jit
+        if self.quant_report is not None:
+            self.bind_stats["quantized_ops"] = \
+                self.quant_report["quantized_ops"]
 
     def _freeze_one(self, name, value):
         v = value.asnumpy() if hasattr(value, "asnumpy") else np.asarray(value)
@@ -403,8 +392,9 @@ class AOTPredictor:
             list(zip(self._sym.list_arguments(), arg_shapes))
             + list(zip(self._sym.list_auxiliary_states(), aux_shapes))
             if n in set(self._extra_names)}
-        nodes, node_ids, entries = self._nodes, self._node_ids, self._entries
-        dyn, const_index, key = self._dyn, self._const_index, self._key
+        plan = self._plan
+        nodes, node_ids, entries = plan.nodes, plan.node_ids, plan.entries
+        dyn, const_index, key = plan.dyn, plan.const_index, self._key
         cast_back = self._np_dtype != np.float32
 
         def run(data_vals, consts):
@@ -448,7 +438,8 @@ class AOTPredictor:
 
     def _executable(self, bucket):
         cache_key = (self._cache_key, bucket if bucket is not None
-                     else "exact", self._dtype_name)
+                     else "exact", self._dtype_name,
+                     self._quant_fingerprint)
         return self._cache.get_or_build(cache_key,
                                         lambda: self._build(bucket))
 
@@ -471,7 +462,7 @@ class AOTPredictor:
 
     @property
     def num_outputs(self):
-        return len(self._entries)
+        return len(self._plan.entries)
 
     def pick_bucket(self, rows):
         """Smallest ladder bucket >= rows (bucket selection)."""

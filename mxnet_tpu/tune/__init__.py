@@ -38,9 +38,37 @@ from .table import (ScheduleTable, TABLE_VERSION, default_table_path,
 from .search import (FLASH_BLOCKS, FUSED_KINDS, flash_candidates,
                      fused_candidates, sweep_flash, sweep_fused)
 
+SWEEPABLE_KERNELS = FUSED_KINDS + ("flash_attention",)
+
+
+def rule_kernels():
+    """{IR rule name: kernel names it lands on} from the pass
+    framework's rule registry (ISSUE 13): a fusion rule *names* the
+    Pallas kernel family its rewrite consults, and the autotuner folds
+    those names into its sweep set automatically — new fusions become
+    searchable schedule-table keys with zero edits here."""
+    from ..ir.rules import registered_kernels
+
+    return registered_kernels()
+
+
+def sweepable_kernels():
+    """Kernel names the offline sweep covers by default: the built-in
+    families plus every kernel a registered IR rule names (unknown
+    rule-named kernels are surfaced by tools/tune_kernels.py as
+    unsweepable rather than silently dropped)."""
+    names = list(SWEEPABLE_KERNELS)
+    for kernels in rule_kernels().values():
+        for k in kernels:
+            if k not in names:
+                names.append(k)
+    return tuple(names)
+
+
 __all__ = [
     "ScheduleTable", "TABLE_VERSION", "default_table_path", "get_table",
     "make_key", "reset", "schedule_for",
-    "FLASH_BLOCKS", "FUSED_KINDS", "flash_candidates", "fused_candidates",
+    "FLASH_BLOCKS", "FUSED_KINDS", "SWEEPABLE_KERNELS", "flash_candidates",
+    "fused_candidates", "rule_kernels", "sweepable_kernels",
     "sweep_flash", "sweep_fused",
 ]

@@ -428,6 +428,30 @@ S("_sparse_retain", [N((4, 3), seed=138), np.array([0, 2], np.float32)])
 # ---- misc ----
 S("_CrossDeviceCopy", [_D23])
 
+
+# ---- IR-pass ops (ISSUE 13) ----
+def I8(shape, seed=0):
+    """Int8-valued quantized operand for the serving int8 MAC ops."""
+    return np.clip(np.round(N(shape, seed=seed, scale=1.0) * 40),
+                   -127, 127).astype(np.int8)
+
+
+S("_ConvResidualAdd",
+  [_IMG, N((4, 3, 3, 3), seed=150, scale=0.3),
+   N(tuple(_IMG.shape[:1]) + (4,) + tuple(_IMG.shape[2:]), seed=151),
+   N((4,), seed=152)],
+  {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)}, tol=2e-2)
+S("_quantize_int8", [N((4, 8), seed=153)], {"scale": 0.05})
+S("_quantize_rows_int8", [N((4, 8), seed=154)])
+S("_int8_fully_connected",
+  [I8((2, 8), seed=155), I8((4, 8), seed=156),
+   np.full((4,), 0.01, np.float32), N((4,), seed=157, scale=0.1)],
+  {"num_hidden": 4, "scale": 0.05})
+S("_int8_convolution",
+  [I8((2, 3, 4, 4), seed=158), I8((4, 3, 3, 3), seed=159),
+   np.full((4,), 0.01, np.float32), N((4,), seed=160, scale=0.1)],
+  {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1), "scale": 0.05})
+
 # ops whose canonical spec is keyed under another name (pure aliases that
 # appear as canonical because both spellings are registered)
 ALIAS_SPECS = {

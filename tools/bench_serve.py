@@ -20,6 +20,14 @@ dynamic batch-fill ratio in ONE bench.py-style JSON line.
 Acceptance (ISSUE 6): dynamic >= 2x sequential req/s at equal-or-better
 p99, swap completes with dropped == errors == 0.
 
+``--quant int8`` (ISSUE 13) serves the same closed-loop Poisson trace
+at bf16 and at int8 (post-training quantized through the IR pass
+framework: weights quantized at bind time by the shared fold pass,
+activations at the bound boundary) and reports both modes' req/s and
+p99 plus int8-vs-bf16 top-1 agreement on a fixed logits corpus —
+acceptance is int8 req/s > bf16 at equal-or-better p99 with
+agreement >= 99%.
+
 ``--fleet`` (ISSUE 11) measures req/s scaling across replica processes;
 ``--generate`` (ISSUE 12) measures the autoregressive-decode workload:
 the same Poisson arrival trace (sampled prompt/output lengths) replayed
@@ -96,20 +104,35 @@ def _pctl(sorted_vals, q):
 
 
 def run_mode(symbol, args_np, ladder, clients, seconds, think_ms, dim,
-             rows, swap_prefix=None, deadline_ms=None):
-    """Measure one serving configuration; returns a result dict."""
+             rows, swap_prefix=None, deadline_ms=None, dtype=None,
+             quant=None, calib=None, warm_ladder=False):
+    """Measure one serving configuration; returns a result dict.
+    ``dtype``/``quant``/``calib`` ride through to the AOTPredictor
+    bind (the --quant int8-vs-bf16 comparison); ``warm_ladder``
+    compiles EVERY bucket outside the clock so neither quant mode pays
+    compiles inside its measured window."""
+    import numpy as np
+
     from mxnet_tpu import profiler
     from mxnet_tpu.serving import ModelServer
 
     profiler.serving_reset()
     results = []
     deadline_s = None if deadline_ms is None else deadline_ms / 1e3
+    pred_kwargs = {}
+    if dtype is not None:
+        pred_kwargs["dtype"] = dtype
+    if quant is not None:
+        pred_kwargs["quant"] = quant
+        pred_kwargs["calib_data"] = calib
     with ModelServer(ladder=ladder, queue_depth=4 * clients + 8,
                      submit_timeout=60) as server:
         server.add_model("model", symbol=symbol, arg_params=args_np,
-                         data_shapes={"data": (1, dim)})
-        server.predict("model", __import__("numpy").zeros(
-            (rows, dim), "float32"))  # compile warmup outside the clock
+                         data_shapes={"data": (1, dim)}, **pred_kwargs)
+        warm = sorted({b for b in ladder if b >= rows} or {ladder[-1]}) \
+            if warm_ladder else [rows]
+        for wrows in warm:  # compile warmup outside the clock
+            server.predict("model", np.zeros((wrows, dim), "float32"))
         t0 = time.perf_counter()
         stop_at = t0 + seconds
         threads = [threading.Thread(
@@ -520,6 +543,111 @@ def measure_generate(requests=64, rate=400.0, slots=8, page_size=16,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# quant mode (ISSUE 13): int8 post-training-quantized serving vs bf16
+# on the same closed-loop Poisson trace — the nncase serving-throughput
+# lever, measured end to end through the ModelServer.
+# ---------------------------------------------------------------------------
+def _train_model(symbol, dim, classes, seed=0, epochs=6, n=4096,
+                 batch=256):
+    """Briefly train the bench MLP on a clustered synthetic task.
+    Post-TRAINING quantization assumes a trained model: random-weight
+    logits are near-tied by construction, so top-1 agreement there
+    measures tie-breaking noise, not quantization quality. Returns
+    (trained args dict, a sample-factory for calibration/eval data)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype(np.float32) * 1.5
+
+    def sample(count, sample_seed):
+        r = np.random.RandomState(sample_seed)
+        y = r.randint(0, classes, count)
+        return (centers[y] + r.randn(count, dim).astype(np.float32),
+                y.astype(np.float32))
+
+    x, y = sample(n, seed + 1)
+    mod = mx.mod.Module(symbol, context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(x, y, batch, label_name="softmax_label"),
+            num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    args, _aux = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}, sample
+
+
+def measure_quant(clients=24, seconds=5.0, think_ms=2.0, dim=256,
+                  hidden=512, layers=6, classes=64, rows=8,
+                  calib_batches=8, ladder=None, corpus_rows=2048):
+    """The --quant record: the SAME closed-loop Poisson load served at
+    bf16 and at int8 (post-training quantized through the IR pass),
+    plus int8-vs-bf16 top-1 agreement on a fixed logits corpus.
+    Acceptance (ISSUE 13): int8 req/s beats bf16 at equal-or-better
+    p99, agreement >= 99%."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import AOTPredictor, env_batch_ladder
+
+    ladder = env_batch_ladder() if ladder is None else ladder
+    symbol, _raw = build_model(dim, hidden, layers, classes)
+    args_np, sample = _train_model(symbol, dim, classes)
+    calib = [{"data": sample(64, 500 + i)[0]} for i in range(calib_batches)]
+
+    # fixed logits corpus: int8-vs-bf16 top-1 agreement (predictor-level,
+    # outside the load loop) + accuracy of both against the labels
+    corpus, labels = sample(corpus_rows, 900)
+    shapes = {"data": (1, dim)}
+    profiler.pass_reset()
+    pred_bf16 = AOTPredictor(symbol, args_np, data_shapes=shapes,
+                             ladder=(corpus_rows,), dtype="bfloat16")
+    pred_int8 = AOTPredictor(symbol, args_np, data_shapes=shapes,
+                             ladder=(corpus_rows,), quant="int8",
+                             calib_data=calib)
+    top_bf16 = np.argmax(pred_bf16.predict(corpus)[0], 1)
+    top_int8 = np.argmax(pred_int8.predict(corpus)[0], 1)
+    agreement = float((top_int8 == top_bf16).mean())
+    acc_bf16 = float((top_bf16 == labels).mean())
+    acc_int8 = float((top_int8 == labels).mean())
+    pass_stats = profiler.pass_stats(reset=True)
+    calib_report = (pred_int8.quant_report or {}).get("calibration", {})
+
+    common = dict(ladder=ladder, clients=clients, seconds=seconds,
+                  think_ms=think_ms, dim=dim, rows=rows, warm_ladder=True)
+    bf16 = run_mode(symbol, args_np, dtype="bfloat16", **common)
+    int8 = run_mode(symbol, args_np, quant="int8", calib=calib, **common)
+    rec = {
+        "metric": "quant_serving_throughput",
+        "value": int8["req_s"],
+        "unit": "req/s",
+        "speedup_vs_bf16": round(int8["req_s"] / bf16["req_s"], 2)
+        if bf16["req_s"] else None,
+        "int8": int8,
+        "bf16": bf16,
+        "agreement_top1": round(agreement, 4),
+        "acc_bf16": round(acc_bf16, 4),
+        "acc_int8": round(acc_int8, 4),
+        "corpus_rows": corpus_rows,
+        "quantized_ops": pred_int8.bind_stats.get("quantized_ops"),
+        "calib_batches": len(calib),
+        "calibration": {k: {"absmax": v["absmax"], "scale": v["scale"]}
+                        for k, v in sorted(calib_report.items())},
+        "pass_stats": pass_stats.get("passes", {}).get("quantize"),
+        "ladder": list(ladder),
+        "clients": clients,
+        "seconds": seconds,
+        "think_ms": think_ms,
+        "rows": rows,
+        "model": {"dim": dim, "hidden": hidden, "layers": layers,
+                  "classes": classes},
+        "backend": jax.default_backend(),
+    }
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=32)
@@ -558,8 +686,20 @@ def main():
                     help="generate mode: decode batch slots")
     ap.add_argument("--page-size", type=int, default=16,
                     help="generate mode: tokens per KV page")
+    ap.add_argument("--quant", choices=("int8",), default=None,
+                    help="quant mode (ISSUE 13): int8 post-training-"
+                         "quantized serving vs bf16 on the same Poisson "
+                         "trace — req/s, p99, and top-1 agreement on a "
+                         "fixed logits corpus")
+    ap.add_argument("--calib-batches", type=int, default=8,
+                    help="quant mode: calibration batches")
     args = ap.parse_args()
-    if args.generate:
+    if args.quant:
+        rec = measure_quant(clients=args.clients, seconds=args.seconds,
+                            think_ms=args.think_ms,
+                            calib_batches=args.calib_batches,
+                            rows=max(args.rows, 8))
+    elif args.generate:
         rec = measure_generate(requests=args.requests, rate=args.rate,
                                slots=args.slots, page_size=args.page_size)
     elif args.fleet:
