@@ -76,6 +76,8 @@ def _measure(variant):
         return _measure_generate()
     if variant == "quant":
         return _measure_quant()
+    if variant == "embed":
+        return _measure_embed()
     if variant == "tune":
         return _measure_tune()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
@@ -324,6 +326,36 @@ def _measure_quant():
         print(json.dumps({"error": "quant: %s" % str(e)[:500]}))
 
 
+def _measure_embed():
+    """Sharded-embedding variant (ISSUE 14): training-shaped rounds
+    (dedup zipfian pull + gradient scatter push) against 4 in-process
+    row-sharded servers (tools/bench_embed.py). The trajectory tracks
+    rows/s, the dedup-vs-naive pull speedup (acceptance >= 2x), the
+    async-vs-sync ratio (honest with the core count), and the
+    per-server memory ratio (~1/num_servers via memoryStats)."""
+    try:
+        from tools.bench_embed import measure
+
+        rec = measure()
+        print(json.dumps({
+            "variant": "embed",
+            "rows_s": rec["train_rows_s"],
+            "pull_rows_s": rec["pull_rows_s"],
+            "naive_pull_rows_s": rec["naive_pull_rows_s"],
+            "speedup_dedup_vs_naive": rec["speedup_dedup_vs_naive"],
+            "sync_rows_s": rec["sync_train_rows_s"],
+            "async_vs_sync": rec["async_vs_sync"],
+            "rows_s_2bit": rec["train_rows_s_2bit"],
+            "mem_ratio_max": rec["mem_ratio_max"],
+            "servers": rec["servers"],
+            "table_mb": rec["table_mb"],
+            "dedup_ratio": rec["dedup_ratio"],
+            "cores": rec["cores"],
+        }))
+    except Exception as e:
+        print(json.dumps({"error": "embed: %s" % str(e)[:500]}))
+
+
 def _measure_tune():
     """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
     space at the bench shapes (tools/tune_kernels.py) and record the
@@ -405,6 +437,9 @@ def _report(results, kernels=None):
     if "quant" in results:
         rec["quant"] = {k: v for k, v in results["quant"].items()
                         if k != "variant"}
+    if "embed" in results:
+        rec["embed"] = {k: v for k, v in results["embed"].items()
+                        if k != "variant"}
     if "tune" in results:
         rec["tune"] = {k: v for k, v in results["tune"].items()
                        if k != "variant"}
@@ -467,9 +502,9 @@ def main():
     # if it kills this process mid-attempt the round still lands a
     # number.
     for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "quant", "tune",
+                    "generate", "quant", "embed", "tune",
                     "unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "quant", "tune"):
+                    "generate", "quant", "embed", "tune"):
         if variant in results:
             continue
         if time.time() > deadline - 60:
@@ -492,10 +527,11 @@ def main():
                 except ValueError:
                     continue  # stray brace-looking log line
                 if "img_s" in parsed or "req_s" in parsed \
-                        or "tuned" in parsed or "error" in parsed:
+                        or "rows_s" in parsed or "tuned" in parsed \
+                        or "error" in parsed:
                     line = parsed
             if line and ("img_s" in line or "req_s" in line
-                         or "tuned" in line):
+                         or "rows_s" in line or "tuned" in line):
                 results[variant] = line
                 _report(results)
             else:

@@ -42,6 +42,7 @@ from . import module as mod  # noqa: F401
 from . import gluon  # noqa: F401
 from . import operator  # noqa: F401
 from . import config  # noqa: F401
+from . import embedding  # noqa: F401
 from . import ir  # noqa: F401
 from . import contrib  # noqa: F401
 from . import name  # noqa: F401

@@ -399,6 +399,35 @@ KNOBS = {
         "tokens buffer per request and flush to the streaming "
         "callback every N steps (and at finish); integer >= 1 "
         "(serving/broker.py GenerateServer)"),
+    # --- sharded embeddings (ISSUE 14) ---
+    "MXNET_EMBED_SHARDS": (
+        "0", "honored",
+        "row-shard count override for ShardedEmbeddingTable; 0 (the "
+        "default) shards one-per-server, shard s lives on server "
+        "s %% num_servers otherwise; integer >= 0 "
+        "(embedding/table.py)"),
+    "MXNET_EMBED_DEDUP": (
+        "1", "honored",
+        "deduplicate requested row ids before pulling (one row_pull "
+        "frame per shard); 0 falls back to the naive per-id pull "
+        "baseline the bench compares against; 0|1, anything else "
+        "raises (embedding/table.py)"),
+    "MXNET_EMBED_PULL_BATCH": (
+        "65536", "honored",
+        "pull batch budget: max rows per row_pull RPC frame — larger "
+        "requests split into multiple frames per shard; integer >= 1 "
+        "(embedding/table.py)"),
+    "MXNET_EMBED_WIRE": (
+        "raw", "honored",
+        "row-gradient wire treatment for embedding scatter pushes: "
+        "'raw' or '2bit' (the PR 4 packed two-bit quantizer applied "
+        "to the pushed row block, with per-row error-feedback "
+        "residuals held client-side for the rows this worker touched) "
+        "(embedding/table.py)"),
+    "MXNET_EMBED_WIRE_THRESHOLD": (
+        "0.5", "honored",
+        "ternary threshold for MXNET_EMBED_WIRE=2bit; finite float "
+        "> 0 (embedding/table.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

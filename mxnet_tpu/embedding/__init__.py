@@ -1,0 +1,37 @@
+"""Sharded embedding subsystem (ISSUE 14): recommendation-scale tables
+too large for any chip, row-sharded across the dist_async
+KVStoreServers.
+
+- :class:`ShardedEmbeddingTable` — the client data plane: stable-hash
+  row sharding (``sharding.RowSharding``), deduplicated ``row_pull``
+  reads, async row-scatter pushes on the PR 4 sender pipeline,
+  optional 2-bit wire compression, per-server memory ~1/num_servers.
+- :class:`SparseEmbedding` — the Gluon block: pulls exactly the rows a
+  batch touches, autograd accumulates their gradients, ``step()``
+  pushes them back for the server-side lazy sparse optimizer.
+- :class:`EmbeddingLookupServer` / :class:`EmbeddingTowerPredictor` —
+  the serving half: sharded lookup + dense tower through AOTPredictor,
+  registered as a fleet ``replica`` role (PR 11 discovery/routing/
+  drain apply unchanged).
+- :func:`elastic_table_checkpoint` — the PR 3 coordinated-checkpoint
+  choreography over sharded tables; a respawned server restores its
+  suffix-routed sub-keys through the existing elastic path.
+- Typed failures raise :class:`EmbeddingShardError` at the client —
+  out-of-vocabulary ids are never clamped and never surface
+  server-side only.
+"""
+from .sharding import (  # noqa: F401
+    RowSharding,
+    embedding_shard_rank,
+    embedding_sub_key,
+)
+from .table import (  # noqa: F401
+    EmbeddingShardError,
+    ShardedEmbeddingTable,
+)
+from .block import SparseEmbedding  # noqa: F401
+from .lookup import (  # noqa: F401
+    EmbeddingLookupServer,
+    EmbeddingTowerPredictor,
+)
+from .checkpoint import elastic_table_checkpoint  # noqa: F401

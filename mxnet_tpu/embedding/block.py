@@ -1,0 +1,144 @@
+"""SparseEmbedding — the Gluon block over a server-sharded table.
+
+Unlike :class:`gluon.nn.Embedding`, the weight table is NOT a
+Parameter: it lives row-sharded on the dist_async KVStoreServers and
+only the rows a batch actually touches ever reach this process. Each
+``forward``:
+
+1. deduplicates the batch's ids and pulls exactly those rows
+   (``ShardedEmbeddingTable.pull``);
+2. wraps the pulled ``(n_unique, dim)`` block as an autograd-marked
+   variable, so ``backward`` accumulates the batch's row gradients
+   into a block-local buffer (XLA's gather VJP does the in-batch
+   scatter-add for repeated ids);
+3. runs the stock ``Embedding`` gather against the remapped
+   (``inverse``) ids.
+
+After ``loss.backward()``, :meth:`step` pushes the accumulated row
+gradients back as async scatter pushes — the server-side optimizer
+applies its lazy row-sparse update on arrival (dist_async semantics:
+no global synchronization, pulls return the freshest rows).
+
+::
+
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer("sgd", learning_rate=0.05,
+                     rescale_grad=1.0 / batch_size)
+    emb = SparseEmbedding(64, input_dim=1 << 20, kvstore=kv,
+                          key="user_emb")
+    with autograd.record():
+        vec = emb(user_ids)              # pull + gather
+        loss = ...
+    loss.backward()
+    emb.step()                           # async scatter push
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from ..gluon.block import Block
+from ..ndarray import ndarray as nd
+from .table import EmbeddingShardError, ShardedEmbeddingTable
+
+__all__ = ["SparseEmbedding"]
+
+
+class SparseEmbedding(Block):
+    """Gluon block whose embedding table is server-sharded.
+
+    ``kvstore`` may be handed to the constructor or later via
+    :meth:`bind_kvstore` (the table binds lazily on first use, so the
+    block can be built before the dist topology exists). ``key``
+    names the table on the servers; it defaults to the block's gluon
+    name, but every worker must agree on it — pass it explicitly in
+    multi-worker jobs (gluon auto-naming counts per process).
+    """
+
+    def __init__(self, output_dim, input_dim, kvstore=None, key=None,
+                 dtype="float32", table_kwargs=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = int(input_dim)
+        self._output_dim = int(output_dim)
+        self._dtype = dtype
+        self._table_kwargs = dict(table_kwargs or {})
+        self._key = key
+        self._kv = None
+        self._table = None
+        self._pending = []  # [(unique_ids, grad NDArray buffer), ...]
+        if kvstore is not None:
+            self.bind_kvstore(kvstore)
+
+    def bind_kvstore(self, kvstore):
+        """Attach the dist_async kvstore this block's table lives on.
+        Rebinding to a different store mid-training is a topology
+        error and raises."""
+        if self._kv is not None and self._kv is not kvstore:
+            raise MXNetError(
+                "SparseEmbedding %r is already bound to a kvstore"
+                % self.name)
+        self._kv = kvstore
+        if self._table is None:
+            self._table = ShardedEmbeddingTable(
+                self._key or self.name, kvstore, rows=self._input_dim,
+                dim=self._output_dim, dtype=self._dtype,
+                **self._table_kwargs)
+        return self
+
+    @property
+    def table(self):
+        if self._table is None:
+            raise MXNetError(
+                "SparseEmbedding %r has no kvstore bound — pass "
+                "kvstore= or call bind_kvstore() first" % self.name)
+        return self._table
+
+    def initialize_table(self, init_array=None, scale=None, seed=0):
+        """Install the table on the servers (first-writer-wins; safe
+        to call from every worker)."""
+        self.table.init(init_array=init_array, scale=scale, seed=seed)
+        return self
+
+    # -- forward / backward --------------------------------------------------
+    def forward(self, x):
+        table = self.table
+        ids_np = x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+        uniq, inverse, rows = table.pull(ids_np)
+        if uniq.size == 0:
+            raise EmbeddingShardError(
+                "SparseEmbedding %r: empty id batch" % self.name)
+        weight = nd.array(rows)
+        if autograd.is_recording():
+            grad = nd.zeros(weight.shape, dtype=rows.dtype)
+            autograd.mark_variables([weight], [grad])
+            self._pending.append((uniq, grad))
+        inv = nd.array(inverse.reshape(np.asarray(ids_np).shape)
+                       .astype(np.int32))
+        return nd.invoke(
+            "Embedding", [inv, weight],
+            {"input_dim": int(uniq.size),
+             "output_dim": self._output_dim})
+
+    def step(self, priority=0):
+        """Push every recorded forward's accumulated row gradients to
+        the servers (async; the next pull of those rows waits on the
+        frames). Returns the number of pushed row-gradient blocks.
+        Gradient scaling is the server optimizer's ``rescale_grad`` —
+        configure it like any dist_async job."""
+        pending, self._pending = self._pending, []
+        for uniq, grad in pending:
+            self.table.push(uniq, grad.asnumpy(), priority=priority)
+        return len(pending)
+
+    def discard_grads(self):
+        """Drop recorded forwards without pushing (eval passes that
+        ran under record, aborted steps)."""
+        self._pending = []
+
+    def __repr__(self):
+        return ("SparseEmbedding(%d -> %d, key=%r, shards=%s)"
+                % (self._input_dim, self._output_dim,
+                   self._key or self.name,
+                   self._table.num_shards if self._table else "?"))

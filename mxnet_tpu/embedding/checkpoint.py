@@ -1,0 +1,71 @@
+"""Coordinated checkpoints for sharded embedding tables (ISSUE 14).
+
+The PR 3 elastic machinery already restores a respawned server's key
+shard from the newest committed checkpoint — but it had only ever seen
+dense module parameters. This helper runs the SAME three-named-barrier
+choreography as ``callback.elastic_checkpoint`` over sharded tables:
+each sub-table is snapshot under the quiesced window and committed as
+an ordinary ``arg:<key>@embshard<s>`` weight, and the server-side
+optimizer state (which includes the sub-keys automatically — they are
+plain keys in each server's updater) rides the existing
+``save_optimizer_states`` wire plumbing. A respawned server then
+restores exactly its suffix-routed sub-keys through
+``KVStoreServer.restore_from_checkpoint`` — no new restore path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["elastic_table_checkpoint"]
+
+
+def elastic_table_checkpoint(manager, tables, kv, state_fn=None,
+                             extra_weights_fn=None):
+    """``fn(epoch)`` running the coordinated checkpoint choreography
+    for ``tables`` (a list of :class:`ShardedEmbeddingTable` /
+    :class:`SparseEmbedding` — blocks are unwrapped) on the dist_async
+    kvstore ``kv``. Call it at every epoch end from EVERY worker
+    (``manager.due`` gates the period). ``extra_weights_fn() ->
+    {prefixed_name: numpy}`` lets the caller fold dense params into
+    the same commit."""
+    rank = kv.rank
+
+    def _default_state():
+        return {"numpy_rng": np.random.get_state()}
+
+    state_fn = state_fn or _default_state
+    resolved = [getattr(t, "table", t) for t in tables]
+
+    def _sync(epoch, phase):
+        kv.barrier("embed-ckpt-%d-%s" % (epoch, phase))
+
+    def _checkpoint(epoch):
+        if not manager.due(epoch):
+            return None
+        if rank == 0:
+            manager.begin(epoch)
+        _sync(epoch, "stage")                 # A: staging dir exists
+        state = dict(state_fn())
+        state.setdefault("epoch", epoch)
+        manager.write_worker_state(epoch, rank, state)
+        _sync(epoch, "progress")              # B: all progress staged
+        if rank == 0:
+            # quiesced window: every other worker is parked in barrier
+            # C, and snapshot()/save_optimizer_states drain this
+            # client's own pipeline — no push lands between the
+            # sub-table reads and the commit
+            weights = {}
+            for t in resolved:
+                for sub_key, arr in t.snapshot().items():
+                    weights["arg:%s" % sub_key] = arr
+            if extra_weights_fn is not None:
+                weights.update(extra_weights_fn())
+            kv.save_optimizer_states(
+                manager.staged_optimizer_states_path(epoch))
+            manager.commit(epoch, weights=weights,
+                           optimizer_config=kv.get_optimizer_config(),
+                           num_workers=kv.num_workers)
+        _sync(epoch, "commit")                # C: commit visible
+        return epoch
+
+    return _checkpoint

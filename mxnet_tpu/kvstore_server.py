@@ -36,6 +36,7 @@ from __future__ import annotations
 import heapq
 import os
 import pickle
+import re
 import socket
 import sys
 import threading
@@ -75,6 +76,34 @@ def shard_key(key, num_shards):
     if num_shards <= 1:
         return 0
     return zlib.crc32(str(key).encode()) % num_shards
+
+
+# ---------------------------------------------------------------------------
+# Embedding row-sharding (ISSUE 14): a sharded embedding table lives as
+# one dense SUB-TABLE per row shard, named ``<key>@embshard<s>`` and
+# stored on server ``s % num_servers`` — the routing is purely
+# client-side like the ZeRO value-sharded slices below, but keyed by a
+# suffix rule instead of the crc32 key hash so a respawned server can
+# tell exactly which sub-keys are its own. ONE definition of that rule,
+# shared by the client's routing (embedding/table.py) and
+# ``restore_from_checkpoint`` — or a restored server would load the
+# wrong sub-tables.
+# ---------------------------------------------------------------------------
+_EMBED_SHARD_RE = re.compile(r"@embshard(\d+)$")
+
+
+def embedding_sub_key(key, shard):
+    """The dense sub-table key holding row shard ``shard`` of the
+    sharded embedding table ``key``."""
+    return "%s@embshard%d" % (key, int(shard))
+
+
+def embedding_shard_rank(key):
+    """The row-shard index encoded in an embedding sub-key, or None
+    for ordinary keys. Sub-key ``s`` lives on server ``s % num_servers``
+    (the one routing rule, shared with embedding/table.py)."""
+    m = _EMBED_SHARD_RE.search(str(key))
+    return int(m.group(1)) if m else None
 
 
 # ---------------------------------------------------------------------------
@@ -174,8 +203,40 @@ def _grad_to_wire(arr, compressed=None):
             payload)
 
 
+#: row-scatter push wire tag (ISSUE 14): a sparse gradient for a
+#: handful of rows of a stored dense (sub-)table — the ids ride as a
+#: plain int64 wire array, the values block as a dense (or 2-bit
+#: compressed) gradient entry. Riding the SAME push op means the whole
+#: PR 4 data plane — per-shard sender threads, priority ordering,
+#: coalesced push_multi frames, (cid, seq) dedupe under retry — applies
+#: to embedding scatters with zero new protocol machinery.
+_ROW_TAG = "rows"
+
+
+class _RowScatter:
+    """Decoded row-scatter push: ``values[i]`` is the gradient of row
+    ``ids[i]`` of the stored table."""
+
+    __slots__ = ("ids", "values")
+
+    def __init__(self, ids, values):
+        self.ids = ids
+        self.values = values
+
+
+def _rows_to_wire(ids, values, compressed=None):
+    """(local row ids, per-row gradient block) -> wire entry."""
+    return (_ROW_TAG,
+            _arr_to_wire(np.ascontiguousarray(ids, dtype=np.int64)),
+            _grad_to_wire(values, compressed))
+
+
 def _grad_from_wire(w):
-    """Wire entry -> dense gradient; dequantizes 2-bit payloads."""
+    """Wire entry -> dense gradient (dequantizing 2-bit payloads) or a
+    :class:`_RowScatter` for row-granular embedding pushes."""
+    if w and w[0] == _ROW_TAG:
+        _tag, ids_w, vals_w = w
+        return _RowScatter(_arr_from_wire(ids_w), _grad_from_wire(vals_w))
     if w and w[0] == _2BIT_TAG:
         _tag, dtype, shape, threshold, raw = w
         return two_bit_dequantize(raw, shape, dtype, threshold)
@@ -184,8 +245,10 @@ def _grad_from_wire(w):
 
 def _chaos_op(op):
     """Coalesced/multi-key frames answer to their base op's fault rules
-    (rpc:drop@op=push must keep covering the pipelined client)."""
-    return {"push_multi": "push", "pull_multi": "pull"}.get(op, op)
+    (rpc:drop@op=push must keep covering the pipelined client); the
+    embedding row read answers to pull rules the same way."""
+    return {"push_multi": "push", "pull_multi": "pull",
+            "row_pull": "pull"}.get(op, op)
 
 
 def _state_to_wire(v):
@@ -280,7 +343,9 @@ class KVStoreServer:
         with self._lock:
             if key not in self._store:
                 raise KeyError("push before init: %r" % (key,))
-            if self._updater is None:
+            if isinstance(grad, _RowScatter):
+                self._apply_row_scatter_locked(key, grad)
+            elif self._updater is None:
                 self._store[key] += grad
             else:
                 from .ndarray import array
@@ -293,6 +358,41 @@ class KVStoreServer:
         # (server:R:crash@step=N); outside the lock so the injected
         # hard-exit never dies holding it
         chaos.tick_step()
+
+    def _apply_row_scatter_locked(self, key, scatter):
+        """Apply a row-granular gradient (ISSUE 14): the server-side
+        optimizer runs its LAZY row-sparse update — only the pushed
+        rows (and their rows of the dense optimizer state, which is
+        sub-table-shaped and therefore 1/num_shards per server) move.
+        Out-of-range ids are a protocol violation and error the whole
+        push: the client validates against the table's vocabulary
+        BEFORE routing (embedding/table.py raises the typed
+        EmbeddingShardError), so reaching this guard means the
+        client's sharding math and the stored sub-table disagree."""
+        store = self._store[key]
+        ids = np.asarray(scatter.ids, np.int64)
+        vals = np.asarray(scatter.values)
+        if vals.shape[:1] != ids.shape or \
+                vals.shape[1:] != store.shape[1:]:
+            raise ValueError(
+                "row push shape mismatch for %r: %d ids, values %s vs "
+                "stored rows of %s"
+                % (key, ids.shape[0], vals.shape, store.shape[1:]))
+        if ids.size and (ids.min() < 0 or ids.max() >= store.shape[0]):
+            raise ValueError(
+                "row push out of range for %r: ids [%d, %d] vs %d "
+                "stored rows" % (key, int(ids.min()), int(ids.max()),
+                                 store.shape[0]))
+        if self._updater is None:
+            np.add.at(store, ids, vals.astype(store.dtype, copy=False))
+            return
+        from .ndarray import array
+        from .ndarray.sparse import RowSparseNDArray
+
+        w = array(store)
+        grad = RowSparseNDArray(array(vals), array(ids), w.shape)
+        self._updater(key, grad, w)
+        self._store[key] = w.asnumpy()
 
     #: per-client applied-seqno window: retries are immediate, so a
     #: never-applied seqno can only trail the newest applied one by the
@@ -358,6 +458,62 @@ class KVStoreServer:
                 raise KeyError("pull before init: %r" % (key,))
             snap = np.ascontiguousarray(self._store[key]).copy()
         return _arr_to_wire(snap, zero_copy=True)
+
+    def _row_pull_wire(self, key, meta):
+        """Selected rows of a stored dense (sub-)table as one wire
+        entry (ISSUE 14): the embedding read path — the wire carries
+        exactly the requested rows, never the whole table (the old
+        dense-backed ``row_sparse_pull`` pulled the FULL value and
+        took rows client-side). The gather-copy happens under the
+        lock; the copy is what makes the zero-copy send safe outside
+        it."""
+        if not isinstance(meta, dict) or "ids" not in meta:
+            raise ValueError("row_pull requires meta={'ids': wire}")
+        ids = np.asarray(_arr_from_wire(meta["ids"]), np.int64)
+        with self._lock:
+            if key not in self._store:
+                raise KeyError("row_pull before init: %r" % (key,))
+            store = self._store[key]
+            if ids.size and (ids.min() < 0
+                             or ids.max() >= store.shape[0]):
+                raise ValueError(
+                    "row_pull out of range for %r: ids [%d, %d] vs %d "
+                    "stored rows" % (key, int(ids.min()),
+                                     int(ids.max()), store.shape[0]))
+            snap = np.ascontiguousarray(store[ids])
+        return _arr_to_wire(snap, zero_copy=True)
+
+    def memory_bytes(self):
+        """Measured bytes this server actually holds — the per-server
+        1/num_servers scaling evidence (memoryStats acceptance,
+        ISSUE 14): stored table bytes and optimizer-state bytes, split
+        into embedding sub-tables (``@embshard`` keys) vs everything
+        else."""
+        def _state_bytes(v):
+            if hasattr(v, "asnumpy"):
+                return v.asnumpy().nbytes
+            if isinstance(v, np.ndarray):
+                return v.nbytes
+            if isinstance(v, (list, tuple)):
+                return sum(_state_bytes(i) for i in v)
+            return 0
+
+        with self._lock:
+            out = {"keys": len(self._store), "store_bytes": 0,
+                   "opt_bytes": 0, "embed_store_bytes": 0,
+                   "embed_opt_bytes": 0}
+            for k, v in self._store.items():
+                out["store_bytes"] += int(v.nbytes)
+                if embedding_shard_rank(k) is not None:
+                    out["embed_store_bytes"] += int(v.nbytes)
+            states = self._updater.states if self._updater is not None \
+                else {}
+            for k, v in states.items():
+                b = int(_state_bytes(v))
+                out["opt_bytes"] += b
+                if embedding_shard_rank(k) is not None:
+                    out["embed_opt_bytes"] += b
+        return out
 
     def _set_optimizer(self, name, meta):
         from . import optimizer
@@ -553,6 +709,10 @@ class KVStoreServer:
             if not isinstance(key, (list, tuple)):
                 raise ValueError("pull_multi expects a key list")
             return [self._pull_wire(k) for k in key]
+        if op == "row_pull":
+            return self._row_pull_wire(key, meta)
+        if op == "mem":
+            return self.memory_bytes()
         if op == "set_optimizer":
             self._set_optimizer(key, meta)
             return None
@@ -663,6 +823,18 @@ class KVStoreServer:
                     continue  # aux state never lives on the server
                 key = name[len("arg:"):]
                 arr = np.asarray(arr)
+                esr = embedding_shard_rank(key)
+                if esr is not None:
+                    # embedding sub-table: the suffix IS the routing
+                    # rule (sub-key s lives on server s % num_servers)
+                    # — the crc32 key hash below would scatter the
+                    # sub-keys arbitrarily and a respawned server
+                    # would restore someone else's rows
+                    if esr % num_shards != shard_rank:
+                        continue
+                    self._store[key] = np.ascontiguousarray(arr).copy()
+                    restored += 1
+                    continue
                 if zero and zero_value_sharded(arr, num_shards, zero_min):
                     sizes = zero_slice_sizes(arr.size, num_shards)
                     zsizes[key] = sizes
@@ -691,8 +863,12 @@ class KVStoreServer:
             states_map = unwrap_states_map(pickle.loads(states_blob))
             mine = {}
             for k, v in states_map.items():
+                esr = embedding_shard_rank(k)
                 sizes = zsizes.get(k)
-                if sizes is not None:
+                if esr is not None:
+                    if esr % num_shards == shard_rank:
+                        mine[k] = v
+                elif sizes is not None:
                     mine[k] = zero_slice_pytree(v, sizes, shard_rank)
                 elif shard_key(k, num_shards) == shard_rank:
                     mine[k] = v
@@ -954,6 +1130,9 @@ class ServerKVStore(kvstore.KVStore):
     _RETRY_SAFE = frozenset((
         "init", "push", "push_multi", "pull", "pull_multi", "num_workers",
         "save_opt", "load_opt", "set_optimizer", "opt_config",
+        # row_pull/mem are pure reads (ISSUE 14); row pushes ride the
+        # ordinary push op and inherit its (cid, seq) dedupe
+        "row_pull", "mem",
         # rollback is generation-deduped server-side (meta["gen"]), so a
         # lost-reply retry restores again (idempotent) without
         # re-applying the lr backoff
@@ -1055,6 +1234,11 @@ class ServerKVStore(kvstore.KVStore):
         if self._tracker is None:
             return 0
         return self._tracker.num_dead_node()
+
+    @property
+    def num_servers(self):
+        """Server (shard) count this client routes across."""
+        return len(self._socks)
 
     def _shard(self, key):
         return shard_key(key, len(self._socks))
@@ -1707,6 +1891,69 @@ class ServerKVStore(kvstore.KVStore):
                     dense = np.zeros(w.shape, w.dtype)
                     dense[ids] = w[ids]
                     t[:] = dense
+
+    # -- embedding row data plane (ISSUE 14) --------------------------------
+    def row_pull(self, server_idx, key, ids):
+        """Pull exactly the rows ``ids`` of the dense (sub-)table
+        ``key`` stored on server ``server_idx``. Waits for this key's
+        in-flight async pushes first (read-your-writes), then one
+        row_pull RPC whose wire carries only the requested rows —
+        never the whole table. Returns the ``(len(ids), ...)`` numpy
+        block in request order. Range validation happens at the CALLER
+        (embedding/table.py raises the typed EmbeddingShardError
+        against the table's vocabulary before any routing); the server
+        re-checks against its stored sub-table as defense in depth."""
+        self._check_async_error()
+        self._wait_key(key)
+        ids = np.ascontiguousarray(np.asarray(ids), dtype=np.int64)
+        wire = self._rpc_idx(int(server_idx), "row_pull", key,
+                             {"ids": _arr_to_wire(ids)})
+        return _arr_from_wire(wire)
+
+    def row_push(self, server_idx, key, ids, values, priority=0,
+                 compressed=None):
+        """Push a row-granular gradient scatter for ``key`` on server
+        ``server_idx`` — (local row ids, per-row value block) — on the
+        SAME async per-shard sender pipeline as every dense push:
+        priority-ordered, coalesced into push_multi frames, (cid, seq)
+        deduped under retry, failures sticky until the next wait
+        point. ``compressed`` is an optional ``(packed, threshold)``
+        pair from two_bit_quantize applied to the value block."""
+        self._check_async_error()
+        ids = np.ascontiguousarray(np.asarray(ids), dtype=np.int64)
+        values = np.asarray(values)
+        if self._pipeline and compressed is None \
+                and values.flags.writeable:
+            # snapshot: the wire holds a zero-copy view and the caller
+            # may reuse its gradient buffer before the sender ships it
+            # (the _push_shard rule)
+            values = np.array(values, copy=True)
+        wire = _rows_to_wire(ids, values, compressed)
+        nbytes = int(ids.nbytes) + int(
+            compressed[0].nbytes if compressed else values.nbytes)
+        profiler.comm_record("push", raw_bytes=int(ids.nbytes
+                                                   + values.nbytes))
+        if not self._pipeline:
+            self._rpc_idx(int(server_idx), "push", key,
+                          {"cid": self._client_id}, wire)
+            return
+        entry = {"key": key, "meta": {"cid": self._client_id},
+                 "wire": wire, "nbytes": nbytes,
+                 "future": _PushFuture()}
+        with self._pending_lock:
+            self._key_pending.setdefault(key, []).append(entry["future"])
+        try:
+            self._sender(int(server_idx)).enqueue(entry, priority)
+        except BaseException as e:
+            entry["future"]._finish(e)
+            raise
+
+    def server_memory(self):
+        """Per-server measured memory ({keys, store_bytes, opt_bytes,
+        embed_store_bytes, embed_opt_bytes} per server, in rank order)
+        — the 1/num_servers acceptance evidence reads this surface."""
+        self.wait_outstanding()
+        return self._rpc_all("mem")
 
     def barrier(self, name=""):
         """Barrier across workers, held at every server in rank order

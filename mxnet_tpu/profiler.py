@@ -113,6 +113,9 @@ def dump_profile():
     passes = pass_stats()
     if passes:
         payload["passStats"] = passes
+    embed = embedding_stats()
+    if embed:
+        payload["embeddingStats"] = embed
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -665,6 +668,108 @@ def pass_reset():
     with _PASS_LOCK:
         _PASS.clear()
         _PASS_CALIB.clear()
+
+
+# ---------------------------------------------------------------------------
+# sharded-embedding observability (ISSUE 14): always-on counters for the
+# server-sharded embedding data plane — pull/push round counts, rows
+# actually moved, requested vs deduplicated id counts (their ratio IS
+# the dedup win the subsystem exists for), per-shard wire bytes, typed
+# out-of-vocab rejections, and bounded pull/push latency reservoirs for
+# p50/p99. Always-on like comm_record; rides dump_profile as
+# embeddingStats. Unknown counter names raise (the fleet_record rule).
+# ---------------------------------------------------------------------------
+_EMBED_LOCK = threading.Lock()
+_EMBED_ZERO = {
+    "pulls": 0, "pushes": 0, "ids_requested": 0, "unique_ids": 0,
+    "rows_pulled": 0, "rows_pushed": 0, "oov_errors": 0,
+    "pull_seconds": 0.0, "push_seconds": 0.0,
+}
+_EMBED_FLOATS = ("pull_seconds", "push_seconds")
+_EMBED = dict(_EMBED_ZERO)
+_EMBED_SHARD_BYTES = {}     # shard index -> accumulated wire bytes
+_EMBED_LAT_CAP = 8192
+_EMBED_PULL_LAT = None      # deque, created lazily
+_EMBED_PUSH_LAT = None
+
+
+def embedding_record(shard_bytes=None, pull_latencies=None,
+                     push_latencies=None, **adds):
+    """Accumulate sharded-embedding counters (thread-safe).
+    ``shard_bytes`` is a ``{shard_index: bytes}`` increment map;
+    latency lists feed the bounded reservoirs. Unknown counter names
+    raise — a typo'd counter would silently vanish from the acceptance
+    evidence."""
+    global _EMBED_PULL_LAT, _EMBED_PUSH_LAT
+    with _EMBED_LOCK:
+        for k, v in adds.items():
+            if k in _EMBED_FLOATS:
+                _EMBED[k] += float(v)
+            elif k in _EMBED_ZERO:
+                _EMBED[k] += int(v)
+            else:
+                raise ValueError(
+                    "embedding_record: unknown counter %r" % k)
+        if shard_bytes:
+            for s, b in shard_bytes.items():
+                s = int(s)
+                _EMBED_SHARD_BYTES[s] = \
+                    _EMBED_SHARD_BYTES.get(s, 0) + int(b)
+        if pull_latencies:
+            if _EMBED_PULL_LAT is None:
+                from collections import deque
+
+                _EMBED_PULL_LAT = deque(maxlen=_EMBED_LAT_CAP)
+            _EMBED_PULL_LAT.extend(pull_latencies)
+        if push_latencies:
+            if _EMBED_PUSH_LAT is None:
+                from collections import deque
+
+                _EMBED_PUSH_LAT = deque(maxlen=_EMBED_LAT_CAP)
+            _EMBED_PUSH_LAT.extend(push_latencies)
+
+
+def embedding_stats(reset=False):
+    """Snapshot with derived dedup ratio (unique / requested ids) and
+    pull/push p50/p99 (ms); empty dict when the embedding tier never
+    ran."""
+    global _EMBED_PULL_LAT, _EMBED_PUSH_LAT
+    with _EMBED_LOCK:
+        snap = dict(_EMBED)
+        shards = {str(s): b for s, b in
+                  sorted(_EMBED_SHARD_BYTES.items())}
+        pull_lat = sorted(_EMBED_PULL_LAT) if _EMBED_PULL_LAT else []
+        push_lat = sorted(_EMBED_PUSH_LAT) if _EMBED_PUSH_LAT else []
+        if reset:
+            _EMBED.update(_EMBED_ZERO)
+            _EMBED_SHARD_BYTES.clear()
+            _EMBED_PULL_LAT = None
+            _EMBED_PUSH_LAT = None
+    if not (any(snap.values()) or shards):
+        return {}
+    if snap["ids_requested"]:
+        snap["dedup_ratio"] = round(
+            snap["unique_ids"] / snap["ids_requested"], 4)
+    for key in _EMBED_FLOATS:
+        snap[key] = round(snap[key], 4)
+    if shards:
+        snap["shard_bytes"] = shards
+    if pull_lat:
+        snap["pull_p50_ms"] = _percentile_ms(pull_lat, 0.50)
+        snap["pull_p99_ms"] = _percentile_ms(pull_lat, 0.99)
+    if push_lat:
+        snap["push_p50_ms"] = _percentile_ms(push_lat, 0.50)
+        snap["push_p99_ms"] = _percentile_ms(push_lat, 0.99)
+    return snap
+
+
+def embedding_reset():
+    global _EMBED_PULL_LAT, _EMBED_PUSH_LAT
+    with _EMBED_LOCK:
+        _EMBED.update(_EMBED_ZERO)
+        _EMBED_SHARD_BYTES.clear()
+        _EMBED_PULL_LAT = None
+        _EMBED_PUSH_LAT = None
 
 
 def pause():

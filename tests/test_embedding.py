@@ -1,0 +1,481 @@
+"""Sharded embedding subsystem (ISSUE 14): row sharding math, the
+dedup-pull / scatter-push data plane, the SparseEmbedding gluon block,
+the lookup serving path, checkpoint shard restore, and the knob /
+observability satellites. Default tier is subprocess-free (in-process
+KVStoreServer threads); the launch.py e2e + chaos cases are slow-tier.
+"""
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.embedding import (EmbeddingLookupServer,
+                                 EmbeddingShardError, RowSharding,
+                                 ShardedEmbeddingTable, SparseEmbedding,
+                                 embedding_shard_rank, embedding_sub_key)
+from mxnet_tpu.kvstore_server import KVStoreServer, ServerKVStore
+from mxnet_tpu.ndarray import ndarray as nd
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def cluster():
+    """(client, servers): 2 in-process value servers + one client."""
+    servers = [KVStoreServer(num_workers=1) for _ in range(2)]
+    for s in servers:
+        s.serve_in_background()
+    kv = ServerKVStore(",".join(s.addr for s in servers))
+    profiler.embedding_reset()
+    yield kv, servers
+    kv.close()
+    for s in servers:
+        s.shutdown()
+    profiler.embedding_reset()
+
+
+def _table(kv, rows=60, dim=8, full=None, **kw):
+    t = ShardedEmbeddingTable("emb", kv, rows, dim, **kw)
+    t.init(init_array=full)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# sharding math
+# ---------------------------------------------------------------------------
+def test_row_sharding_bijection_and_balance():
+    for rows, shards in ((1, 1), (2, 2), (101, 4), (4096, 3)):
+        rs = RowSharding(rows, shards)
+        ids = np.arange(rows, dtype=np.int64)
+        s, loc = rs.shard_and_local(ids)
+        # every (shard, local) pair unique -> the mapping is a bijection
+        assert len(set(zip(s.tolist(), loc.tolist()))) == rows
+        assert sorted(rs.sizes) == sorted(
+            np.bincount(s, minlength=shards).tolist())
+        assert max(rs.sizes) - min(rs.sizes) <= 1
+        for sh in range(shards):
+            g = rs.global_ids(sh)
+            s2, l2 = rs.shard_and_local(g)
+            assert (s2 == sh).all()
+            assert (l2 == np.arange(rs.sizes[sh])).all()
+
+
+def test_sharding_stripes_the_hot_head():
+    """Consecutive (frequency-sorted) hot ids must spread across
+    shards — the reason the permutation exists at all."""
+    rs = RowSharding(100000, 4)
+    head = np.arange(64)
+    s, _ = rs.shard_and_local(head)
+    counts = np.bincount(s, minlength=4)
+    assert counts.min() >= 8, counts  # no shard starved of head rows
+
+
+def test_sub_key_naming_and_rank_parse():
+    assert embedding_sub_key("user_emb", 3) == "user_emb@embshard3"
+    assert embedding_shard_rank("user_emb@embshard3") == 3
+    assert embedding_shard_rank("user_emb") is None
+    assert embedding_shard_rank("fc1_weight") is None
+
+
+def test_sharding_validation():
+    with pytest.raises(MXNetError):
+        RowSharding(0, 1)
+    with pytest.raises(MXNetError):
+        RowSharding(4, 5)  # more shards than rows
+    with pytest.raises(MXNetError):
+        RowSharding(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# knob validation (strict accessors: malformed raises naming the knob)
+# ---------------------------------------------------------------------------
+def test_knob_validation(cluster, monkeypatch):
+    kv, _ = cluster
+    for knob, bad in (("MXNET_EMBED_DEDUP", "maybe"),
+                      ("MXNET_EMBED_PULL_BATCH", "zero"),
+                      ("MXNET_EMBED_WIRE", "3bit"),
+                      ("MXNET_EMBED_WIRE_THRESHOLD", "-1"),
+                      ("MXNET_EMBED_SHARDS", "-2")):
+        monkeypatch.setenv(knob, bad)
+        with pytest.raises(MXNetError, match=knob):
+            ShardedEmbeddingTable("k", kv, 10, 4)
+        monkeypatch.delenv(knob)
+
+
+def test_shards_knob_override(cluster, monkeypatch):
+    kv, _ = cluster
+    monkeypatch.setenv("MXNET_EMBED_SHARDS", "3")
+    t = ShardedEmbeddingTable("k3", kv, 30, 4)
+    assert t.num_shards == 3
+    # shard 2 wraps onto server 0 (s % num_servers)
+    assert t.server_of(2) == 0
+
+
+# ---------------------------------------------------------------------------
+# table data plane
+# ---------------------------------------------------------------------------
+def test_init_pull_parity(cluster):
+    kv, _ = cluster
+    full = np.random.RandomState(0).randn(60, 8).astype(np.float32)
+    t = _table(kv, full=full)
+    ids = np.array([3, 7, 3, 59, 0, 7, 31])
+    uniq, inverse, vecs = t.pull(ids)
+    assert uniq.size == 5  # deduped
+    assert np.allclose(vecs[inverse], full[ids])
+    assert np.allclose(t.as_dense(), full)
+
+
+def test_dedup_accounting_and_stats_ride(cluster, tmp_path):
+    kv, _ = cluster
+    t = _table(kv)
+    t.pull(np.array([1, 1, 1, 2]))
+    stats = profiler.embedding_stats()
+    assert stats["ids_requested"] == 4
+    assert stats["unique_ids"] == 2
+    assert stats["dedup_ratio"] == 0.5
+    assert stats["rows_pulled"] == 2
+    assert stats["shard_bytes"]  # per-shard wire bytes recorded
+    assert "pull_p99_ms" in stats
+    # rides dump_profile as embeddingStats
+    out = tmp_path / "profile.json"
+    profiler.profiler_set_config(filename=str(out))
+    try:
+        profiler.dump_profile()
+    finally:
+        profiler.profiler_set_config(filename="profile.json")
+    import json
+
+    payload = json.loads(out.read_text())
+    assert payload["embeddingStats"]["unique_ids"] == 2
+    # unknown counter names raise (the fleet_record rule)
+    with pytest.raises(ValueError):
+        profiler.embedding_record(bogus=1)
+
+
+def test_push_update_parity_and_duplicate_combine(cluster):
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=1.0, rescale_grad=1.0)
+    full = np.random.RandomState(1).randn(60, 8).astype(np.float32)
+    t = _table(kv, full=full)
+    ids = np.array([3, 7, 3, 0])  # row 3 twice: grads must sum
+    g = np.ones((4, 8), np.float32)
+    t.push(ids, g)
+    kv.wait_outstanding()
+    expect = full.copy()
+    np.add.at(expect, ids, -1.0)  # sgd lr=1: w -= sum(grads)
+    assert np.allclose(t.as_dense(), expect, atol=1e-6)
+
+
+def test_momentum_state_lives_server_side_at_one_over_n(cluster):
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9,
+                     rescale_grad=1.0)
+    t = _table(kv)
+    t.push(np.arange(10), np.ones((10, 8), np.float32))
+    kv.wait_outstanding()
+    mem = kv.server_memory()
+    per = [m["embed_store_bytes"] + m["embed_opt_bytes"] for m in mem]
+    total = sum(per)
+    assert all(m["embed_opt_bytes"] > 0 for m in mem)
+    for b in per:  # ~1/num_servers each (uneven split is +-1 row)
+        assert abs(b / total - 0.5) < 0.02
+
+
+def test_oov_raises_typed_at_client_before_any_rpc(cluster):
+    kv, _ = cluster
+    t = _table(kv, rows=20)
+    profiler.comm_reset()
+    with pytest.raises(EmbeddingShardError, match="out of vocabulary"):
+        t.pull(np.array([0, 20]))
+    with pytest.raises(EmbeddingShardError, match="out of vocabulary"):
+        t.push(np.array([-1]), np.zeros((1, 8), np.float32))
+    with pytest.raises(EmbeddingShardError, match="non-integral"):
+        t.pull(np.array([0.5]))
+    # validation happened CLIENT-side: no row_pull/push RPC went out
+    comm = profiler.comm_stats()
+    assert comm.get("pull", {}).get("count", 0) == 0
+    assert comm.get("push", {}).get("count", 0) == 0
+    assert profiler.embedding_stats()["oov_errors"] >= 2
+
+
+def test_pull_batch_budget_splits_frames(cluster):
+    kv, _ = cluster
+    t = _table(kv, rows=40, pull_batch=4)
+    profiler.comm_reset()
+    t.pull(np.arange(40))
+    # 40 unique rows over 2 shards at <= 4 rows/frame: >= 10 frames
+    comm = profiler.comm_stats()
+    assert comm["pull"]["count"] >= 10
+
+
+def test_naive_mode_is_per_id(cluster):
+    kv, _ = cluster
+    t = _table(kv, rows=40, dedup=False)
+    profiler.comm_reset()
+    ids = np.array([1, 1, 5, 9])
+    uniq, inverse, vecs = t.pull(ids)
+    assert uniq.size == 4  # no dedup
+    assert (inverse == np.arange(4)).all()
+    assert profiler.comm_stats()["pull"]["count"] == 4  # one RPC per id
+
+
+def test_2bit_wire_error_feedback(cluster):
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=1.0, rescale_grad=1.0)
+    full = np.zeros((20, 8), np.float32)
+    t = _table(kv, rows=20, full=full, wire="2bit", threshold=0.5)
+    # sub-threshold gradient: first push quantizes to zero codes, the
+    # residual carries the error, repeated pushes cross the threshold
+    g = np.full((1, 8), 0.2, np.float32)
+    t.push(np.array([3]), g)
+    kv.wait_outstanding()
+    assert np.allclose(t.as_dense()[3], 0.0)  # quantized away...
+    for _ in range(3):
+        t.push(np.array([3]), g)
+    kv.wait_outstanding()
+    dense = t.as_dense()
+    assert not np.allclose(dense[3], 0.0)  # ...but error feedback lands
+    # every update step is a multiple of the threshold
+    steps = np.unique(np.abs(dense[3]))
+    assert all(abs(s / 0.5 - round(s / 0.5)) < 1e-6 for s in steps)
+
+
+# ---------------------------------------------------------------------------
+# SparseEmbedding block
+# ---------------------------------------------------------------------------
+def test_sparse_embedding_grad_parity(cluster):
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=1.0, rescale_grad=1.0)
+    full = np.random.RandomState(2).randn(30, 4).astype(np.float32)
+    emb = SparseEmbedding(4, 30, kvstore=kv, key="emb")
+    emb.initialize_table(init_array=full)
+    c = np.random.RandomState(3).randn(5, 4).astype(np.float32)
+    ids = np.array([2, 9, 2, 17, 5], np.int64)
+    with autograd.record():
+        out = emb(nd.array(ids))
+        loss = (out * nd.array(c)).sum()
+    loss.backward()
+    assert emb.step() == 1
+    kv.wait_outstanding()
+    # d loss / d row r = sum of c over positions where ids == r
+    expect = full.copy()
+    np.add.at(expect, ids, -c)
+    assert np.allclose(emb.table.as_dense(), expect, atol=1e-5)
+
+
+def test_sparse_embedding_training_decreases_loss(cluster):
+    """Tiny matrix factorization against a hidden low-rank model:
+    full-batch GD with the server-side momentum optimizer (the mean
+    loss divides per-row gradients by the batch — the lr compensates)
+    must recover most of the signal."""
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=10.0, momentum=0.9,
+                     rescale_grad=1.0)
+    rng = np.random.RandomState(4)
+    users, items = 40, 25
+    gu = np.random.RandomState(10).randn(users, 6) * 0.5
+    gv = np.random.RandomState(11).randn(items, 6) * 0.5
+    eu = SparseEmbedding(6, users, kvstore=kv, key="u")
+    ev = SparseEmbedding(6, items, kvstore=kv, key="v")
+    eu.initialize_table(scale=0.2, seed=1)
+    ev.initialize_table(scale=0.2, seed=2)
+    u_ids = rng.randint(0, users, 200)
+    i_ids = rng.randint(0, items, 200)
+    ratings = (gu[u_ids] * gv[i_ids]).sum(axis=1).astype(np.float32)
+
+    def mse():
+        pred = (eu(nd.array(u_ids)) * ev(nd.array(i_ids))).sum(axis=1)
+        return float(((pred.asnumpy() - ratings) ** 2).mean())
+
+    before = mse()
+    for _ in range(40):
+        with autograd.record():
+            pred = (eu(nd.array(u_ids))
+                    * ev(nd.array(i_ids))).sum(axis=1)
+            diff = pred - nd.array(ratings)
+            loss = (diff * diff).mean()
+        loss.backward()
+        eu.step()
+        ev.step()
+    kv.wait_outstanding()
+    assert mse() < before * 0.2, (before, mse())
+
+
+def test_sparse_embedding_requires_kvstore():
+    emb = SparseEmbedding(4, 10, key="nokv")
+    with pytest.raises(MXNetError, match="no kvstore bound"):
+        emb(nd.array(np.array([1])))
+
+
+# ---------------------------------------------------------------------------
+# lookup serving (fleet replica role)
+# ---------------------------------------------------------------------------
+def _tower(feat_dim, w, b, ladder=(1, 4, 16)):
+    from mxnet_tpu.serving import AOTPredictor
+
+    data = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return AOTPredictor(sym, {"fc_weight": nd.array(w),
+                              "fc_bias": nd.array(b)},
+                        data_shapes={"data": (1, feat_dim)},
+                        ladder=ladder)
+
+
+def test_lookup_server_parity_and_fleet_routing(cluster):
+    from mxnet_tpu.serving import FleetRouter
+
+    kv, _ = cluster
+    tu = ShardedEmbeddingTable("lu", kv, 30, 4)
+    ti = ShardedEmbeddingTable("li", kv, 20, 4)
+    tu.init(seed=3)
+    ti.init(seed=4)
+    w = np.random.RandomState(5).randn(1, 8).astype(np.float32)
+    b = np.zeros((1,), np.float32)
+    with EmbeddingLookupServer(
+            "mf", {"user": tu, "item": ti}, _tower(8, w, b)) as srv:
+        u = np.array([1, 5, 7])
+        it = np.array([0, 3, 19])
+        outs = srv.predict({"user": u, "item": it})
+        feats = np.concatenate([tu.lookup(u), ti.lookup(it)], axis=1)
+        expect = feats @ w.T + b
+        assert np.allclose(outs[0], expect, atol=1e-5)
+        # column-vector id format works at every batch size, INCLUDING
+        # batch-of-one (np.squeeze would collapse (1, 1) to 0-d)
+        col = srv.predict({"user": u.reshape(-1, 1),
+                           "item": it.reshape(-1, 1)})
+        assert np.allclose(col[0], expect, atol=1e-5)
+        one = srv.predict({"user": np.array([[1]]),
+                           "item": np.array([[0]])})
+        assert np.allclose(one[0], expect[:1], atol=1e-5)
+        # discovered + routed like any serving replica (static view)
+        with FleetRouter(replicas=[srv.addr], view_interval=0.5,
+                         timeout=10.0) as router:
+            r = router.request("mf", {"user": u, "item": it})
+            assert np.allclose(r[0], expect, atol=1e-5)
+
+
+def test_lookup_server_oov_typed(cluster):
+    kv, _ = cluster
+    tu = ShardedEmbeddingTable("lo", kv, 10, 4)
+    tu.init(seed=6)
+    w = np.zeros((1, 4), np.float32)
+    b = np.zeros((1,), np.float32)
+    with EmbeddingLookupServer("m1", {"user": tu},
+                               _tower(4, w, b)) as srv:
+        with pytest.raises(EmbeddingShardError, match="out of vocab"):
+            srv.predict({"user": np.array([11])})
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: suffix-routed shard restore (the elastic respawn path)
+# ---------------------------------------------------------------------------
+def test_checkpoint_restores_exactly_the_servers_sub_keys(
+        cluster, tmp_path, monkeypatch):
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    kv, _ = cluster
+    kv.set_optimizer("sgd", learning_rate=0.1, momentum=0.9,
+                     rescale_grad=1.0)
+    full = np.random.RandomState(7).randn(40, 4).astype(np.float32)
+    t = _table(kv, rows=40, dim=4, full=full)
+    t.push(np.arange(12), np.ones((12, 4), np.float32))
+    kv.wait_outstanding()
+    trained = t.as_dense()
+
+    manager = CheckpointManager(str(tmp_path))
+    weights = {"arg:%s" % k: v for k, v in t.snapshot().items()}
+    opt_path = tmp_path / "opt.states"
+    kv.save_optimizer_states(str(opt_path))
+    manager.save(1, weights=weights,
+                 optimizer_states=opt_path.read_bytes(),
+                 optimizer_config=kv.get_optimizer_config())
+
+    monkeypatch.delenv("MXNET_TPU_ZERO_SERVER", raising=False)
+    for rank in range(2):
+        fresh = KVStoreServer(num_workers=1)
+        try:
+            n = fresh.restore_from_checkpoint(
+                manager.latest(), shard_rank=rank, num_shards=2)
+            assert n == 1  # exactly this server's sub-key
+            key = embedding_sub_key("emb", rank)
+            assert key in fresh._store
+            other = embedding_sub_key("emb", 1 - rank)
+            assert other not in fresh._store
+            # restored bytes match the trained shard
+            assert np.allclose(
+                fresh._store[key],
+                trained[t.sharding.global_ids(rank)])
+            # the momentum state followed its sub-key
+            assert fresh._updater is not None
+            states = fresh._updater.states
+            assert key in states and other not in states
+        finally:
+            fresh.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke
+# ---------------------------------------------------------------------------
+def test_bench_embed_smoke():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from bench_embed import measure
+    finally:
+        sys.path.pop(0)
+    rec = measure(rows=512, dim=8, servers=2, batch=64, iters=2,
+                  naive_batch=16, naive_iters=1)
+    assert rec["train_rows_s"] > 0
+    assert rec["speedup_dedup_vs_naive"] > 0
+    assert abs(rec["mem_ratio_max"] - 0.5) < 0.05
+    assert rec["cores"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# slow tier: launch.py e2e + chaos
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_recommender_e2e_two_workers_two_servers():
+    """Acceptance: the recommender trains to decreasing loss on
+    ``launch.py -n 2 -s 2`` end-to-end."""
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--timeout", "150",
+           sys.executable,
+           os.path.join(ROOT, "examples", "recommender", "train.py"),
+           "--num-epochs", "2", "--num-samples", "4000"]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
+    assert len(losses) == 2, out[-3000:]
+    for _rank, l0, l1 in losses:
+        assert float(l1) < float(l0), out[-2000:]
+
+
+@pytest.mark.slow
+def test_chaos_embed_server_crash_heals():
+    """The chaos matrix embedding case: server crash mid-training
+    heals via elastic respawn + suffix-routed shard restore with loss
+    still decreasing (tools/chaos_check.py --embed)."""
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_check.py"),
+         "--embed", "--spec", "server:0:crash@step=200"],
+        env=env, capture_output=True, text=True, timeout=260)
+    assert proc.returncode == 0, \
+        (proc.stdout + proc.stderr)[-3000:]
+    assert "chaos_check[embed]: OK" in proc.stdout

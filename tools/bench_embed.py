@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Sharded-embedding data-plane microbenchmark (ISSUE 14).
+
+Measures the workload the subsystem exists for — skewed many-small-keys
+traffic against a table too large for one server: ``--servers`` (default
+4) in-process KVStoreServers hold the row shards (total table+optimizer
+bytes = servers x one server's budget, the >= 4x acceptance shape), and
+a training-shaped round (dedup pull of a zipfian id batch, gradient
+scatter push) drives rows/s:
+
+- **dedup vs naive**: deduplicated per-shard ``row_pull`` frames vs the
+  ``MXNET_EMBED_DEDUP=0`` one-RPC-per-id baseline (pull-only rows/s;
+  the >= 2x acceptance number);
+- **async vs sync**: the PR 4 sender pipeline vs the synchronous client
+  (full pull+push rounds);
+- **2bit wire**: the compressed scatter push as a bonus row.
+
+Per-server memory is measured (``ServerKVStore.server_memory``) and
+published through ``profiler.memory_record`` so the ~1/num_servers
+evidence rides memoryStats. Emits ONE bench.py-style JSON line.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _zipf_ids(rng, n, rows, a):
+    """Zipfian ids clipped into the vocabulary (frequency-sorted: the
+    hot head sits at the low ids, as recommender vocabs are built)."""
+    import numpy as np
+
+    return np.minimum(rng.zipf(a, n).astype(np.int64) - 1, rows - 1)
+
+
+def measure(rows=131072, dim=64, servers=4, batch=4096, iters=8,
+            naive_batch=512, naive_iters=2, zipf_a=1.2, seed=0):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.embedding import ShardedEmbeddingTable
+    from mxnet_tpu.kvstore_server import KVStoreServer, ServerKVStore
+
+    srvs = [KVStoreServer(num_workers=1) for _ in range(servers)]
+    for s in srvs:
+        s.serve_in_background()
+    uris = ",".join(s.addr for s in srvs)
+    rng = np.random.RandomState(seed)
+    batches = [_zipf_ids(rng, batch, rows, zipf_a) for _ in range(iters)]
+    uniq_frac = float(np.mean([np.unique(b).size / b.size
+                               for b in batches]))
+
+    def client(pipeline=True, wire="raw"):
+        kv = ServerKVStore(uris, pipeline=pipeline)
+        kv.set_optimizer("sgd", learning_rate=0.05, momentum=0.9,
+                         rescale_grad=1.0 / batch)
+        t = ShardedEmbeddingTable("bench_emb", kv, rows, dim,
+                                  wire=wire)
+        t.init(seed=seed)  # first-writer-wins: one real init
+        return kv, t
+
+    def train_round(t, ids):
+        uniq, inverse, vecs = t.pull(ids)
+        # a gradient the size of the pulled block (the MF shape)
+        t.push(uniq, vecs * 0.01)
+
+    def timed_rounds(t, kv, n):
+        # warmup (compiles the lazy sparse update kernels server-side)
+        train_round(t, batches[0])
+        kv.wait_outstanding()
+        t0 = time.perf_counter()
+        for i in range(n):
+            train_round(t, batches[i % iters])
+        kv.wait_outstanding()
+        return (n * batch) / (time.perf_counter() - t0)
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    rec = {"rows": rows, "dim": dim, "servers": servers,
+           "batch": batch, "zipf_a": zipf_a,
+           "unique_frac": round(uniq_frac, 4),
+           "table_mb": round(rows * dim * 4 / 1e6, 1),
+           # async-vs-sync is only meaningful with cores to overlap
+           # on: on a 1-core host the pipeline's sender threads and
+           # the 4 servers' concurrent lazy-sparse updates contend for
+           # the same core (the PR 11 fleet-scaling precedent) — the
+           # record carries the core count so the number reads
+           # honestly
+           "cores": cores}
+
+    # -- async, dedup (the subsystem's intended shape) ----------------------
+    profiler.embedding_reset()
+    kv, t = client()
+    rec["train_rows_s"] = round(timed_rounds(t, kv, iters), 1)
+
+    # pull-only: dedup vs the naive per-id baseline
+    t0 = time.perf_counter()
+    for i in range(iters):
+        t.pull(batches[i % iters])
+    rec["pull_rows_s"] = round(
+        (iters * batch) / (time.perf_counter() - t0), 1)
+    # snapshot the dedup path's counters BEFORE the naive baseline
+    # runs: its giant per-pull aggregate latencies and 1.0-ratio id
+    # counts would otherwise pollute the reported p99/dedup_ratio
+    stats = profiler.embedding_stats()
+    rec["dedup_ratio"] = stats.get("dedup_ratio")
+    rec["pull_p99_ms"] = stats.get("pull_p99_ms")
+    rec["push_p99_ms"] = stats.get("push_p99_ms")
+    t.dedup = False
+    npulls = max(1, naive_iters)
+    t0 = time.perf_counter()
+    for i in range(npulls):
+        t.pull(batches[i % iters][:naive_batch])
+    rec["naive_pull_rows_s"] = round(
+        (npulls * naive_batch) / (time.perf_counter() - t0), 1)
+    t.dedup = True
+    rec["speedup_dedup_vs_naive"] = round(
+        rec["pull_rows_s"] / max(rec["naive_pull_rows_s"], 1e-9), 2)
+
+    # -- per-server memory (the 1/num_servers acceptance) -------------------
+    mem = kv.server_memory()
+    per = [m["embed_store_bytes"] + m["embed_opt_bytes"] for m in mem]
+    total = sum(per)
+    rec["per_server_mb"] = [round(b / 1e6, 2) for b in per]
+    rec["mem_ratio_max"] = round(max(per) / max(total, 1), 4)
+    profiler.memory_record(
+        embedding_per_server_bytes=per,
+        embedding_total_bytes=total,
+        embedding_servers=servers)
+    rec["memory_stats"] = profiler.memory_stats()
+    kv.close()
+
+    # -- sync client (MXNET_KVSTORE_PIPELINE=0 fallback) --------------------
+    kv_sync, t_sync = client(pipeline=False)
+    rec["sync_train_rows_s"] = round(
+        timed_rounds(t_sync, kv_sync, iters), 1)
+    rec["async_vs_sync"] = round(
+        rec["train_rows_s"] / max(rec["sync_train_rows_s"], 1e-9), 2)
+    kv_sync.close()
+
+    # -- 2bit wire (bonus row) ----------------------------------------------
+    kv_2b, t_2b = client(wire="2bit")
+    rec["train_rows_s_2bit"] = round(timed_rounds(t_2b, kv_2b, iters), 1)
+    kv_2b.close()
+
+    for s in srvs:
+        s.shutdown()
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=131072)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--servers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--naive-batch", type=int, default=512)
+    ap.add_argument("--naive-iters", type=int, default=2)
+    ap.add_argument("--zipf", type=float, default=1.2)
+    args = ap.parse_args()
+    rec = measure(rows=args.rows, dim=args.dim, servers=args.servers,
+                  batch=args.batch, iters=args.iters,
+                  naive_batch=args.naive_batch,
+                  naive_iters=args.naive_iters, zipf_a=args.zipf)
+    print(json.dumps({
+        "metric": "embed_train_rows_s", "value": rec["train_rows_s"],
+        "unit": "rows/s", "embed": rec}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

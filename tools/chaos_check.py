@@ -96,6 +96,19 @@ GENERATE_MATRIX = [
     "generate:stall@req=2",
 ]
 
+#: sharded-embedding fault kind (ISSUE 14): the recommender job
+#: (examples/recommender/train.py, sharded tables on 2 servers) under
+#: a server crash — the PR 3 elastic respawn + checkpoint restore path
+#: exercised by SPARSE state for the first time. The respawned server
+#: must restore exactly its suffix-routed embedding sub-keys
+#: (event=restored-from keys=2) and the job must still converge.
+#: step=200 lands in epoch 2, after the epoch-1 table checkpoint
+#: committed (2 workers x 32 steps/epoch x 2 sub-key pushes ≈ 128
+#: applied pushes per epoch on server 0).
+EMBED_MATRIX = [
+    "server:0:crash@step=200",
+]
+
 
 def _kind(spec):
     m = re.search(r":(crash|nan|preempt)@", spec)
@@ -349,6 +362,69 @@ def run_serve_case(args, spec):
     return 0
 
 
+def run_embed_case(args, spec):
+    """One sharded-embedding fault case: the recommender MF job on 2
+    workers / 2 value servers with coordinated table checkpoints,
+    under a server crash. Passes only when the crash fired, launch.py
+    respawned the server, the respawn restored its embedding sub-keys
+    from the committed checkpoint (the suffix-routed restore — the
+    line carries keys=N > 0), and the loss still decreased on every
+    worker."""
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    env["MXNET_FAULT_SPEC"] = spec
+    # the MF job runs 3 epochs across a server death + restore: give
+    # it more room than the dense trainer's default watchdog
+    timeout = max(args.timeout, 150)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2",
+           "--max-restarts", str(args.max_restarts),
+           "--timeout", str(timeout),
+           sys.executable,
+           os.path.join(ROOT, "examples", "recommender", "train.py"),
+           "--num-epochs", "3"]
+    print("chaos_check[embed]: %s  (MXNET_FAULT_SPEC=%s)"
+          % (" ".join(cmd), spec), flush=True)
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout + 30)
+    out = proc.stdout + proc.stderr
+    sys.stdout.write(out)
+
+    failures = []
+    if proc.returncode != 0:
+        failures.append("job exited %d" % proc.returncode)
+    if "[chaos]" not in out:
+        failures.append("fault spec never fired (no [chaos] line)")
+    if "respawning" not in out:
+        failures.append("no respawn observed")
+    restores = re.findall(
+        r"event=restored-from role=server rank=\d+ ckpt=\S+ keys=(\d+)",
+        out)
+    if not restores:
+        failures.append("respawned server never restored from a "
+                        "checkpoint")
+    elif not any(int(k) > 0 for k in restores):
+        failures.append("server restore found no embedding sub-keys "
+                        "(keys=0): the suffix routing lost the shards")
+    losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
+    if len(losses) != 2:
+        failures.append("expected 2 worker loss reports, got %d"
+                        % len(losses))
+    for rank, loss0, loss1 in losses:
+        if not float(loss1) < float(loss0):
+            failures.append("worker %s loss did not decrease (%s -> %s)"
+                            % (rank, loss0, loss1))
+    if failures:
+        print("chaos_check[embed]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[embed]: OK — server crash healed via shard "
+          "restore (%s) and the recommender converged"
+          % ", ".join("keys=%s" % k for k in restores))
+    return 0
+
+
 def run_case(args, spec):
     from mxnet_tpu.test_utils import clean_dist_env
 
@@ -429,9 +505,14 @@ def main():
                          "(default: kill worker 1 mid-epoch)")
     ap.add_argument("--matrix", action="store_true",
                     help="run the full fault matrix (crash, nan, "
-                         "preempt, plus the serving-fleet replica "
-                         "crash/stall and router drop kinds) instead "
-                         "of a single --spec")
+                         "preempt, the serving-fleet replica "
+                         "crash/stall and router drop kinds, and the "
+                         "sharded-embedding server-crash case) "
+                         "instead of a single --spec")
+    ap.add_argument("--embed", action="store_true",
+                    help="run --spec against the sharded-embedding "
+                         "recommender job (2 workers / 2 value "
+                         "servers) instead of the dense trainer")
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--max-restarts", type=int, default=1)
@@ -439,11 +520,17 @@ def main():
                     help="launch.py watchdog per case (seconds)")
     args = ap.parse_args()
 
-    specs = (MATRIX + SERVE_MATRIX + GENERATE_MATRIX) if args.matrix \
-        else [args.spec]
+    if args.matrix:
+        specs = [(s, False) for s in MATRIX + SERVE_MATRIX
+                 + GENERATE_MATRIX]
+        specs += [(s, True) for s in EMBED_MATRIX]
+    else:
+        specs = [(args.spec, args.embed)]
     rc = 0
-    for spec in specs:
-        if _is_generate_spec(spec):
+    for spec, embed in specs:
+        if embed:
+            rc |= run_embed_case(args, spec)
+        elif _is_generate_spec(spec):
             rc |= run_generate_case(args, spec)
         elif _is_serve_spec(spec):
             rc |= run_serve_case(args, spec)
