@@ -357,18 +357,19 @@ def _measure_embed():
 
 
 def _measure_tune():
-    """Schedule-autotuner variant (ISSUE 10): sweep the Pallas knob
-    space at the bench shapes (tools/tune_kernels.py) and record the
-    winner vs the default schedule per kernel in one JSON line — the
-    measurement ROADMAP item 1 needs to populate BENCH_r06 and decide
-    the fused-default flip by search instead of by hand. Winners land
-    in the on-disk schedule table, so subsequent fused runs with
-    MXNET_TPU_TUNE=1 pick them up at trace time."""
+    """Schedule-autotuner variant (ISSUE 10 + 15): sweep the Pallas
+    knob space at the bench shapes (tools/tune_kernels.py --compare:
+    exhaustive first, cost-model refit, then the ranked sweep) and
+    record winner-vs-default AND ranked-vs-exhaustive (timed/skipped
+    counts, wall-times, winner delta) per kernel in one JSON line — so
+    the trajectory tracks ranked-sweep wall-time next to winner
+    quality. Winners land in the on-disk schedule table, so subsequent
+    fused runs with MXNET_TPU_TUNE=1 pick them up at trace time."""
     try:
         proc = subprocess.run(
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "tools", "tune_kernels.py")],
+                          "tools", "tune_kernels.py"), "--compare"],
             capture_output=True, text=True,
             timeout=max(60, CHILD_TOTAL_TIMEOUT - 120))
         rec = None
@@ -389,8 +390,10 @@ def _measure_tune():
                                  (proc.stderr or "").strip()[-300:])}))
             return
         tuned = {}
+        ranked_wall = exh_wall = 0.0
         for key, r in rec["tune"].items():
             w = r.get("winner") or {}
+            exh = r.get("exhaustive") or {}
             tuned[key] = {
                 "cache_hit": r.get("cache_hit", False),
                 "schedule": w.get("schedule"),
@@ -399,10 +402,34 @@ def _measure_tune():
                 "speedup_vs_default": w.get("speedup_vs_default"),
                 "n_timed": r.get("n_timed"),
                 "n_pruned": r.get("n_pruned"),
+                "n_skipped_ranked": r.get("n_skipped_ranked"),
+                "ranker": (r.get("ranker") or {}).get("mode"),
+                "wall_s": r.get("wall_s"),
+                "exhaustive_n_timed": exh.get("n_timed"),
+                "exhaustive_wall_s": exh.get("wall_s"),
+                "winner_delta_pct": r.get("winner_delta_pct"),
+                # what the table actually serves after the run: the
+                # compare flow re-commits the exhaustive winner when
+                # the ranked one measured slower
+                "recommitted_exhaustive_winner": r.get(
+                    "recommitted_exhaustive_winner", False),
+                "committed_schedule": (exh.get("winner_schedule")
+                                       if r.get(
+                                           "recommitted_exhaustive_winner")
+                                       else w.get("schedule")),
             }
-        print(json.dumps({"variant": "tune", "tuned": tuned,
-                          "backend": rec.get("backend"),
-                          "table": rec.get("table")}))
+            if r.get("wall_s"):
+                ranked_wall += r["wall_s"]
+            if exh.get("wall_s"):
+                exh_wall += exh["wall_s"]
+        out = {"variant": "tune", "tuned": tuned,
+               "backend": rec.get("backend"),
+               "table": rec.get("table")}
+        if ranked_wall and exh_wall:
+            out["ranked_wall_s"] = round(ranked_wall, 2)
+            out["exhaustive_wall_s"] = round(exh_wall, 2)
+            out["sweep_speedup"] = round(exh_wall / ranked_wall, 2)
+        print(json.dumps(out))
     except (subprocess.TimeoutExpired, OSError) as e:
         print(json.dumps({"error": "tune: %s" % str(e)[:300]}))
 

@@ -276,6 +276,36 @@ KNOBS = {
         "schedule_table.json); written atomically by "
         "tools/tune_kernels.py, keyed (kernel, shape, dtype, backend) "
         "(tune/table.py)"),
+    # --- learned cost model / ranked sweeps / background tuning
+    # (ISSUE 15) ---
+    "MXNET_TUNE_RANKER": (
+        "1", "honored",
+        "rank sweep candidates with the learned cost model and time "
+        "only the top MXNET_TUNE_TOPK (hand default always timed as "
+        "baseline); the ranker abstains into the exhaustive sweep when "
+        "the model is missing, under-trained, or below the validation "
+        "rank-correlation floor — 0 pins the PR 10 exhaustive sweep "
+        "(tune/search.py)"),
+    "MXNET_TUNE_TOPK": (
+        "3", "honored",
+        "how many model-ranked candidates a ranked sweep times, on top "
+        "of the always-timed hand default (tune/search.py)"),
+    "MXNET_TUNE_MODEL": (
+        "", "honored",
+        "cost-model path override (default: next to the schedule "
+        "table, <table>.model.json); versioned JSON written atomically "
+        "by model refits — corrupt files log, behave as absent, and "
+        "are rewritten whole by the next fit (tune/model.py)"),
+    "MXNET_TUNE_BACKGROUND": (
+        "0", "honored",
+        "arm tune.BackgroundTuner in Module.fit: bounded tuning slots "
+        "at epoch/checkpoint drain boundaries for shapes the job "
+        "traced (schedule-table misses), never inside the steady-state "
+        "step loop (tune/background.py)"),
+    "MXNET_TUNE_BG_BUDGET": (
+        "2", "honored",
+        "max timed programs per background-tuning slot, hand default "
+        "included (tune/background.py)"),
     # --- misc registered per the drift audit ---
     "MXNET_TPU_FUSED_ROW_TILE": (
         "", "honored",

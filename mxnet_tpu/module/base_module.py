@@ -169,6 +169,15 @@ class BaseModule:
         if health_guard is not None:
             health_guard.install_preemption_handler()
 
+        # background tuning (ISSUE 15): armed by MXNET_TUNE_BACKGROUND=1.
+        # Steals one bounded tuning slot per epoch at the drain boundary
+        # below (after get_params emptied the dispatch-ahead pipeline)
+        # for shapes this job traced but the schedule table missed —
+        # never inside the steady-state step loop (tune/background.py).
+        from ..tune.background import BackgroundTuner
+
+        bg_tuner = BackgroundTuner.from_env(logger=self.logger)
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -217,6 +226,14 @@ class BaseModule:
 
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
+
+            if bg_tuner is not None:
+                # drained boundary: get_params() above blocked on the
+                # dispatch-ahead pipeline, so the tuner's bounded slot
+                # cannot overlap a steady-state step; winners commit
+                # atomically and the next trace of this shape picks
+                # them up
+                bg_tuner.on_drain()
 
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):

@@ -395,45 +395,68 @@ def health_reset():
 
 
 # ---------------------------------------------------------------------------
-# autotuner observability (ISSUE 10): always-on counters for the
+# autotuner observability (ISSUE 10 + 15): always-on counters for the
 # schedule-table consult path — table hits/misses (one per trace-time
 # schedule_for call, memo'd thereafter per key), fallbacks (a stored
-# schedule rejected as illegal for the shape), and the chosen schedule
-# per kernel key with its source (table vs default). Cheap enough to
-# run unconditionally, like comm_record; rides dump_profile as
-# tuningStats.
+# schedule rejected as illegal for the shape), the chosen schedule per
+# kernel key with its source (table vs default) — plus the learned-
+# ranker counters: candidates scored, timings the ranking skipped,
+# abstains (exhaustive fallback), model refits, background-tuning
+# slots/commits, and a per-(kernel, backend) predicted-vs-measured
+# validation rank-correlation gauge. Cheap enough to run
+# unconditionally, like comm_record; rides dump_profile as
+# tuningStats. Unknown counter names raise.
 # ---------------------------------------------------------------------------
 _TUNE_LOCK = threading.Lock()
-_TUNE_ZERO = {"hits": 0, "misses": 0, "fallbacks": 0}
+_TUNE_ZERO = {"hits": 0, "misses": 0, "fallbacks": 0,
+              "candidates_ranked": 0, "timings_skipped": 0,
+              "ranker_abstains": 0, "model_refits": 0,
+              "bg_slots": 0, "bg_commits": 0}
 _TUNE = dict(_TUNE_ZERO)
 _TUNE_KERNELS = {}
+_TUNE_CORR = {}
 
 
-def tuning_record(hits=0, misses=0, fallbacks=0, kernel=None,
-                  schedule=None, source=None):
-    """Accumulate schedule-table counters; ``kernel`` (a table key)
-    additionally records that kernel's chosen schedule + source."""
+def tuning_record(kernel=None, schedule=None, source=None, corr=None,
+                  **counts):
+    """Accumulate autotuner counters (``hits=1``,
+    ``timings_skipped=4``, ... — unknown names raise). ``kernel`` (a
+    table key) additionally records that kernel's chosen schedule +
+    source; ``corr`` merges a {model group: validation rank
+    correlation} gauge."""
+    for name in counts:
+        if name not in _TUNE_ZERO:
+            raise ValueError("unknown tuning counter %r (known: %s)"
+                             % (name, ", ".join(sorted(_TUNE_ZERO))))
     with _TUNE_LOCK:
-        _TUNE["hits"] += hits
-        _TUNE["misses"] += misses
-        _TUNE["fallbacks"] += fallbacks
+        for name, v in counts.items():
+            _TUNE[name] += v
         if kernel is not None:
             _TUNE_KERNELS[kernel] = {"schedule": schedule, "source": source}
+        if corr:
+            for gk, v in dict(corr).items():
+                _TUNE_CORR[gk] = round(float(v), 4)
 
 
 def tuning_stats(reset=False):
-    """Snapshot {hits, misses, fallbacks, kernels: {key: {schedule,
-    source}}}; empty dict when the consult path never ran."""
+    """Snapshot {hits, misses, fallbacks, candidates_ranked,
+    timings_skipped, ranker_abstains, model_refits, bg_slots,
+    bg_commits, kernels: {key: {schedule, source}}, rank_correlation:
+    {group: r}}; empty dict when the tuning path never ran."""
     with _TUNE_LOCK:
         snap = dict(_TUNE)
         kernels = {k: dict(v) for k, v in _TUNE_KERNELS.items()}
+        corr = dict(_TUNE_CORR)
         if reset:
             _TUNE.update(_TUNE_ZERO)
             _TUNE_KERNELS.clear()
-    if not (any(snap.values()) or kernels):
+            _TUNE_CORR.clear()
+    if not (any(snap.values()) or kernels or corr):
         return {}
     if kernels:
         snap["kernels"] = kernels
+    if corr:
+        snap["rank_correlation"] = corr
     return snap
 
 
@@ -441,6 +464,7 @@ def tuning_reset():
     with _TUNE_LOCK:
         _TUNE.update(_TUNE_ZERO)
         _TUNE_KERNELS.clear()
+        _TUNE_CORR.clear()
 
 
 # ---------------------------------------------------------------------------

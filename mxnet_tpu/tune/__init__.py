@@ -32,13 +32,41 @@ the current hand defaults as fallback, so an empty table is
 bit-identical to the pre-autotuner behavior. ``tools/tune_kernels.py``
 runs the sweep offline; ``profiler.tuning_stats`` counts table
 hits/misses/fallbacks and records each kernel's chosen schedule.
-"""
-from .table import (ScheduleTable, TABLE_VERSION, default_table_path,
-                    get_table, make_key, reset, schedule_for)
-from .search import (FLASH_BLOCKS, FUSED_KINDS, flash_candidates,
-                     fused_candidates, sweep_flash, sweep_fused)
 
-SWEEPABLE_KERNELS = FUSED_KINDS + ("flash_attention",)
+ISSUE 15 grows the loop with a *learned* half:
+
+- :mod:`.model` — a pure-numpy learned cost model (ridge on log
+  plan-summary features) trained on the table's banked timings,
+  cross-validated per (kernel, backend), abstaining (exhaustive
+  fallback) when under-trained or below the rank-correlation floor.
+- ranked sweeps — :func:`sweep_fused`/:func:`sweep_flash` time only
+  the model's top-``MXNET_TUNE_TOPK`` candidates (hand default always
+  included) and refit the model from every commit.
+- :mod:`.background` — :class:`BackgroundTuner`: long training jobs
+  tune the shapes they actually traced in bounded slots at drain
+  boundaries (armed by ``MXNET_TUNE_BACKGROUND=1``).
+"""
+from .table import (ScheduleTable, TABLE_VERSION, clear_misses,
+                    default_table_path, get_table, make_key,
+                    recorded_misses, schedule_for)
+from .table import reset as _reset_table
+from .search import (FLASH_BLOCKS, FUSED_KINDS, SWEEPABLE_KERNELS,
+                     flash_candidates, fused_candidates, sweep_flash,
+                     sweep_for_key, sweep_fused)
+from .model import (CostModel, CostModelError, MODEL_VERSION,
+                    default_model_path, features_from_plan,
+                    fit_cost_model, get_model, plan_for)
+from .model import reset as _reset_model
+from .background import BackgroundTuner
+
+
+def reset():
+    """Drop the process-global table, miss registry, and cost model —
+    tests, and long-lived processes that want to pick up externally
+    updated files."""
+    _reset_table()
+    _reset_model()
+
 
 
 def rule_kernels():
@@ -67,8 +95,11 @@ def sweepable_kernels():
 
 __all__ = [
     "ScheduleTable", "TABLE_VERSION", "default_table_path", "get_table",
-    "make_key", "reset", "schedule_for",
+    "make_key", "reset", "schedule_for", "recorded_misses", "clear_misses",
     "FLASH_BLOCKS", "FUSED_KINDS", "SWEEPABLE_KERNELS", "flash_candidates",
     "fused_candidates", "rule_kernels", "sweepable_kernels",
-    "sweep_flash", "sweep_fused",
+    "sweep_flash", "sweep_fused", "sweep_for_key",
+    "BackgroundTuner", "CostModel", "CostModelError", "MODEL_VERSION",
+    "default_model_path", "features_from_plan", "fit_cost_model",
+    "get_model", "plan_for",
 ]

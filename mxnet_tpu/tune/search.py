@@ -20,14 +20,32 @@ candidate is reported against the bench_kernel <10% bar. Winners are
 committed to the on-disk table (:mod:`.table`); a re-run of a sweep
 whose key is already cached is a pure table hit with zero candidate
 timings.
+
+**Ranked sweeps (ISSUE 15).** With ``MXNET_TUNE_RANKER=1`` (default)
+and a usable learned cost model (:mod:`.model`), a sweep featurizes
+every legal candidate, predicts its ms, and times only the
+top-``MXNET_TUNE_TOPK`` (the hand default is always timed as the
+baseline) — everything else is marked ``skipped_ranked`` with its
+predicted ms so the decision rides the trajectory. The ranker
+*abstains* (exhaustive sweep, bit-identical to PR 10) when the model
+is missing/corrupt, has fewer than ``model.MIN_FIT_ROWS`` rows for
+the (kernel, backend) group, or its validation rank correlation is
+below ``model.CORR_FLOOR``. Every sweep commit banks ALL its timings
+in the table record and — in ranked mode — refits the model, so the
+ranker improves across sweeps.
 """
 from __future__ import annotations
 
 import itertools
+import time
 
 from .table import get_table, make_key
 
 FUSED_KINDS = ("fused_fwd", "fused_wgrad", "fused_dgrad")
+
+# every kernel family sweep_for_key can dispatch — THE one list the
+# background tuner's miss filter and the package surface derive from
+SWEEPABLE_KERNELS = FUSED_KINDS + ("flash_attention",)
 
 # default candidate grids — the knob space ISSUE 10 names; tune_kernels
 # can override per sweep
@@ -175,6 +193,89 @@ def flash_candidates(seq_q, seq_k, blocks=None):
 
 
 # ---------------------------------------------------------------------------
+# ranked mode (ISSUE 15)
+# ---------------------------------------------------------------------------
+def _resolve_ranker(ranked, topk):
+    """Resolve the ranked-mode knobs: explicit args beat
+    ``MXNET_TUNE_RANKER`` / ``MXNET_TUNE_TOPK`` (strict accessors —
+    malformed values raise naming the knob)."""
+    from .. import config
+
+    if ranked is None:
+        ranked = config.get_strict_bool("MXNET_TUNE_RANKER")
+    if topk is None:
+        topk = config.get_positive_int("MXNET_TUNE_TOPK")
+    return bool(ranked), int(topk)
+
+
+def _apply_ranking(kernel, shape, dtype, backend, entries, topk, table,
+                   cost_model=None):
+    """Rank the legal candidates with the learned cost model and mark
+    everything below the top-``topk`` as ``skipped_ranked`` (predicted
+    ms annotated on every scored entry). Returns the ranker report for
+    the sweep: ``mode`` is ``ranked`` or — when the model is missing,
+    under-trained, or below the validation-correlation floor —
+    ``exhaustive`` with ``abstained`` and the reason (behaviorally
+    identical to the PR 10 sweep)."""
+    import numpy as np
+
+    from . import model as cost_model_mod
+    from .. import profiler
+
+    m = cost_model or cost_model_mod.get_model(
+        cost_model_mod.model_path_for(table))
+    cands = [e for e in entries if e["status"] == "candidate"]
+    if not cands:
+        # nothing to rank (every candidate pruned / deduped into the
+        # default): vacuous ranked mode — the sweep times the default
+        # only, exactly like exhaustive would
+        return {"mode": "ranked", "abstained": False, "topk": topk,
+                "n_scored": 0, "n_skipped": 0,
+                "group": cost_model_mod.group_key(kernel, backend),
+                "val_corr": None}
+    ok, why = m.usable(kernel, backend)
+    if not ok:
+        profiler.tuning_record(ranker_abstains=1)
+        return {"mode": "exhaustive", "abstained": True, "reason": why}
+    plans = [e.get("plan") or cost_model_mod.plan_for(kernel, shape,
+                                                      e["schedule"])
+             for e in cands]
+    pred = m.predict(kernel, backend, plans)
+    order = np.argsort(pred, kind="mergesort")
+    keep = set(int(i) for i in order[:topk])
+    skipped = 0
+    for i, e in enumerate(cands):
+        e["predicted_ms"] = round(float(pred[i]), 6)
+        if i not in keep:
+            e["status"] = "skipped_ranked"
+            skipped += 1
+    profiler.tuning_record(candidates_ranked=len(cands),
+                           timings_skipped=skipped)
+    return {"mode": "ranked", "abstained": False, "topk": topk,
+            "n_scored": len(cands), "n_skipped": skipped,
+            "group": cost_model_mod.group_key(kernel, backend),
+            "val_corr": (m.group(kernel, backend) or {}).get("val_corr")}
+
+
+def sweep_for_key(kernel, shape, dtype, *, backend=None, **kw):
+    """Dispatch a sweep from a table-key ``(kernel, shape, dtype)`` —
+    the background tuner's entry point: a recorded miss carries
+    exactly these, so the shapes a job traced are directly
+    sweepable."""
+    shape = tuple(int(d) for d in shape)
+    if kernel in FUSED_KINDS:
+        n, h, wd, ci, co, k, stride = shape
+        return sweep_fused(kernel, (n, h, wd, ci), (k, k, ci, co),
+                           stride=stride, dtype=dtype, backend=backend,
+                           **kw)
+    if kernel == "flash_attention":
+        b, h, sq, sk, d, causal = shape
+        return sweep_flash(b, h, sq, sk, d, causal=bool(causal),
+                           dtype=dtype, backend=backend, **kw)
+    raise ValueError("no sweep recipe for kernel %r" % (kernel,))
+
+
+# ---------------------------------------------------------------------------
 # timing + commit
 # ---------------------------------------------------------------------------
 def _rand(key, shape, dtype):
@@ -190,17 +291,24 @@ def _time_entries(entries, build_fn, budget, repeats, iters, target_sec,
     ``budget - 1`` searched candidates; annotates entries in place with
     ms/spread (or ``skipped_budget``) and returns the timed entries.
 
-    Budget truncation orders survivors by DESCENDING per-call work
-    (flash: block area) — the generation grid is ascending, so a
-    naive head-slice would only ever explore the smallest-tile corner
-    of the space and, since re-runs are cache hits, never reach the
-    likely-good large tiles at all."""
+    Budget truncation orders survivors by the model's prediction
+    (ascending) when every survivor carries one — a ranked sweep whose
+    budget is tighter than its topk (the background tuner's
+    BG_BUDGET=2 vs TOPK=3) must time the predicted-BEST candidates,
+    not override the ranking. Exhaustive-mode truncation orders by
+    DESCENDING per-call work (flash: block area) — the generation grid
+    is ascending, so a naive head-slice would only ever explore the
+    smallest-tile corner of the space and, since re-runs are cache
+    hits, never reach the likely-good large tiles at all."""
     from . import harness
 
     searched = [e for e in entries if e["status"] == "candidate"]
-    searched.sort(key=lambda e: -(e.get("work")
-                                  or e["schedule"].get("block_q", 1)
-                                  * e["schedule"].get("block_k", 1)))
+    if searched and all("predicted_ms" in e for e in searched):
+        searched.sort(key=lambda e: e["predicted_ms"])
+    else:
+        searched.sort(key=lambda e: -(e.get("work")
+                                      or e["schedule"].get("block_q", 1)
+                                      * e["schedule"].get("block_k", 1)))
     keep = max(0, budget - 1)
     for e in searched[keep:]:
         e["status"] = "skipped_budget"
@@ -225,7 +333,10 @@ def _time_entries(entries, build_fn, budget, repeats, iters, target_sec,
     return timed
 
 
-def _finish_sweep(kernel, shape, dtype, backend, entries, timed, table):
+def _finish_sweep(kernel, shape, dtype, backend, entries, timed, table,
+                  t_start=None, rank_info=None, refit=False):
+    from . import model as cost_model_mod
+
     default = next(e for e in timed if e["status"] == "default")
     winner = min(timed, key=lambda e: e["ms_per_iter"])
     rec = {
@@ -237,9 +348,25 @@ def _finish_sweep(kernel, shape, dtype, backend, entries, timed, table):
         "speedup_vs_default": round(
             default["ms_per_iter"] / winner["ms_per_iter"], 3)
         if winner["ms_per_iter"] else 1.0,
+        # bank EVERY timing (ISSUE 15): these are the cost model's
+        # training rows — each carries the plan_summary featurization
+        # joins on, so table entries, bench records, and model inputs
+        # share one representation
+        "timings": [
+            {"schedule": dict(e["schedule"]),
+             "ms_per_iter": e["ms_per_iter"],
+             "plan": e.get("plan") or cost_model_mod.plan_for(
+                 kernel, shape, e["schedule"])}
+            for e in timed],
     }
+    # the banked-rows merge (a topk-bounded ranked sweep or background
+    # slot must GROW the model's training set, never shrink a previous
+    # exhaustive sweep's bank) happens inside table.record, against
+    # the merge base re-read from disk at commit time — a concurrent
+    # process's rows banked for this key during the sweep survive
     table.record(kernel, shape, dtype, backend, rec)
-    return {
+    rec = table.entry(kernel, shape, dtype, backend) or rec
+    report = {
         "key": make_key(kernel, shape, dtype, backend),
         "kernel": kernel, "shape": list(shape), "dtype": dtype,
         "backend": backend, "cache_hit": False,
@@ -248,8 +375,27 @@ def _finish_sweep(kernel, shape, dtype, backend, entries, timed, table):
         "n_pruned": sum(1 for e in entries
                         if e["status"].startswith("pruned")),
         "n_timed": len(timed),
+        "n_skipped_ranked": sum(1 for e in entries
+                                if e["status"] == "skipped_ranked"),
+        "ranker": rank_info or {"mode": "exhaustive", "abstained": False},
         "winner": rec,
     }
+    if refit:
+        # the learning loop: every ranked-mode sweep refits the model
+        # from the table's accumulated timings, so the ranker improves
+        # across sweeps (an under-trained refit just skips groups)
+        try:
+            fit_rep = cost_model_mod.get_model(
+                cost_model_mod.model_path_for(table)).fit_from_table(table)
+            report["model_refit"] = fit_rep["fit"]
+        except cost_model_mod.CostModelError as e:
+            report["model_refit_error"] = str(e)
+    if t_start is not None:
+        # after the refit: the ranked mode's reported wall-time must
+        # carry the refit cost it alone pays — the >=5x acceptance and
+        # bench sweep_speedup compare these numbers
+        report["wall_s"] = round(time.perf_counter() - t_start, 4)
+    return report
 
 
 def _cache_hit_report(kernel, shape, dtype, backend, table, cached):
@@ -262,12 +408,16 @@ def _cache_hit_report(kernel, shape, dtype, backend, table, cached):
 def sweep_fused(kernel, x_shape, w_shape, stride=1, dtype="bfloat16", *,
                 budget=8, repeats=5, iters=None, target_sec=0.3,
                 min_iters=10, interpret=None, grid=None, table=None,
-                force=False, backend=None):
+                force=False, backend=None, ranked=None, topk=None,
+                cost_model=None):
     """Search one fused-conv kernel at one shape; commit the winner.
 
     The cache check goes through :meth:`ScheduleTable.lookup`, so a
     sweep whose key is already tuned is a pure table hit — zero
     candidate timings, visible in ``profiler.tuning_stats``.
+    ``ranked``/``topk`` default to the ``MXNET_TUNE_RANKER`` /
+    ``MXNET_TUNE_TOPK`` knobs; in ranked mode only the model's
+    top-``topk`` candidates (plus the hand default) are timed.
     """
     import jax
     import jax.numpy as jnp
@@ -276,7 +426,7 @@ def sweep_fused(kernel, x_shape, w_shape, stride=1, dtype="bfloat16", *,
 
     if backend is None:
         backend = jax.default_backend()
-    table = table or get_table()
+    table = table if table is not None else get_table()  # empty table is falsy
     n, h, wd, ci = x_shape
     k = int(w_shape[0])
     co = int(w_shape[-1])
@@ -288,7 +438,13 @@ def sweep_fused(kernel, x_shape, w_shape, stride=1, dtype="bfloat16", *,
                                      table.entry(kernel, shape, dtype,
                                                  backend))
 
+    t_start = time.perf_counter()
+    ranked, topk = _resolve_ranker(ranked, topk)
     entries = fused_candidates(kernel, x_shape, w_shape, stride, grid=grid)
+    rank_info = None
+    if ranked:
+        rank_info = _apply_ranking(kernel, shape, dtype, backend, entries,
+                                   topk, table, cost_model)
 
     jdt = jnp.dtype(dtype)
     keys = jax.random.split(jax.random.PRNGKey(0), 4)
@@ -321,16 +477,18 @@ def sweep_fused(kernel, x_shape, w_shape, stride=1, dtype="bfloat16", *,
     timed = _time_entries(entries, build_fn, budget, repeats, iters,
                           target_sec, min_iters)
     return _finish_sweep(kernel, shape, dtype, backend, entries, timed,
-                         table)
+                         table, t_start=t_start,
+                         rank_info=rank_info, refit=ranked)
 
 
 def sweep_flash(b, h, seq_q, seq_k, d, causal=False, dtype="float32", *,
                 budget=8, repeats=5, iters=None, target_sec=0.3,
                 min_iters=10, interpret=None, blocks=None, table=None,
-                force=False, backend=None):
+                force=False, backend=None, ranked=None, topk=None,
+                cost_model=None):
     """Search flash-attention (block_q, block_k) at one shape; commit
     the winner. Times the forward kernel (backward reuses the same
-    block parameters)."""
+    block parameters). ``ranked``/``topk`` as in :func:`sweep_fused`."""
     import jax
     import jax.numpy as jnp
 
@@ -338,7 +496,7 @@ def sweep_flash(b, h, seq_q, seq_k, d, causal=False, dtype="float32", *,
 
     if backend is None:
         backend = jax.default_backend()
-    table = table or get_table()
+    table = table if table is not None else get_table()  # empty table is falsy
     shape = (b, h, seq_q, seq_k, d, int(bool(causal)))
     if not force:
         cached = table.lookup("flash_attention", shape, dtype, backend)
@@ -348,7 +506,14 @@ def sweep_flash(b, h, seq_q, seq_k, d, causal=False, dtype="float32", *,
                                      table.entry("flash_attention", shape,
                                                  dtype, backend))
 
+    t_start = time.perf_counter()
+    ranked, topk = _resolve_ranker(ranked, topk)
     entries = flash_candidates(seq_q, seq_k, blocks=blocks)
+    rank_info = None
+    if ranked:
+        rank_info = _apply_ranking("flash_attention", shape, dtype,
+                                   backend, entries, topk, table,
+                                   cost_model)
 
     jdt = jnp.dtype(dtype)
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -366,4 +531,6 @@ def sweep_flash(b, h, seq_q, seq_k, d, causal=False, dtype="float32", *,
     timed = _time_entries(entries, build_fn, budget, repeats, iters,
                           target_sec, min_iters)
     return _finish_sweep("flash_attention", shape, dtype, backend, entries,
-                         timed, table)
+                         timed, table,
+                         t_start=t_start,
+                         rank_info=rank_info, refit=ranked)

@@ -55,6 +55,50 @@ def make_key(kernel, shape, dtype, backend):
     return "%s|%s|%s|%s" % (kernel, dims, dtype, backend)
 
 
+# ---------------------------------------------------------------------------
+# miss registry (ISSUE 15): every trace-time consult that found no
+# table entry records WHAT was missing — (kernel, shape, dtype,
+# backend), enough to reconstruct a sweep — so the background tuner
+# can time ranked candidates for exactly the shapes the job traced.
+# Process-local, bounded, cleared when a commit satisfies the key.
+# ---------------------------------------------------------------------------
+_MISS_LOCK = threading.Lock()
+_MISSES = {}          # key -> {key, kernel, shape, dtype, backend, count}
+_MISS_CAP = 512
+
+
+def _record_miss(key, kernel, shape, dtype, backend):
+    with _MISS_LOCK:
+        m = _MISSES.get(key)
+        if m is not None:
+            m["count"] += 1
+            return
+        if len(_MISSES) >= _MISS_CAP:
+            return
+        _MISSES[key] = {"key": key, "kernel": str(kernel),
+                        "shape": tuple(int(d) for d in shape),
+                        "dtype": str(dtype), "backend": str(backend),
+                        "count": 1}
+
+
+def recorded_misses():
+    """Snapshot of the schedule-table misses this process recorded via
+    trace-time consults (``schedule_for``), insertion-ordered — the
+    background tuner's work queue."""
+    with _MISS_LOCK:
+        return [dict(m) for m in _MISSES.values()]
+
+
+def clear_miss(key):
+    with _MISS_LOCK:
+        _MISSES.pop(key, None)
+
+
+def clear_misses():
+    with _MISS_LOCK:
+        _MISSES.clear()
+
+
 def _valid_schedule(schedule):
     if not isinstance(schedule, dict) or not schedule:
         return False
@@ -155,7 +199,20 @@ class ScheduleTable:
                                        schedule=dict(sched), source="table")
             else:
                 profiler.tuning_record(misses=1)
+                _record_miss(key, kernel, shape, dtype, backend)
         return dict(sched) if sched else None
+
+    def reload(self):
+        """Drop the in-memory entries AND the consult memo so the next
+        read re-reads the table file — how a long-lived process picks
+        up another job's commits (the background tuner calls this once
+        per drain slot, so its tuned-elsewhere check and the trace-time
+        consults both see cross-process winners; without it ``lookup``
+        would serve the memoized miss forever)."""
+        with self._lock:
+            self._entries = None
+            self.load_error = None
+            self._memo = {}
 
     def entry(self, kernel, shape, dtype, backend):
         """The full stored record (schedule + timings), or None."""
@@ -163,6 +220,13 @@ class ScheduleTable:
             self._load_locked()
             rec = self._entries.get(make_key(kernel, shape, dtype, backend))
             return dict(rec) if rec else None
+
+    def entries(self):
+        """Snapshot of every stored record keyed by table key — the
+        cost model's training-row source (ISSUE 15)."""
+        with self._lock:
+            self._load_locked()
+            return {k: dict(v) for k, v in self._entries.items()}
 
     def record(self, kernel, shape, dtype, backend, record):
         """Commit one winner record (atomic whole-file rewrite).
@@ -172,7 +236,11 @@ class ScheduleTable:
         bench.py's tune variant) don't clobber each other's winners
         with stale process-lifetime snapshots; the remaining race is
         two commits in the same instant, which a tuning tool can live
-        with."""
+        with. Banked ``timings`` rows merge against the re-read base
+        the same way (fresh measurement of a schedule wins): a
+        topk-bounded ranked sweep or background slot GROWS the cost
+        model's training set, never shrinks another sweep's bank
+        (ISSUE 15)."""
         if not _valid_schedule(record.get("schedule")):
             raise ValueError("record.schedule must be a non-empty dict of "
                              "known integer knobs >= 1, got %r"
@@ -182,11 +250,32 @@ class ScheduleTable:
             self._entries = None
             self.load_error = None
             self._load_locked()
+            prev = self._entries.get(key)
+            if prev and prev.get("timings"):
+                if record.get("timings"):
+                    # loading validates only the top-level schedule, so
+                    # a hand-edited/foreign-build banked row can be
+                    # anything — skip what the merge key cannot digest
+                    # (corrupt-data-behaves-as-absent, like the model's
+                    # _record_rows), never break every future commit
+                    # for the key
+                    merged = {}
+                    for t in list(prev["timings"]) + list(record["timings"]):
+                        try:
+                            merged[frozenset(t["schedule"].items())] = t
+                        except (AttributeError, KeyError, TypeError):
+                            continue
+                    record = dict(record, timings=list(merged.values()))
+                else:
+                    # a winner-only commit (PR 10-era caller, the
+                    # --compare recommit) must never destroy the bank
+                    record = dict(record, timings=prev["timings"])
             self._entries[key] = dict(record, kernel=kernel,
                                       shape=[int(d) for d in shape],
                                       dtype=str(dtype), backend=backend)
             self._persist_locked()
             self._memo[key] = dict(record["schedule"])
+        clear_miss(key)   # a commit satisfies the recorded miss
         return key
 
     def __len__(self):
@@ -215,12 +304,13 @@ def get_table(path=None):
 
 
 def reset():
-    """Drop the process-global table (memo included) — tests, and
-    long-lived processes that want to pick up an externally updated
-    table file."""
+    """Drop the process-global table (memo included) and the miss
+    registry — tests, and long-lived processes that want to pick up an
+    externally updated table file."""
     global _GLOBAL
     with _GLOBAL_LOCK:
         _GLOBAL = None
+    clear_misses()
 
 
 def schedule_for(kernel, shape, dtype, backend=None):

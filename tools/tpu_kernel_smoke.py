@@ -103,6 +103,14 @@ def main():
                          "and commit winners to the on-disk schedule table")
     ap.add_argument("--tune-budget", type=int, default=None,
                     help="timed-candidate budget per kernel for --tune")
+    ap.add_argument("--ranked", dest="ranked", action="store_true",
+                    default=None,
+                    help="with --tune: force learned-cost-model ranked "
+                         "sweeps (time only the top MXNET_TUNE_TOPK "
+                         "candidates; the next tunnel session's "
+                         "BENCH_r06 population run wants this)")
+    ap.add_argument("--no-ranked", dest="ranked", action="store_false",
+                    help="with --tune: pin the exhaustive sweep")
     args = ap.parse_args()
 
     if args.cpu or args.lower:
@@ -273,6 +281,10 @@ def main():
             cmd.append("--cpu")
         if args.tune_budget is not None:
             cmd += ["--budget", str(args.tune_budget)]
+        if args.ranked is True:
+            cmd.append("--ranked")
+        elif args.ranked is False:
+            cmd.append("--no-ranked")
         print("--- schedule sweep ---", flush=True)
         rc = subprocess.call(cmd)
         if rc != 0:

@@ -40,6 +40,44 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax  # noqa: E402
 
 
+def _run_one(sweep_fn, kw, args):
+    """One sweep, honoring --compare: run the exhaustive sweep first
+    (banks every timing), refit the cost model from the table, then
+    the ranked sweep (forced — compare implies re-search), and report
+    the winner delta + both wall times side by side (ISSUE 15). The
+    better measured winner stays committed: the ranked pass's forced
+    re-commit must not leave a schedule the same run just measured to
+    be slower live in the shared table."""
+    from mxnet_tpu import tune
+
+    if not args.compare:
+        return sweep_fn(**kw)
+    exh_kw = dict(kw, ranked=False, force=True)
+    exh = sweep_fn(**exh_kw)
+    tune.fit_cost_model()   # the ranked pass learns from the exhaustive one
+    rep = sweep_fn(**dict(kw, ranked=True, force=True))
+    rep["exhaustive"] = {
+        "n_timed": exh["n_timed"], "wall_s": exh.get("wall_s"),
+        "winner_ms": exh["winner"]["ms_per_iter"],
+        "winner_schedule": exh["winner"]["schedule"],
+    }
+    if exh["winner"]["ms_per_iter"]:
+        rep["winner_delta_pct"] = round(
+            (rep["winner"]["ms_per_iter"] - exh["winner"]["ms_per_iter"])
+            / exh["winner"]["ms_per_iter"] * 100, 2)
+        if rep["winner_delta_pct"] > 0 \
+                and rep["winner"]["schedule"] != exh["winner"]["schedule"]:
+            # timings stripped: record() keeps the existing bank, so
+            # the ranked pass's fresher re-measurements are not
+            # overridden by the exhaustive pass's older rows
+            winner = {k: v for k, v in exh["winner"].items()
+                      if k != "timings"}
+            tune.get_table().record(exh["kernel"], tuple(exh["shape"]),
+                                    exh["dtype"], exh["backend"], winner)
+            rep["recommitted_exhaustive_winner"] = True
+    return rep
+
+
 def run_sweeps(args, on_tpu, strict=True):
     from mxnet_tpu import profiler, tune
 
@@ -47,7 +85,8 @@ def run_sweeps(args, on_tpu, strict=True):
     common = dict(budget=args.budget, repeats=args.repeats,
                   iters=args.iters, target_sec=args.target_sec,
                   min_iters=1000 if on_tpu else 5,
-                  interpret=interpret, force=args.force)
+                  interpret=interpret, force=args.force,
+                  ranked=args.ranked, topk=args.topk)
     kernels = args.kernels.split(",")
     unsweepable = {}
     reports = {}
@@ -55,14 +94,16 @@ def run_sweeps(args, on_tpu, strict=True):
     w_shape = (3, 3, args.ci, args.co)
     for kernel in kernels:
         if kernel in tune.FUSED_KINDS:
-            reps = [tune.sweep_fused(kernel, x_shape, w_shape,
-                                     stride=args.stride, dtype=args.dtype,
-                                     **common)]
+            reps = [_run_one(tune.sweep_fused,
+                             dict(common, kernel=kernel, x_shape=x_shape,
+                                  w_shape=w_shape, stride=args.stride,
+                                  dtype=args.dtype), args)]
         elif kernel == "flash_attention":
-            reps = [tune.sweep_flash(args.flash_batch, args.heads,
-                                     args.seq, args.seq, args.head_dim,
-                                     causal=args.causal,
-                                     dtype=args.flash_dtype, **common)]
+            reps = [_run_one(tune.sweep_flash,
+                             dict(common, b=args.flash_batch, h=args.heads,
+                                  seq_q=args.seq, seq_k=args.seq,
+                                  d=args.head_dim, causal=args.causal,
+                                  dtype=args.flash_dtype), args)]
             if args.decode:
                 # the generate-serving decode shape (ISSUE 12): one
                 # query per batch slot against the whole cached
@@ -71,10 +112,11 @@ def run_sweeps(args, on_tpu, strict=True):
                 # decode query attends to ALL cached keys
                 # (length-masked), matching the consult key in
                 # models/transformer.decode_schedule_shape
-                reps.append(tune.sweep_flash(
-                    args.decode_slots, args.heads, 1, args.seq,
-                    args.head_dim, causal=False,
-                    dtype=args.flash_dtype, **common))
+                reps.append(_run_one(
+                    tune.sweep_flash,
+                    dict(common, b=args.decode_slots, h=args.heads,
+                         seq_q=1, seq_k=args.seq, d=args.head_dim,
+                         causal=False, dtype=args.flash_dtype), args))
         elif not strict:
             # a kernel named by an IR rule (tune.rule_kernels) with no
             # sweep recipe yet: surface it in the report instead of
@@ -96,13 +138,25 @@ def run_sweeps(args, on_tpu, strict=True):
                       % (rep["key"], rep["winner"]["schedule"]))
             else:
                 w = rep["winner"]
+                rk = rep.get("ranker") or {}
+                extra = ""
+                if rk.get("mode") == "ranked":
+                    extra = "  ranked(top %d, skipped %d)" \
+                        % (rk.get("topk", 0), rep.get("n_skipped_ranked", 0))
+                elif rk.get("abstained"):
+                    extra = "  ranker abstained (%s)" % rk.get("reason", "")
+                if "winner_delta_pct" in rep:
+                    extra += "  delta_vs_exhaustive=%+.2f%%" \
+                        % rep["winner_delta_pct"]
                 print("%-50s timed %d/%d (pruned %d)  winner=%s  "
-                      "%.4f ms/iter (default %.4f, %.2fx)"
+                      "%.4f ms/iter (default %.4f, %.2fx)  %.1fs%s"
                       % (rep["key"], rep["n_timed"], rep["n_candidates"],
                          rep["n_pruned"], w["schedule"], w["ms_per_iter"],
-                         w["default_ms_per_iter"], w["speedup_vs_default"]))
+                         w["default_ms_per_iter"], w["speedup_vs_default"],
+                         rep.get("wall_s") or 0.0, extra))
     report = {"tune": reports, "backend": jax.default_backend(),
               "table": tune.default_table_path(),
+              "model": tune.default_model_path(),
               "rule_kernels": tune.rule_kernels(),
               "tuning_stats": profiler.tuning_stats()}
     if unsweepable:
@@ -145,6 +199,23 @@ def main(argv=None):
     ap.add_argument("--decode-slots", type=int, default=None,
                     help="batch dim of the decode-shape sweep (default: "
                          "MXNET_GENERATE_SLOTS's default, 8)")
+    ap.add_argument("--ranked", dest="ranked", action="store_true",
+                    default=None,
+                    help="force ranked sweeps (learned cost model picks "
+                         "the top MXNET_TUNE_TOPK candidates to time; "
+                         "abstains into exhaustive when under-trained). "
+                         "Default: the MXNET_TUNE_RANKER knob (on)")
+    ap.add_argument("--no-ranked", dest="ranked", action="store_false",
+                    help="pin the PR 10 exhaustive sweep")
+    ap.add_argument("--topk", type=int, default=None,
+                    help="ranked-mode candidates to time (default: "
+                         "MXNET_TUNE_TOPK)")
+    ap.add_argument("--compare", action="store_true",
+                    help="run the exhaustive sweep, refit the cost "
+                         "model, then the ranked sweep (implies "
+                         "re-search) and report timed/skipped counts, "
+                         "wall-times, and the ranked winner's delta vs "
+                         "the exhaustive winner per key")
     ap.add_argument("--budget", type=int, default=8,
                     help="max timed programs per kernel, default "
                          "baseline included (the rest of the legal "
