@@ -279,12 +279,19 @@ def _measure_generate():
     drain-whole-batch tokens/s, p99 time-to-first-token, and slot
     occupancy. The acceptance pair is speedup >= 2x at equal-or-better
     p99 TTFT; pages_in_use_after == 0 is the paged-allocator
-    exactness evidence riding every record."""
+    exactness evidence riding every record.
+
+    The record also carries the ISSUE 16 pair: prefix_speedup (p99
+    TTFT, sharing off / on, from --prefix-share — acceptance >= 3x at
+    exact prefill-token accounting, zero leaks, identical outputs) and
+    spec_tokens_s / spec_speedup / acceptance_rate (from --spec —
+    acceptance >= 1.5x tokens/s at byte-identical greedy outputs), so
+    the trajectory tracks both levers."""
     try:
         from tools.bench_serve import measure_generate
 
         rec = measure_generate()
-        print(json.dumps({
+        out = {
             "variant": "generate",
             "tokens_s": rec["continuous"]["tokens_s"],
             "speedup_vs_drain": rec["speedup_vs_drain"],
@@ -295,7 +302,34 @@ def _measure_generate():
             "drain_occupancy": rec["drain"]["slot_occupancy"],
             "pages_high_water": rec["continuous"]["pages_high_water"],
             "pages_in_use_after": rec["continuous"]["pages_in_use_after"],
-        }))
+        }
+        try:
+            from tools.bench_serve import measure_prefix
+
+            px = measure_prefix()
+            out.update({
+                "prefix_speedup": px["prefix_speedup"],
+                "prefix_ttft_p99_ms": px["sharing_on"]["ttft_p99_ms"],
+                "prefix_outputs_equal": px["outputs_equal"],
+                "prefix_accounting_exact":
+                    px["prefill_token_accounting_exact"],
+                "prefix_page_leaks": px["sharing_on"]["page_leaks"],
+            })
+        except Exception as e:
+            out["prefix_error"] = str(e)[:200]
+        try:
+            from tools.bench_serve import measure_spec
+
+            sp = measure_spec()
+            out.update({
+                "spec_tokens_s": sp["spec"]["tokens_s"],
+                "spec_speedup": sp["spec_speedup"],
+                "acceptance_rate": sp["acceptance_rate"],
+                "spec_outputs_equal": sp["outputs_equal"],
+            })
+        except Exception as e:
+            out["spec_error"] = str(e)[:200]
+        print(json.dumps(out))
     except Exception as e:
         print(json.dumps({"error": "generate: %s" % str(e)[:500]}))
 

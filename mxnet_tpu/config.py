@@ -429,6 +429,37 @@ KNOBS = {
         "tokens buffer per request and flush to the streaming "
         "callback every N steps (and at finish); integer >= 1 "
         "(serving/broker.py GenerateServer)"),
+    # --- shared-prefix KV cache + speculative decoding (ISSUE 16) ---
+    "MXNET_GENERATE_PREFIX_CACHE": (
+        "0", "honored",
+        "enable the shared-prefix KV cache: a radix index over full "
+        "KV pages keyed by token-id page runs — admission matches the "
+        "longest cached prefix, shares those pages copy-on-write via "
+        "per-page refcounts and prefills only the uncovered tail; off "
+        "(the default) is bit-identical to the unshared path; "
+        "0/1/true/false (serving/broker.py GenerateServer)"),
+    "MXNET_GENERATE_PREFIX_EVICT": (
+        "0", "honored",
+        "max KV pages the prefix index may pin; crossing the bound "
+        "evicts least-recently-matched entries, and pool pressure "
+        "evicts regardless (sharing never causes a PagePoolExhausted "
+        "a no-sharing run would avoid); 0 = bounded only by pool "
+        "pressure; integer >= 0 (serving/broker.py GenerateServer)"),
+    "MXNET_GENERATE_SPEC_K": (
+        "0", "honored",
+        "speculative-decoding depth: the draft model proposes k "
+        "tokens per slot per round and ONE batched verify step of the "
+        "target model accepts the longest agreeing prefix (greedy "
+        "token-for-token parity with non-speculative decode); 0 "
+        "disables; integer >= 0 (serving/broker.py GenerateServer)"),
+    "MXNET_GENERATE_DRAFT": (
+        "0", "honored",
+        "self-draft layer count for speculative decoding: the draft "
+        "model is the target's FIRST N transformer layers sharing "
+        "embed/pos/final-LN (models/transformer.py draft_from_layers); "
+        "0 means an explicit draft_config=/draft_params= must be "
+        "passed when MXNET_GENERATE_SPEC_K > 0; integer >= 0 "
+        "(serving/broker.py GenerateServer)"),
     # --- sharded embeddings (ISSUE 14) ---
     "MXNET_EMBED_SHARDS": (
         "0", "honored",

@@ -41,7 +41,8 @@ from ..parallel.ring import ring_attention_inner, full_attention
 
 __all__ = ["TransformerConfig", "init_params", "param_specs", "make_loss_fn",
            "make_train_step", "make_forward_fn", "init_kv_cache",
-           "make_prefill_fn", "make_decode_fn", "decode_schedule_shape"]
+           "make_prefill_fn", "make_decode_fn", "make_extend_fn",
+           "draft_from_layers", "decode_schedule_shape"]
 
 
 @dataclasses.dataclass
@@ -466,6 +467,53 @@ def _paged_decode_attention(q, k, v, positions, block_k):
     return out[:, :, None, :].astype(q.dtype)                # (B, H, 1, Dh)
 
 
+def _paged_extend_attention(q, k, v, positions, block_k):
+    """:func:`_paged_decode_attention` generalized to T query tokens per
+    slot (ISSUE 16). q: (B, H, T, Dh); k/v: (B, H, L, Dh) gathered from
+    the page pool; query row t of slot b sits at ``positions[b, t]`` and
+    attends key column j iff ``j <= positions[b, t]`` — the per-row
+    causal mask that makes one batched call serve both the shared-prefix
+    tail prefill (rows are consecutive prompt-tail positions attending
+    the cached prefix pages) and the speculative verify step (rows are
+    the pending token + k draft proposals). Same fp32 online softmax."""
+    B, H, L, Dh = k.shape
+    scale = 1.0 / (Dh ** 0.5)
+    nb = -(-L // block_k)
+    pad = nb * block_k - L
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    T = q.shape[2]
+    q32 = q.astype(jnp.float32) * scale                      # (B, H, T, Dh)
+    neg = jnp.float32(-1e30)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = lax.dynamic_slice_in_dim(k, j * block_k, block_k,
+                                      axis=2).astype(jnp.float32)
+        vb = lax.dynamic_slice_in_dim(v, j * block_k, block_k,
+                                      axis=2).astype(jnp.float32)
+        s = jnp.einsum("bhtd,bhkd->bhtk", q32, kb,
+                       preferred_element_type=jnp.float32)
+        cols = j * block_k + jnp.arange(block_k)
+        ok = cols[None, None, None, :] <= positions[:, None, :, None]
+        s = jnp.where(ok, s, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhtk,bhkd->bhtd", p, vb, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, H, T), neg, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    a0 = jnp.zeros((B, H, T, Dh), jnp.float32)
+    _, l, acc = lax.fori_loop(0, nb, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)                               # (B, H, T, Dh)
+
+
 def _stacked_layer_params(params):
     return {k: v for k, v in params.items()
             if k not in ("embed_weight", "pos_embed_weight",
@@ -594,3 +642,111 @@ def make_decode_fn(config, slots, max_pages_per_slot, page_size,
         return cache, logits.astype(jnp.float32)
 
     return decode
+
+
+def make_extend_fn(config, slots, steps, max_pages_per_slot, page_size,
+                   block_k=None):
+    """fn(params, cache, tokens (S, T) int32, positions (S, T) int32,
+    block_tables (S, max_pages_per_slot) int32, valid (S, T) bool) →
+    (cache', logits (S, T, V) fp32) with ``S == slots``, ``T == steps``.
+
+    The multi-token generalization of ``make_decode_fn`` (ISSUE 16):
+    each slot appends up to T tokens against its already-cached pages in
+    ONE compiled call. Token (b, t) is written at page
+    ``block_tables[b, positions[b, t] // page_size]`` offset
+    ``positions[b, t] % page_size``, then every row attends the slot's
+    gathered pages under the per-row mask ``col <= positions[b, t]`` —
+    all T writes of a layer land before that layer's gather, so row t
+    sees rows < t of its own call (in-window causality is free). Two
+    callers, same program shape:
+
+    - shared-prefix tail prefill: S = 1, rows are the uncovered prompt
+      tail at positions ``prefix_len..prompt_len-1`` — they attend the
+      SHARED prefix pages but, because every row's position lies past
+      the shared region, only ever write the request's private pages
+      (the copy-on-write guarantee, asserted in tests);
+    - speculative verify: rows are the pending token + k draft
+      proposals; logits row t is the target model's next-token
+      distribution after prefix+row t, so acceptance (argmax equality)
+      reproduces the non-speculative greedy chain token-for-token.
+
+    Invalid rows (valid == False: padded tails, slots speculating fewer
+    than k tokens) write to the scratch page and return zero logits.
+    Rows at positions past the verified prefix may leave REJECTED
+    tokens' K/V behind — safe for the same reason padded prefill tails
+    are: columns past a row's position are masked, and a later call
+    writes the position before any row attends it."""
+    c = config
+    cdt = jnp.dtype(c.dtype)
+    page_size = int(page_size)
+    max_ctx = int(max_pages_per_slot) * page_size
+    if block_k is None:
+        block_k = _decode_block_k(c, slots, max_ctx)
+
+    def extend(params, cache, tokens, positions, block_tables, valid):
+        S, T = tokens.shape
+        positions = jnp.maximum(positions, 0)
+        emb = params["embed_weight"]
+        x = jnp.take(emb, jnp.clip(tokens, 0, emb.shape[0] - 1), axis=0)
+        pos_emb = jnp.take(
+            params["pos_embed_weight"],
+            jnp.clip(positions, 0, params["pos_embed_weight"].shape[0] - 1),
+            axis=0)
+        x = (x + pos_emb).astype(cdt)                        # (S, T, d)
+
+        page_idx = jnp.clip(positions // page_size, 0, block_tables.shape[1] - 1)
+        offset = positions % page_size
+        page = jnp.take_along_axis(block_tables, page_idx, axis=1)  # (S, T)
+        # invalid rows (and any unset table entry) write to scratch
+        page = jnp.where(valid, page, 0)
+
+        def layer(x, xs):
+            lp, cl = xs
+            h = _layernorm(x, lp["ln1_gamma"], lp["ln1_beta"])
+            qkv = jnp.einsum("bsd,dthe->tbhse", h,
+                             lp["attn_qkv_weight"].astype(cdt))
+            q, k, v = qkv[0], qkv[1], qkv[2]          # (S, H, T, Dh)
+            cl = cl.at[0, page, offset].set(
+                k.transpose(0, 2, 1, 3).astype(cl.dtype))
+            cl = cl.at[1, page, offset].set(
+                v.transpose(0, 2, 1, 3).astype(cl.dtype))
+            kg = cl[0][block_tables].reshape(
+                S, max_ctx, c.n_heads, -1).transpose(0, 2, 1, 3)
+            vg = cl[1][block_tables].reshape(
+                S, max_ctx, c.n_heads, -1).transpose(0, 2, 1, 3)
+            o = _paged_extend_attention(q.astype(cdt), kg, vg, positions,
+                                        block_k)
+            o = jnp.einsum("bhse,hed->bsd", o,
+                           lp["attn_out_weight"].astype(cdt))
+            return _ffn(x + o, lp, c, frozenset(), cdt), cl
+
+        x, cache = lax.scan(layer, x, (_stacked_layer_params(params), cache))
+        x = _layernorm(x, params["final_ln_gamma"], params["final_ln_beta"])
+        logits = jnp.einsum("btd,vd->btv", x,
+                            params["embed_weight"].astype(cdt))
+        logits = jnp.where(valid[..., None], logits, 0.0)
+        return cache, logits.astype(jnp.float32)
+
+    return extend
+
+
+def draft_from_layers(config, params, n_layers):
+    """Self-draft for speculative decoding (ISSUE 16): slice the stacked
+    layer params down to the FIRST ``n_layers`` transformer blocks,
+    sharing the embedding / position / final-LN tensors with the target
+    model. Returns ``(draft_config, draft_params)`` ready for a second
+    :class:`~mxnet_tpu.serving.generate.GenerativePredictor` — no extra
+    training, no extra checkpoint, and (because ``init_params`` stacks
+    every per-layer tensor on a leading L axis) no copy of the shared
+    tensors. A one-layer draft of an L-layer target is the cheap
+    proposer whose agreement the verify step measures as
+    ``acceptance_rate``."""
+    n = int(n_layers)
+    if not 1 <= n <= config.n_layers:
+        raise ValueError(
+            "draft_from_layers: n_layers must lie in [1, %d], got %d"
+            % (config.n_layers, n))
+    shared = ("embed_weight", "pos_embed_weight",
+              "final_ln_gamma", "final_ln_beta")
+    dparams = {k: (v if k in shared else v[:n]) for k, v in params.items()}
+    return dataclasses.replace(config, n_layers=n), dparams

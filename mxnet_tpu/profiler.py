@@ -551,9 +551,19 @@ _GEN_ZERO = {
     "deadline": 0, "exhausted": 0, "errors": 0, "shed": 0,
     "slot_steps": 0, "active_slot_steps": 0, "max_queue_depth": 0,
     "busy_seconds": 0.0,   # prefill + decode compute time (floats)
+    # shared-prefix KV cache (ISSUE 16): admissions that matched a
+    # cached prefix, pages borrowed copy-on-write, prompt tokens whose
+    # prefill was skipped, and least-recently-matched evictions
+    "prefix_hits": 0, "shared_pages": 0, "prefill_tokens_saved": 0,
+    "prefix_evictions": 0,
+    # speculative decoding (ISSUE 16): draft-proposed vs verify-accepted
+    # tokens (their ratio rides generate_stats as acceptance_rate) and
+    # verify rounds run
+    "draft_proposed": 0, "draft_accepted": 0, "spec_rounds": 0,
 }
 _GEN_FLOATS = ("busy_seconds",)
-_GEN_GAUGES = ("pages_in_use", "pages_high_water", "pool_pages")
+_GEN_GAUGES = ("pages_in_use", "pages_high_water", "pool_pages",
+               "page_ref_high_water", "prefix_pages")
 _GEN = dict(_GEN_ZERO)
 _GEN_PAGES = {}
 _GEN_TTFT_CAP = 8192
@@ -609,6 +619,11 @@ def generate_stats(reset=False):
         # arrival-to-completion wall-clock variant next to it)
         snap["tokens_s"] = round(snap["tokens"] / snap["busy_seconds"], 1)
         snap["busy_seconds"] = round(snap["busy_seconds"], 4)
+    if snap["draft_proposed"]:
+        # the speculative-decoding health gauge: what fraction of draft
+        # proposals the target's verify step accepted
+        snap["acceptance_rate"] = round(
+            snap["draft_accepted"] / snap["draft_proposed"], 3)
     if ttft:
         snap["ttft_p50_ms"] = _percentile_ms(ttft, 0.50)
         snap["ttft_p99_ms"] = _percentile_ms(ttft, 0.99)

@@ -45,6 +45,7 @@ from .generate import (  # noqa: F401
     GenerativePredictor,
     PagePool,
     PagePoolExhausted,
+    PrefixIndex,
 )
 from .fleet import (  # noqa: F401
     FleetError,

@@ -1,0 +1,478 @@
+"""Shared-prefix KV cache + speculative decoding (ISSUE 16).
+
+The three acceptance invariants:
+
+- **Accounting**: per-page refcounts are exact — after every holder
+  (requests, prefix index) drops its references the pool drains to
+  ``in_use == 0`` with ``allocs == frees``, under randomized
+  admit/share/evict/finish interleavings (the torture test); double
+  unrefs and foreign ids raise typed.
+- **Copy-on-write**: a shared prefix page is NEVER written by a tail
+  prefill — the tail's positions all lie past the shared region
+  (``test_cow_shared_pages_never_written``).
+- **Parity**: prefix sharing and speculative decoding are pure
+  optimizations — greedy outputs are token-for-token identical to the
+  unshared / non-speculative path (``test_server_*_parity``); with the
+  knobs off the new code is never reached.
+
+Wall-time note (tests/README): everything that jit-compiles a
+transformer program is ``slow``-marked — the tier-1 gate sits at
+~865 s of its 870 s budget, so the default tier only gets the
+pure-Python allocator/index/knob/profiler tests (< 2 s).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import config, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import transformer as tfm
+from mxnet_tpu.serving import (
+    GenerateError,
+    GenerateServer,
+    GenerativePredictor,
+    PagePool,
+    PagePoolExhausted,
+    PrefixIndex,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=64, dtype="float32")
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, tfm.init_params(cfg, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    profiler.generate_reset()
+    yield
+    profiler.generate_reset()
+
+
+# ---------------------------------------------------------------------------
+# refcounted page pool
+# ---------------------------------------------------------------------------
+def test_refcount_share_and_release():
+    pool = PagePool(4)
+    pages = pool.alloc(2)
+    assert all(pool.refcount(p) == 1 for p in pages)
+    pool.ref(pages)                       # a second holder shares both
+    assert all(pool.refcount(p) == 2 for p in pages)
+    assert pool.in_use == 2
+    pool.unref(pages)                     # first drop: pages stay live
+    assert pool.in_use == 2 and pool.free_pages == 2
+    pool.free(pages)                      # free is the unref alias
+    assert pool.in_use == 0 and pool.free_pages == 4
+    s = pool.stats()
+    assert s["allocs"] == s["frees"] == 2
+    assert s["refs"] == 2 and s["ref_high_water"] == 2
+
+
+def test_refcount_double_unref_and_foreign_raise_typed():
+    pool = PagePool(2)
+    pages = pool.alloc(1)
+    pool.unref(pages)
+    with pytest.raises(GenerateError):
+        pool.unref(pages)                 # double drop
+    with pytest.raises(GenerateError):
+        pool.unref([99])                  # foreign id
+    with pytest.raises(GenerateError):
+        pool.ref([99])                    # cannot share a free page
+    with pytest.raises(GenerateError):
+        pool.ref(pages)                   # page already returned
+
+
+def test_refcount_unref_is_all_or_nothing():
+    pool = PagePool(3)
+    pages = pool.alloc(2)
+    with pytest.raises(GenerateError):
+        pool.unref(pages + [77])          # one foreign id poisons the call
+    assert all(pool.refcount(p) == 1 for p in pages)  # nothing was dropped
+    pool.unref(pages)
+    assert pool.in_use == 0
+
+
+def test_refcount_torture_randomized_interleavings():
+    """Randomized admit/share/index/evict/finish over a tiny pool: after
+    every holder drains, the accounting must be exactly zero."""
+    rng = np.random.default_rng(7)
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=4)
+    vocab = 16
+    live = []          # in-flight "requests": lists of held page ids
+    for _ in range(400):
+        op = rng.integers(0, 4)
+        if op == 0 and pool.free_pages >= 3:          # admit + maybe index
+            tokens = rng.integers(0, vocab, size=9).tolist()
+            matched = idx.match(tokens, pool)
+            tail = pool.alloc(3 - len(matched))
+            pages = matched + tail
+            idx.insert(tokens, pages, pool)
+            live.append(pages)
+        elif op == 1 and live:                        # finish a request
+            pool.unref(live.pop(rng.integers(0, len(live))))
+        elif op == 2:                                 # pressure eviction
+            idx.evict_lru(pool)
+        elif op == 3 and live:                        # mid-flight growth
+            if pool.free_pages:
+                live[rng.integers(0, len(live))].extend(pool.alloc(1))
+    for pages in live:
+        pool.unref(pages)
+    idx.clear(pool)
+    s = pool.stats()
+    assert s["in_use"] == 0 and s["free"] == pool.num_pages
+    assert s["allocs"] == s["frees"]
+    assert s["ref_high_water"] >= 2       # sharing actually happened
+    assert idx.pages == 0
+
+
+# ---------------------------------------------------------------------------
+# radix prefix index
+# ---------------------------------------------------------------------------
+def test_prefix_match_longest_and_tail_cap():
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=4)
+    tokens = list(range(12))              # 3 full pages
+    pages = pool.alloc(3)
+    idx.insert(tokens, pages, pool)       # index holds one ref per page
+    assert idx.pages == 3
+    assert all(pool.refcount(p) == 2 for p in pages)
+
+    # identical prompt: the cap (len-1)//page_size keeps >= 1 tail token
+    m = idx.match(tokens, pool)
+    assert m == pages[:2]                 # NOT all 3 — the final page is
+    pool.unref(m)                         # always re-prefilled privately
+
+    # longer prompt with the same prefix matches all 3 indexed pages
+    m = idx.match(tokens + [99, 98], pool)
+    assert m == pages
+    pool.unref(m)
+
+    # diverging second page matches only the first
+    m = idx.match(tokens[:4] + [33] * 8, pool)
+    assert m == pages[:1]
+    pool.unref(m)
+
+    # a short prompt (< 1 full page + 1 token) can never match
+    assert idx.match(tokens[:4], pool) == []
+    assert idx.match([], pool) == []
+
+
+def test_prefix_insert_dedupes_and_keeps_indexed_page():
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=4)
+    tokens = list(range(8))
+    first = pool.alloc(2)
+    idx.insert(tokens, first, pool)
+    dup = pool.alloc(2)                   # a second request's private copy
+    added = idx.insert(tokens, dup, pool)
+    assert added == 0                     # already indexed: no new pins
+    assert all(pool.refcount(p) == 1 for p in dup)   # dup stays private
+    m = idx.match(tokens + [1], pool)
+    assert m == first                     # the indexed copy wins
+    pool.unref(m)
+
+
+def test_prefix_evict_lru_order_and_shared_page_survival():
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=2)
+    a, b = pool.alloc(1), pool.alloc(1)
+    idx.insert([0, 1], a, pool)
+    idx.insert([2, 3], b, pool)
+    m = idx.match([0, 1, 9], pool)        # touch a: b becomes LRU
+    assert m == a
+    assert idx.evict_lru(pool)
+    assert pool.refcount(b[0]) == 1       # b's index pin dropped first
+    assert idx.match([2, 3, 9], pool) == []
+    # a is still matched by a live holder: eviction drops the index ref
+    # but the page survives until that holder unrefs
+    pool.unref(a)                         # the original allocation's ref
+    assert idx.evict_lru(pool)
+    assert pool.refcount(a[0]) == 1       # held by the match above
+    pool.unref(a)
+    pool.unref(b)
+    assert not idx.evict_lru(pool)        # empty index
+    assert pool.in_use == 0
+
+
+def test_prefix_index_max_pages_bound():
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=2, max_pages=2)
+    pages = pool.alloc(4)
+    idx.insert([0, 1, 2, 3], pages[:2], pool)
+    idx.insert([4, 5, 6, 7], pages[2:], pool)
+    assert idx.pages <= 2                 # the bound evicted LRU entries
+    assert idx.stats()["evictions"] >= 2
+    idx.clear(pool)
+    pool.unref(pages)
+    assert pool.in_use == 0
+
+
+def test_prefix_eviction_deepest_leaf_first():
+    pool = PagePool(8)
+    idx = PrefixIndex(page_size=2)
+    pages = pool.alloc(3)
+    idx.insert([0, 1, 2, 3, 4, 5], pages, pool)   # one 3-node chain
+    assert idx.evict_lru(pool)
+    # the leaf (third page) went first: the 2-page prefix still matches
+    m = idx.match([0, 1, 2, 3, 9], pool)
+    assert m == pages[:2]
+    pool.unref(m)
+    idx.clear(pool)
+    pool.unref(pages)
+    assert pool.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# knobs + profiler counters
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("knob,bad", [
+    ("MXNET_GENERATE_PREFIX_CACHE", "maybe"),
+    ("MXNET_GENERATE_PREFIX_EVICT", "-3"),
+    ("MXNET_GENERATE_SPEC_K", "2.5"),
+    ("MXNET_GENERATE_DRAFT", "one"),
+])
+def test_malformed_knob_raises_naming_knob(monkeypatch, knob, bad):
+    monkeypatch.setenv(knob, bad)
+    with pytest.raises(GenerateError) as ei:
+        GenerateServer(config=object(), params={})   # parse dies first
+    assert knob in str(ei.value)
+
+
+def test_new_knobs_registered_with_defaults_off():
+    for knob in ("MXNET_GENERATE_PREFIX_CACHE", "MXNET_GENERATE_PREFIX_EVICT",
+                 "MXNET_GENERATE_SPEC_K", "MXNET_GENERATE_DRAFT"):
+        assert knob in config.KNOBS
+        assert config.KNOBS[knob][0] == "0"          # off by default
+    assert config.get_strict_bool("MXNET_GENERATE_PREFIX_CACHE") is False
+    assert config.get_nonneg_int("MXNET_GENERATE_SPEC_K") == 0
+
+
+def test_spec_without_draft_raises(model):
+    cfg, params = model
+    with pytest.raises(GenerateError) as ei:
+        GenerateServer(config=cfg, params=params, slots=2, page_size=8,
+                       spec_k=2)                     # no draft source
+    assert "MXNET_GENERATE_DRAFT" in str(ei.value)
+
+
+def test_profiler_prefix_spec_counters_and_acceptance_rate():
+    profiler.generate_record(prefix_hits=2, shared_pages=5,
+                             prefill_tokens_saved=80, prefix_evictions=1,
+                             draft_proposed=10, draft_accepted=7,
+                             spec_rounds=4, page_ref_high_water=3,
+                             prefix_pages=6)
+    st = profiler.generate_stats()
+    assert st["prefix_hits"] == 2 and st["shared_pages"] == 5
+    assert st["prefill_tokens_saved"] == 80 and st["prefix_evictions"] == 1
+    assert st["acceptance_rate"] == 0.7
+    assert st["page_ref_high_water"] == 3 and st["prefix_pages"] == 6
+    with pytest.raises(ValueError):
+        profiler.generate_record(prefix_hitz=1)
+
+
+def test_generate_stats_ride_dump_profile(monkeypatch, tmp_path):
+    import json
+
+    profiler.generate_record(prefix_hits=1, draft_proposed=4,
+                             draft_accepted=4)
+    out = tmp_path / "profile.json"
+    monkeypatch.setitem(profiler._STATE, "filename", str(out))
+    profiler.dump_profile()
+    dumped = json.loads(out.read_text())
+    gs = dumped["generateStats"]
+    assert gs["prefix_hits"] == 1 and gs["acceptance_rate"] == 1.0
+
+
+def test_draft_from_layers_slices_and_shares():
+    cfg = _cfg(n_layers=2)
+    params = tfm.init_params(cfg, seed=1)
+    dcfg, dparams = tfm.draft_from_layers(cfg, params, 1)
+    assert dcfg.n_layers == 1
+    assert dparams["embed_weight"] is params["embed_weight"]  # shared
+    assert dparams["attn_qkv_weight"].shape[0] == 1           # sliced
+    assert dparams["ffn_up_weight"].shape[0] == 1
+    with pytest.raises(ValueError):
+        tfm.draft_from_layers(cfg, params, 0)
+    with pytest.raises(ValueError):
+        tfm.draft_from_layers(cfg, params, 3)
+
+
+# ---------------------------------------------------------------------------
+# compiled-path invariants (slow tier: these jit transformer programs)
+# ---------------------------------------------------------------------------
+def _greedy_outputs(srv, prompts, max_new=8):
+    return [srv.generate(p, max_new_tokens=max_new)["tokens"]
+            for p in prompts]
+
+
+def _shared_prompts(seed=0, n=4, prefix_pages=2, page=8, tail=5, vocab=64):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(1, vocab, size=prefix_pages * page).tolist()
+    return [prefix + rng.integers(1, vocab, size=tail).tolist()
+            for _ in range(n)] + [rng.integers(1, vocab, size=7).tolist()]
+
+
+@pytest.mark.slow
+def test_extend_matches_forward(model):
+    """The multi-token extend program reproduces the one-shot forward
+    at every valid row (it is the verify step's numerical contract)."""
+    cfg, params = model
+    pred = GenerativePredictor(cfg, params, slots=2, page_size=8)
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(1, cfg.vocab, size=13)
+    pages = pred.pool.alloc(pred.pages_needed(13))
+    bt = np.zeros((1, pred.max_pages_per_slot), np.int32)
+    bt[0, :len(pages)] = pages
+    tok = np.zeros((1, 16), np.int32)
+    tok[0, :13] = tokens
+    pos = np.arange(16, dtype=np.int32)[None]
+    valid = np.zeros((1, 16), bool)
+    valid[0, :13] = True
+    got = pred.extend(tok, pos, bt, valid)            # (1, 16, V)
+    ref = np.asarray(tfm.make_forward_fn(cfg)(params, tokens[None]))
+    np.testing.assert_allclose(got[0, :13], ref[0], atol=5e-4, rtol=1e-3)
+    assert np.all(got[0, 13:] == 0)                   # invalid rows zeroed
+
+
+@pytest.mark.slow
+def test_cow_shared_pages_never_written(model):
+    """A tail prefill over a shared prefix leaves the shared pages'
+    K/V bytes bit-identical (the copy-on-write guarantee)."""
+    cfg, params = model
+    pred = GenerativePredictor(cfg, params, slots=2, page_size=8)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, cfg.vocab, size=21)      # 2 full pages + 5
+    pages = pred.pool.alloc(3)
+    pred.prefill(prompt, pages)
+    shared, private = pages[:2], pages[2:]
+    before = np.asarray(pred._kv)[:, :, shared].copy()
+
+    # a second request shares the 2 full pages, prefills only its tail
+    tail = rng.integers(1, cfg.vocab, size=6)
+    pred.pool.ref(shared)
+    priv2 = pred.pool.alloc(1)
+    logits = pred.extend_tail(tail, 16, shared + priv2)
+    assert logits.shape == (cfg.vocab,)
+    after = np.asarray(pred._kv)[:, :, shared]
+    np.testing.assert_array_equal(before, after)      # COW held
+    assert np.any(np.asarray(pred._kv)[:, :, priv2] != 0)  # tail landed
+    # and the tail prefill agrees with a from-scratch full prefill
+    prompt2 = np.concatenate([prompt[:16], tail])
+    full_pages = pred.pool.alloc(pred.pages_needed(len(prompt2)))
+    ref = pred.prefill(prompt2, full_pages)
+    np.testing.assert_allclose(logits, ref, atol=5e-4, rtol=1e-3)
+
+
+@pytest.mark.slow
+def test_extend_tail_rejects_unaligned_or_oversized(model):
+    cfg, params = model
+    pred = GenerativePredictor(cfg, params, slots=2, page_size=8)
+    with pytest.raises(GenerateError):
+        pred.extend_tail([1, 2], 3, [1])              # not page-aligned
+    with pytest.raises(GenerateError):
+        pred.extend_tail([1] * 60, 8, [1])            # past max_ctx
+    with pytest.raises(GenerateError):
+        pred.extend_tail([], 8, [1])                  # empty tail
+
+
+@pytest.mark.slow
+def test_server_prefix_parity_and_token_accounting(model):
+    cfg, params = model
+    prompts = _shared_prompts()
+    base = stats_off = None
+    for on in (False, True):
+        profiler.generate_reset()
+        srv = GenerateServer(config=cfg, params=params, slots=2,
+                             page_size=8, max_steps=8, prefix_cache=on)
+        outs = _greedy_outputs(srv, prompts)
+        st = srv.stats()
+        if not on:
+            base, stats_off = outs, st
+            assert "prefix_hits" not in st or st["prefix_hits"] == 0
+            srv.close()
+            continue
+        assert outs == base                           # greedy parity
+        assert st["prefix_hits"] >= 3                 # sharers hit
+        assert st["prefill_tokens_saved"] > 0
+        # the saved tokens are exactly the tokens the off-run prefilled
+        assert st["prefill_tokens"] + st["prefill_tokens_saved"] \
+            == stats_off["prefill_tokens"]
+        # pool drains to exactly the index's pins; clearing them → 0
+        assert srv.predictor.pool.in_use == srv.prefix.pages
+        srv.clear_prefix()
+        assert srv.predictor.pool.in_use == 0
+        s = srv.predictor.pool.stats()
+        assert s["allocs"] == s["frees"]
+        srv.close()
+
+
+@pytest.mark.slow
+def test_server_spec_parity_and_acceptance(model):
+    cfg, params = model
+    prompts = _shared_prompts(seed=11)
+    profiler.generate_reset()
+    with GenerateServer(config=cfg, params=params, slots=2, page_size=8,
+                        max_steps=8) as srv:
+        base = _greedy_outputs(srv, prompts)
+    profiler.generate_reset()
+    with GenerateServer(config=cfg, params=params, slots=2, page_size=8,
+                        max_steps=8, spec_k=3, draft=1) as srv:
+        outs = _greedy_outputs(srv, prompts)
+        st = srv.stats()
+        assert srv.predictor.pool.in_use == 0
+        assert srv.draft_predictor.pool.in_use == 0
+    assert outs == base                               # token-for-token
+    assert st["spec_rounds"] > 0 and st["draft_proposed"] > 0
+    assert 0.0 <= st["acceptance_rate"] <= 1.0
+
+
+@pytest.mark.slow
+def test_server_prefix_plus_spec_combined_parity(model):
+    cfg, params = model
+    prompts = _shared_prompts(seed=13)
+    profiler.generate_reset()
+    with GenerateServer(config=cfg, params=params, slots=2, page_size=8,
+                        max_steps=8) as srv:
+        base = _greedy_outputs(srv, prompts)
+    profiler.generate_reset()
+    with GenerateServer(config=cfg, params=params, slots=2, page_size=8,
+                        max_steps=8, prefix_cache=True, spec_k=2,
+                        draft=1) as srv:
+        outs = _greedy_outputs(srv, prompts)
+        st = srv.stats()
+        srv.clear_prefix()
+        assert srv.predictor.pool.in_use == 0
+    assert outs == base
+    assert st["prefix_hits"] > 0 and st["draft_proposed"] > 0
+
+
+@pytest.mark.slow
+def test_prefix_eviction_under_pool_pressure(model):
+    """With a pool sized so the index's pins would otherwise starve
+    admissions, LRU eviction must keep every request admissible —
+    sharing never causes an exhaustion the unshared path would avoid."""
+    cfg, params = model
+    pred = GenerativePredictor(cfg, params, slots=1, page_size=8,
+                               max_ctx=64)           # pool = 8 pages
+    srv = GenerateServer(predictor=pred, max_steps=4, prefix_cache=True)
+    rng = np.random.default_rng(17)
+    # distinct 3-page prompts: each run indexes 3 pages, so the 8-page
+    # pool hits pressure and must evict earlier entries
+    for i in range(5):
+        prompt = rng.integers(1, cfg.vocab, size=26).tolist()
+        out = srv.generate(prompt, max_new_tokens=4)
+        assert len(out["tokens"]) >= 1
+    st = srv.stats()
+    assert st["prefix_evictions"] > 0
+    assert st.get("exhausted", 0) == 0                # nobody starved
+    srv.clear_prefix()
+    assert pred.pool.in_use == 0
+    srv.close()

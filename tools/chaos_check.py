@@ -51,12 +51,20 @@ Generative-serving kind (ISSUE 12; in-process GenerateServer):
   (pool drains to zero), and the requests behind it must still finish
   by EOS.
 
+Shared-prefix variant (ISSUE 16; ``--prefix``, same spec grammar): the
+stall fires while every request borrows the SAME prefix pages
+copy-on-write from the radix index. Reclaiming the wedged request must
+free only its PRIVATE pages, the surviving borrowers' outputs must be
+bit-identical to a no-fault run, and the pool must drain to exactly
+the index's pins — then to zero after ``clear_prefix``.
+
 Usage:
     python tools/chaos_check.py                      # worker crash
     python tools/chaos_check.py --spec 'server:0:crash@step=130'
     python tools/chaos_check.py --spec 'worker:0:nan@step=16'
     python tools/chaos_check.py --spec 'worker:1:preempt@step=16'
     python tools/chaos_check.py --spec 'replica:1:crash@req=10'
+    python tools/chaos_check.py --spec 'generate:stall@req=2' --prefix
     python tools/chaos_check.py --matrix             # all of the above
 """
 import argparse
@@ -93,6 +101,17 @@ SERVE_MATRIX = [
 #: GenerateServer — the request that never emits EOS must be finished
 #: by the max-decode-steps cap and its slot + KV pages reclaimed
 GENERATE_MATRIX = [
+    "generate:stall@req=2",
+]
+
+#: shared-prefix fault kind (ISSUE 16): the same wedged-request fault,
+#: but with the radix prefix cache ON and every request borrowing the
+#: SAME two prefix pages copy-on-write when the stall fires. Reclaiming
+#: the capped request must free only its PRIVATE pages (the shared ones
+#: stay pinned by the index + the surviving borrowers), the survivors'
+#: outputs must be bit-identical to a no-fault run, and the pool must
+#: drain to exactly the index's pins — then to zero after clear_prefix.
+GENERATE_PREFIX_MATRIX = [
     "generate:stall@req=2",
 ]
 
@@ -191,6 +210,118 @@ def run_generate_case(args, spec):
         return 1
     print("chaos_check[generate]: OK — cap finished the wedged request, "
           "slot + pages reclaimed, healthy requests unharmed")
+    return 0
+
+
+def run_generate_prefix_case(args, spec):
+    """One shared-prefix fault case (ISSUE 16), fully in-process: a
+    GenerateServer with the radix prefix cache ON, four requests that
+    all borrow the same two prefix pages copy-on-write, and the
+    ``generate:stall@req=N`` fault wedging one of them mid-flight.
+    Passes only when the cap finished the wedged request, reclaiming it
+    freed only its PRIVATE pages (after the drain the pool holds
+    exactly the index's pinned pages; zero after ``clear_prefix``), and
+    the surviving requests' outputs are bit-identical to a no-fault
+    run — shared-page reclaim that corrupted a borrower would show up
+    right there."""
+    import numpy as np
+
+    from mxnet_tpu import chaos, profiler
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.serving import GenerateServer
+
+    max_steps = 8
+    failures = []
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_len=64,
+                                dtype="float32")
+    params = tfm.init_params(cfg, seed=0)
+    # 16-token prefix = two full pages at page_size 8; the 4-token tail
+    # keeps the final page partial, so every admission re-prefills it
+    # privately (the structural copy-on-write rule)
+    prompt = np.asarray(list(range(1, 17)) + [20, 21, 22, 23], np.int32)
+
+    def run(fault):
+        if fault:
+            os.environ["MXNET_FAULT_SPEC"] = spec
+        chaos.reset_engine()
+        profiler.generate_reset()
+        try:
+            with GenerateServer(cfg, params, slots=2, page_size=8,
+                                max_steps=max_steps,
+                                prefix_cache=True) as srv:
+                # probe: fixes the EOS id (greedy first token — healthy
+                # requests finish after exactly one token) and seeds the
+                # prefix into the index, so all 4 measured requests hit
+                eos = srv.generate(prompt)["tokens"][0]
+                chaos.reset_engine()  # the probe must not count
+                futs = [srv.submit(prompt, eos_id=eos) for _ in range(4)]
+                results = [f.result(timeout=120) for f in futs]
+                stats = profiler.generate_stats()
+                pool_live = srv.predictor.pool.stats()
+                pinned = srv.prefix_stats()["pages"]
+                srv.clear_prefix()
+                pool_clear = srv.predictor.pool.stats()
+            engine = chaos.engine()
+            fired = bool(engine and any(r.fired for r in engine.rules))
+            return results, stats, pool_live, pinned, pool_clear, fired
+        finally:
+            if fault:
+                os.environ.pop("MXNET_FAULT_SPEC", None)
+                chaos.reset_engine()
+
+    print("chaos_check[generate-prefix]: in-process GenerateServer, "
+          "prefix cache ON (MXNET_FAULT_SPEC=%s, max_steps=%d)"
+          % (spec, max_steps), flush=True)
+    try:
+        ref_results, _rs, _rp, _rpin, _rc, _rf = run(fault=False)
+        results, stats, pool_live, pinned, pool_clear, fired = \
+            run(fault=True)
+
+        reasons = [r["finish_reason"] for r in results]
+        stalled = [i for i, r in enumerate(results)
+                   if r["finish_reason"] == "length"]
+        if stalled != [1]:
+            failures.append("expected exactly request 2 (index 1) to be "
+                            "capped, got reasons %s" % (reasons,))
+        elif len(results[1]["tokens"]) != max_steps:
+            failures.append("capped request generated %d tokens, cap is "
+                            "%d" % (len(results[1]["tokens"]), max_steps))
+        for i in (0, 2, 3):
+            if results[i]["tokens"] != ref_results[i]["tokens"]:
+                failures.append(
+                    "survivor %d's output changed under the fault "
+                    "(%r vs %r): reclaiming the wedged request touched "
+                    "a shared page" % (i, results[i]["tokens"],
+                                       ref_results[i]["tokens"]))
+        if stats.get("prefix_hits") != 4:
+            failures.append("expected all 4 requests to hit the seeded "
+                            "prefix, prefix_hits=%r"
+                            % stats.get("prefix_hits"))
+        if stats.get("shared_pages") != 8:
+            failures.append("expected 4 requests x 2 borrowed pages, "
+                            "shared_pages=%r" % stats.get("shared_pages"))
+        if pinned != 2 or pool_live["in_use"] != pinned:
+            failures.append(
+                "after the drain the pool must hold exactly the "
+                "index's 2 pinned prefix pages: pinned=%r in_use=%r "
+                "(wedged request's private pages leaked?)"
+                % (pinned, pool_live["in_use"]))
+        if pool_clear["in_use"] != 0 \
+                or pool_clear["allocs"] != pool_clear["frees"]:
+            failures.append("pool did not drain to zero after "
+                            "clear_prefix: %r" % (pool_clear,))
+        if not fired:
+            failures.append("fault spec never fired")
+    except Exception as e:
+        failures.append("driver failed: %s: %s" % (type(e).__name__, e))
+    if failures:
+        print("chaos_check[generate-prefix]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[generate-prefix]: OK — wedged borrower capped, "
+          "only its private pages reclaimed, survivors bit-identical, "
+          "pool drained to the index pins then zero")
     return 0
 
 
@@ -506,13 +637,20 @@ def main():
     ap.add_argument("--matrix", action="store_true",
                     help="run the full fault matrix (crash, nan, "
                          "preempt, the serving-fleet replica "
-                         "crash/stall and router drop kinds, and the "
-                         "sharded-embedding server-crash case) "
-                         "instead of a single --spec")
+                         "crash/stall and router drop kinds, the "
+                         "generate stall with and without the shared-"
+                         "prefix cache, and the sharded-embedding "
+                         "server-crash case) instead of a single "
+                         "--spec")
     ap.add_argument("--embed", action="store_true",
                     help="run --spec against the sharded-embedding "
                          "recommender job (2 workers / 2 value "
                          "servers) instead of the dense trainer")
+    ap.add_argument("--prefix", action="store_true",
+                    help="run --spec against a GenerateServer with the "
+                         "shared-prefix KV cache ON (ISSUE 16): the "
+                         "wedged borrower's reclaim must free only its "
+                         "private pages, survivors bit-identical")
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--max-restarts", type=int, default=1)
@@ -521,15 +659,20 @@ def main():
     args = ap.parse_args()
 
     if args.matrix:
-        specs = [(s, False) for s in MATRIX + SERVE_MATRIX
+        specs = [(s, None) for s in MATRIX + SERVE_MATRIX
                  + GENERATE_MATRIX]
-        specs += [(s, True) for s in EMBED_MATRIX]
+        specs += [(s, "prefix") for s in GENERATE_PREFIX_MATRIX]
+        specs += [(s, "embed") for s in EMBED_MATRIX]
     else:
-        specs = [(args.spec, args.embed)]
+        mode = "embed" if args.embed \
+            else ("prefix" if args.prefix else None)
+        specs = [(args.spec, mode)]
     rc = 0
-    for spec, embed in specs:
-        if embed:
+    for spec, mode in specs:
+        if mode == "embed":
             rc |= run_embed_case(args, spec)
+        elif mode == "prefix":
+            rc |= run_generate_prefix_case(args, spec)
         elif _is_generate_spec(spec):
             rc |= run_generate_case(args, spec)
         elif _is_serve_spec(spec):
