@@ -80,6 +80,8 @@ def _measure(variant):
         return _measure_embed()
     if variant == "tune":
         return _measure_tune()
+    if variant == "data":
+        return _measure_data()
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
@@ -161,17 +163,46 @@ def _measure(variant):
     print(json.dumps({"error": "%s: all batch sizes OOM" % variant}))
 
 
-def _measure_fit(n_dev):
-    """End-to-end variant (ISSUE 5): host-fed Module.fit() on synthetic
-    NDArrayIter data through the async input pipeline + device-resident
-    metrics. Unlike the device-resident variants this number includes
-    every per-batch host cost of the real training loop — the trajectory
-    now tracks it so feed-path regressions are visible."""
-    import jax
+def _write_fit_shards(root, n):
+    """Synthetic labeled uint8 image records on disk (ISSUE 17): the
+    fit variant now reads real record shards through the sharded data
+    service instead of in-memory NDArrayIter arrays."""
+    import struct
+
     import numpy as np
+
+    from mxnet_tpu.data import write_record_shards
+
+    rng = np.random.RandomState(0)
+    px = 3 * 224 * 224
+    records = [
+        struct.pack("<f", float(rng.randint(0, 1000)))
+        + rng.randint(0, 256, px, dtype=np.uint8).tobytes()
+        for _ in range(n)
+    ]
+    return write_record_shards(root, "fitimgs", records)
+
+
+def _measure_fit(n_dev):
+    """End-to-end variant (ISSUE 5 + 17): host-fed Module.fit() reading
+    on-disk record shards through the sharded data service
+    (ShardedRecordStream -> ShardedBatchIter -> DeviceQueueIter) with
+    background decode + prefetch, device-resident metrics. Unlike the
+    device-resident variants this number includes every per-batch host
+    cost of the real training loop — input regressions (feed OR data
+    plane) are visible in the trajectory."""
+    import shutil
+    import tempfile
+    from functools import partial
+
+    import jax
 
     import mxnet_tpu as mx
     from mxnet_tpu import profiler
+    from mxnet_tpu.data.lease import LocalLeaseAuthority
+    from mxnet_tpu.data.service import (ShardedBatchIter,
+                                        ShardedRecordStream,
+                                        decode_image_f32)
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.feed import DeviceQueueIter
 
@@ -181,16 +212,22 @@ def _measure_fit(n_dev):
                            else "tpu", i) for i in range(n_dev)]
     for per_dev_batch in (128, 64, 32):
         batch = per_dev_batch * n_dev
-        n = batch * 6  # 6 batches/epoch keeps host RAM bounded (~450MB)
+        n = batch * 6  # 6 batches/epoch keeps host/disk cost bounded
+        root = tempfile.mkdtemp(prefix="bench-fit-")
+        stream = None
         try:
-            rng = np.random.RandomState(0)
-            X = rng.randn(n, 3, 224, 224).astype(np.float32)
-            y = rng.randint(0, 1000, (n,)).astype(np.float32)
+            mpath = _write_fit_shards(root, n)
             mod = mx.mod.Module(sym, context=contexts)
             times = []
             profiler.pipeline_reset()
-            with DeviceQueueIter(mx.io.NDArrayIter(X, y, batch_size=batch),
-                                 module=mod) as feed:
+            profiler.io_reset()
+            stream = ShardedRecordStream(
+                mpath, lease_client=LocalLeaseAuthority(ttl=600.0),
+                rank=0,
+                decode=partial(decode_image_f32, shape=(3, 224, 224)),
+                workers=2, prefetch=4, chunk=batch)
+            data_iter = ShardedBatchIter(stream, batch, (3, 224, 224))
+            with DeviceQueueIter(data_iter, module=mod) as feed:
                 mod.fit(feed, num_epoch=4, kvstore="tpu", optimizer="sgd",
                         optimizer_params={"learning_rate": 0.1,
                                           "momentum": 0.9},
@@ -203,12 +240,18 @@ def _measure_fit(n_dev):
             # epoch 0 pays compile; average the remaining epochs
             img_s = n * (len(times) - 1) / (times[-1] - times[0])
             stats = profiler.pipeline_stats()
+            io = profiler.io_stats()
             print(json.dumps({"img_s": round(img_s, 2), "variant": "fit",
                               "batch": per_dev_batch,
                               "host_syncs": stats.get("host_syncs", 0),
                               "avg_put_ms": stats.get("avg_put_ms"),
                               "avg_stall_feed_ms":
-                                  stats.get("avg_stall_feed_ms")}))
+                                  stats.get("avg_stall_feed_ms"),
+                              "io_records": io.get("records", 0),
+                              "io_wait_s":
+                                  round(io.get("wait_seconds", 0.0), 3),
+                              "io_wait_p99_ms":
+                                  io.get("input_wait_p99_ms")}))
             return
         except Exception as e:
             msg = str(e)
@@ -216,6 +259,10 @@ def _measure_fit(n_dev):
                 continue
             print(json.dumps({"error": "fit: %s" % msg[:500]}))
             return
+        finally:
+            if stream is not None:
+                stream.close()
+            shutil.rmtree(root, ignore_errors=True)
     print(json.dumps({"error": "fit: all batch sizes OOM"}))
 
 
@@ -468,6 +515,23 @@ def _measure_tune():
         print(json.dumps({"error": "tune: %s" % str(e)[:300]}))
 
 
+def _measure_data(records=2048):
+    """Sharded-data-service variant (ISSUE 17): sync vs prefetched
+    input-wait fraction and records/s through ShardedBatchIter over
+    on-disk record shards (tools/bench_data.py), with the
+    deterministic-replay check asserted in the same run — byte-equal
+    decode across a mid-epoch lease handoff. Tracks the input pipeline
+    itself so host-side data regressions show in the trajectory."""
+    try:
+        from tools.bench_data import measure
+
+        rec = measure(records=records)
+        rec["variant"] = "data"
+        print(json.dumps(rec))
+    except Exception as e:
+        print(json.dumps({"error": "data: %s" % str(e)[:500]}))
+
+
 def _report(results, kernels=None):
     imgs = {k: v for k, v in results.items() if "img_s" in v}
     if imgs:
@@ -504,6 +568,9 @@ def _report(results, kernels=None):
     if "tune" in results:
         rec["tune"] = {k: v for k, v in results["tune"].items()
                        if k != "variant"}
+    if "data" in results:
+        rec["data"] = {k: v for k, v in results["data"].items()
+                       if k not in ("variant", "metric", "value", "unit")}
     if "zero" in results and "opt_bytes_per_dev" in results["zero"]:
         rec["zero_mem"] = {
             k: results["zero"][k]
@@ -563,9 +630,9 @@ def main():
     # if it kills this process mid-attempt the round still lands a
     # number.
     for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "quant", "embed", "tune",
+                    "generate", "quant", "embed", "tune", "data",
                     "unfused", "fused", "fit", "zero", "serve", "fleet",
-                    "generate", "quant", "embed", "tune"):
+                    "generate", "quant", "embed", "tune", "data"):
         if variant in results:
             continue
         if time.time() > deadline - 60:
@@ -589,10 +656,11 @@ def main():
                     continue  # stray brace-looking log line
                 if "img_s" in parsed or "req_s" in parsed \
                         or "rows_s" in parsed or "tuned" in parsed \
-                        or "error" in parsed:
+                        or "records_s" in parsed or "error" in parsed:
                     line = parsed
             if line and ("img_s" in line or "req_s" in line
-                         or "rows_s" in line or "tuned" in line):
+                         or "rows_s" in line or "tuned" in line
+                         or "records_s" in line):
                 results[variant] = line
                 _report(results)
             else:

@@ -1,12 +1,16 @@
 """Distributed matrix-factorization recommender over sharded
-embedding tables (ISSUE 14).
+embedding tables (ISSUE 14) fed by the sharded dataset service
+(ISSUE 17).
 
 The recommendation workload the ResNet/transformer suite never
 exercises: user/item embedding tables row-sharded across the
 dist_async KVStoreServers (``mxnet_tpu.embedding``), pulled by
 deduplicated id batches and updated by async row-scatter pushes —
 per-server memory stays ~1/num_servers no matter how large the
-vocabulary grows. Launch:
+vocabulary grows. Interactions live in on-disk record shards read
+through ``mxnet_tpu.data``: workers lease shards from the tracker
+(exactly-once per epoch), and a SIGKILLed worker's respawn resumes
+its shards at the committed cursor. Launch:
 
     # 2 workers, 2 value servers, tracker rendezvous:
     python tools/launch.py -n 2 -s 2 \\
@@ -18,25 +22,35 @@ vocabulary grows. Launch:
         python examples/recommender/train.py
 
 Synthetic ratings come from a hidden low-rank model; training factors
-them back out. Each worker consumes its own interaction shard
-(dist_async semantics: pushes apply on arrival, pulls return the
-freshest rows)."""
+them back out. Every worker writes the identical record dataset
+(fixed seeds, tmp+rename: the write race is benign) and the lease
+book decides who consumes what."""
 import argparse
 import os
+import struct
+import tempfile
 
 import numpy as np
 
 import mxnet as mx
 from mxnet import autograd, nd
+from mxnet_tpu import chaos
+from mxnet_tpu.data import write_record_shards, manifest_path
+from mxnet_tpu.data.service import (ShardedRecordStream,
+                                    iter_manifest_records)
 from mxnet_tpu.embedding import (SparseEmbedding,
                                  elastic_table_checkpoint)
 
+_REC = struct.Struct("<qqf")   # (user, item, rating) per record
+DATASET = "interactions"
 
-def synth_interactions(n, num_users, num_items, rank_k, seed):
+
+def synth_interactions(n, num_users, num_items, rank_k):
     """(user, item, rating) triples from a hidden low-rank model,
     zipfian-skewed over users/items (the head-heavy traffic the dedup
-    pull exists for)."""
-    rng = np.random.RandomState(seed)
+    pull exists for). Seeds are fixed — NOT per-worker — so every
+    worker materializes the identical shared dataset."""
+    rng = np.random.RandomState(9)
     gt_u = np.random.RandomState(7).randn(num_users, rank_k) * 0.8
     gt_v = np.random.RandomState(8).randn(num_items, rank_k) * 0.8
     users = np.minimum(rng.zipf(1.3, n) - 1, num_users - 1)
@@ -45,6 +59,46 @@ def synth_interactions(n, num_users, num_items, rank_k, seed):
     ratings += rng.randn(n).astype(np.float64) * 0.05
     return (users.astype(np.int64), items.astype(np.int64),
             ratings.astype(np.float32))
+
+
+def decode_interaction(raw, seed):
+    """Record bytes -> (user, item, rating)."""
+    return _REC.unpack(raw)
+
+
+def default_data_dir(args):
+    return os.path.join(
+        tempfile.gettempdir(),
+        "mxnet_tpu_recsys_%d_%d_%d"
+        % (args.users, args.items, args.num_samples))
+
+
+def ensure_dataset(args, data_dir):
+    """Write the shared interaction record shards if absent. Identical
+    bytes from every writer (fixed seeds) + tmp+rename publication, so
+    concurrent workers race benignly."""
+    mpath = manifest_path(data_dir, DATASET)
+    if os.path.isfile(mpath):
+        return mpath
+    users, items, ratings = synth_interactions(
+        args.num_samples, args.users, args.items, rank_k=args.dim)
+    records = [_REC.pack(int(u), int(i), float(r))
+               for u, i, r in zip(users, items, ratings)]
+    return write_record_shards(data_dir, DATASET, records)
+
+
+def load_full(mpath):
+    """Full-dataset arrays via the lease-free direct read (eval: every
+    worker intentionally scores everything)."""
+    users, items, ratings = [], [], []
+    for _shard, _idx, raw in iter_manifest_records(mpath):
+        u, i, r = _REC.unpack(raw)
+        users.append(u)
+        items.append(i)
+        ratings.append(r)
+    return (np.asarray(users, dtype=np.int64),
+            np.asarray(items, dtype=np.int64),
+            np.asarray(ratings, dtype=np.float32))
 
 
 def evaluate(emb_user, emb_item, users, items, ratings, batch):
@@ -60,6 +114,23 @@ def evaluate(emb_user, emb_item, users, items, ratings, batch):
     return se / max(n, 1)
 
 
+def train_batch(emb_user, emb_item, u, it, r):
+    r = nd.array(np.asarray(r, dtype=np.float32))
+    with autograd.record():
+        pred = (emb_user(nd.array(np.asarray(u, dtype=np.int64)))
+                * emb_item(nd.array(np.asarray(it, dtype=np.int64)))) \
+            .sum(axis=1)
+        diff = pred - r
+        loss = (diff * diff).mean()
+    loss.backward()
+    # async scatter pushes; the next batch's pulls wait only on
+    # their own rows' frames (priority: user rows first, the
+    # larger table)
+    emb_user.step(priority=1)
+    emb_item.step(priority=0)
+    return float(loss.asnumpy())
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--users", type=int, default=2000)
@@ -69,11 +140,27 @@ def main():
     p.add_argument("--num-epochs", type=int, default=4)
     p.add_argument("--num-samples", type=int, default=8000)
     p.add_argument("--lr", type=float, default=0.08)
+    p.add_argument("--data-dir", default=None,
+                   help="record-shard dataset dir (default: a "
+                        "parameter-keyed dir under the system tmpdir; "
+                        "written on first use)")
+    p.add_argument("--ledger-dir", default=None,
+                   help="per-record consumption ledger dir (the "
+                        "exactly-once evidence; off when unset)")
+    p.add_argument("--write-data-only", action="store_true",
+                   help="materialize the record shards and exit "
+                        "(no kvstore topology needed)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="coordinated checkpoint dir (default: "
                         "MXNET_CHECKPOINT_DIR from the launcher; off "
                         "when neither is set)")
     args = p.parse_args()
+
+    data_dir = args.data_dir or default_data_dir(args)
+    mpath = ensure_dataset(args, data_dir)
+    if args.write_data_only:
+        print("dataset written: %s" % mpath, flush=True)
+        return
 
     kv = mx.kv.create("dist_async")
     if not getattr(kv, "server_side", False):
@@ -101,7 +188,6 @@ def main():
     emb_item.initialize_table(scale=0.1, seed=12)
 
     manager = None
-    begin_epoch = 0
     ckpt_dir = args.checkpoint_dir or os.environ.get(
         "MXNET_CHECKPOINT_DIR")
     if ckpt_dir:
@@ -111,48 +197,55 @@ def main():
             retain=os.environ.get("MXNET_CHECKPOINT_RETAIN", 2))
         ck = manager.latest()
         if ck is not None:
-            begin_epoch = ck.epoch
             state = ck.worker_state(kv.rank)
             if state and state.get("numpy_rng") is not None:
                 np.random.set_state(state["numpy_rng"])
             print("worker %d resuming from checkpoint epoch %d (%s)"
-                  % (kv.rank, begin_epoch, ck.path), flush=True)
+                  % (kv.rank, ck.epoch, ck.path), flush=True)
     checkpoint = elastic_table_checkpoint(
         manager, [emb_user, emb_item], kv) if manager else None
 
-    users, items, ratings = synth_interactions(
-        args.num_samples, args.users, args.items, rank_k=args.dim,
-        seed=kv.rank)
+    users, items, ratings = load_full(mpath)
     loss0 = evaluate(emb_user, emb_item, users, items, ratings,
                      args.batch_size)
 
+    # epoch position comes from the tracker's lease book, not a local
+    # counter: a respawned worker rejoins the epoch the fleet is in
+    # and resumes its shards at the committed cursors
+    stream = ShardedRecordStream(mpath, decode=decode_interaction,
+                                 ledger_dir=args.ledger_dir)
     steps = 0
-    for epoch in range(begin_epoch, args.num_epochs):
-        perm = np.random.permutation(len(users))
-        epoch_se, epoch_n = 0.0, 0
-        for ofs in range(0, len(users), args.batch_size):
-            sel = perm[ofs:ofs + args.batch_size]
-            u, it = users[sel], items[sel]
-            r = nd.array(ratings[sel])
-            with autograd.record():
-                pred = (emb_user(nd.array(u))
-                        * emb_item(nd.array(it))).sum(axis=1)
-                diff = pred - r
-                loss = (diff * diff).mean()
-            loss.backward()
-            # async scatter pushes; the next batch's pulls wait only on
-            # their own rows' frames (priority: user rows first, the
-            # larger table)
-            emb_user.step(priority=1)
-            emb_item.step(priority=0)
-            epoch_se += float(loss.asnumpy()) * len(sel)
-            epoch_n += len(sel)
-            steps += 1
-        print("worker %d epoch %d mse %.4f (%d steps)"
-              % (kv.rank, epoch, epoch_se / max(epoch_n, 1), steps),
-              flush=True)
-        if checkpoint is not None:
-            checkpoint(epoch + 1)
+    try:
+        while stream.epoch < args.num_epochs:
+            epoch = stream.epoch
+            epoch_se, epoch_n = 0.0, 0
+            batch_u, batch_i, batch_r = [], [], []
+            for _shard, _idx, (u, it, r) in stream.epoch_records():
+                batch_u.append(u)
+                batch_i.append(it)
+                batch_r.append(r)
+                if len(batch_u) == args.batch_size:
+                    loss = train_batch(emb_user, emb_item,
+                                       batch_u, batch_i, batch_r)
+                    epoch_se += loss * len(batch_u)
+                    epoch_n += len(batch_u)
+                    batch_u, batch_i, batch_r = [], [], []
+                    steps += 1
+                    chaos.tick_step()
+            if batch_u:   # this worker's epoch remainder still trains
+                loss = train_batch(emb_user, emb_item,
+                                   batch_u, batch_i, batch_r)
+                epoch_se += loss * len(batch_u)
+                epoch_n += len(batch_u)
+                steps += 1
+                chaos.tick_step()
+            print("worker %d epoch %d mse %.4f (%d records, %d steps)"
+                  % (kv.rank, epoch, epoch_se / max(epoch_n, 1),
+                     epoch_n, steps), flush=True)
+            if checkpoint is not None:
+                checkpoint(epoch + 1)
+    finally:
+        stream.close()
 
     loss1 = evaluate(emb_user, emb_item, users, items, ratings,
                      args.batch_size)

@@ -489,6 +489,34 @@ KNOBS = {
         "0.5", "honored",
         "ternary threshold for MXNET_EMBED_WIRE=2bit; finite float "
         "> 0 (embedding/table.py)"),
+    # --- sharded data input (ISSUE 17) ---
+    "MXNET_DATA_SHARDS": (
+        "8", "honored",
+        "default shard count for write_record_shards (capped at the "
+        "record count so no shard is empty); integer >= 1 "
+        "(data/writer.py)"),
+    "MXNET_DATA_WORKERS": (
+        "0", "honored",
+        "background decode/augment process-pool size for "
+        "ShardedRecordStream; 0 decodes inline on the reading thread; "
+        "integer >= 0 (data/service.py)"),
+    "MXNET_DATA_PREFETCH": (
+        "2", "honored",
+        "prefetch-queue depth (read/decode chunks buffered ahead of "
+        "the training thread); 0 = fully synchronous reads, the bench "
+        "baseline; integer >= 0 (data/service.py)"),
+    "MXNET_DATA_DETERMINISTIC": (
+        "1", "honored",
+        "seed record decode/augment from (epoch, shard, record-index) "
+        "so elastic shard rebalancing replays byte-identical batches; "
+        "0 salts seeds with worker identity; 0|1, anything else "
+        "raises (data/service.py)"),
+    "MXNET_DATA_LEASE_TTL": (
+        "30", "honored",
+        "shard-lease time-to-live in seconds: a lease not renewed "
+        "(cursor committed) within the TTL returns to the pool for "
+        "rebalancing; finite float > 0 (tracker.py lease books, "
+        "data/service.py local authority)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

@@ -116,6 +116,9 @@ def dump_profile():
     embed = embedding_stats()
     if embed:
         payload["embeddingStats"] = embed
+    io = io_stats()
+    if io:
+        payload["ioStats"] = io
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -809,6 +812,104 @@ def embedding_reset():
         _EMBED_SHARD_BYTES.clear()
         _EMBED_PULL_LAT = None
         _EMBED_PUSH_LAT = None
+
+
+# ---------------------------------------------------------------------------
+# sharded-data-input observability (ISSUE 17): always-on counters for
+# the dataset service — records/bytes actually read off disk, decode
+# work, prefetch hit/miss + queue depth, shard-lease churn (grants,
+# rebalances, losses, resumes with their cursors), and a bounded
+# per-batch input-wait reservoir for p50/p99 (input wait is the number
+# the prefetch pipeline exists to drive toward zero). Rides
+# dump_profile as ioStats. Unknown counter names raise (the
+# fleet_record rule).
+# ---------------------------------------------------------------------------
+_IO_LOCK = threading.Lock()
+_IO_ZERO = {
+    "records": 0, "bytes": 0, "batches": 0, "decode_tasks": 0,
+    "prefetch_hits": 0, "prefetch_misses": 0,
+    "leases": 0, "lease_lost": 0, "rebalanced_leases": 0,
+    "shards_done": 0, "epochs": 0, "resumes": 0,
+    "read_seconds": 0.0, "decode_seconds": 0.0, "wait_seconds": 0.0,
+}
+_IO_FLOATS = ("read_seconds", "decode_seconds", "wait_seconds")
+_IO = dict(_IO_ZERO)
+_IO_CURSORS = {}            # shard index -> last resume cursor seen
+_IO_QUEUE_DEPTH_MAX = 0
+_IO_LAT_CAP = 8192
+_IO_WAIT_LAT = None         # deque of wait seconds, created lazily
+
+
+def io_record(resume_cursors=None, wait_latencies=None,
+              queue_depth=None, **adds):
+    """Accumulate dataset-service counters (thread-safe).
+    ``resume_cursors`` is a ``{shard_index: cursor}`` last-seen map,
+    ``wait_latencies`` a list of per-batch input-wait seconds for the
+    reservoir, ``queue_depth`` an instantaneous prefetch-queue depth
+    (the max is kept). Unknown counter names raise — a typo'd counter
+    would silently vanish from the acceptance evidence."""
+    global _IO_WAIT_LAT, _IO_QUEUE_DEPTH_MAX
+    with _IO_LOCK:
+        for k, v in adds.items():
+            if k in _IO_FLOATS:
+                _IO[k] += float(v)
+            elif k in _IO_ZERO:
+                _IO[k] += int(v)
+            else:
+                raise ValueError("io_record: unknown counter %r" % k)
+        if resume_cursors:
+            for s, c in resume_cursors.items():
+                _IO_CURSORS[int(s)] = int(c)
+        if queue_depth is not None and queue_depth > _IO_QUEUE_DEPTH_MAX:
+            _IO_QUEUE_DEPTH_MAX = int(queue_depth)
+        if wait_latencies:
+            if _IO_WAIT_LAT is None:
+                from collections import deque
+
+                _IO_WAIT_LAT = deque(maxlen=_IO_LAT_CAP)
+            _IO_WAIT_LAT.extend(wait_latencies)
+
+
+def io_stats(reset=False):
+    """Snapshot with derived prefetch hit rate, last resume cursor per
+    shard, and input-wait p50/p99 (ms); empty dict when the data
+    service never ran."""
+    global _IO_WAIT_LAT, _IO_QUEUE_DEPTH_MAX
+    with _IO_LOCK:
+        snap = dict(_IO)
+        cursors = {str(s): c for s, c in sorted(_IO_CURSORS.items())}
+        depth = _IO_QUEUE_DEPTH_MAX
+        wait_lat = sorted(_IO_WAIT_LAT) if _IO_WAIT_LAT else []
+        if reset:
+            _IO.update(_IO_ZERO)
+            _IO_CURSORS.clear()
+            _IO_QUEUE_DEPTH_MAX = 0
+            _IO_WAIT_LAT = None
+    if not (any(snap.values()) or cursors):
+        return {}
+    probes = snap["prefetch_hits"] + snap["prefetch_misses"]
+    if probes:
+        snap["prefetch_hit_rate"] = round(
+            snap["prefetch_hits"] / probes, 4)
+    for key in _IO_FLOATS:
+        snap[key] = round(snap[key], 4)
+    if cursors:
+        snap["resume_cursors"] = cursors
+    if depth:
+        snap["queue_depth_max"] = depth
+    if wait_lat:
+        snap["input_wait_p50_ms"] = _percentile_ms(wait_lat, 0.50)
+        snap["input_wait_p99_ms"] = _percentile_ms(wait_lat, 0.99)
+    return snap
+
+
+def io_reset():
+    global _IO_WAIT_LAT, _IO_QUEUE_DEPTH_MAX
+    with _IO_LOCK:
+        _IO.update(_IO_ZERO)
+        _IO_CURSORS.clear()
+        _IO_QUEUE_DEPTH_MAX = 0
+        _IO_WAIT_LAT = None
 
 
 def pause():

@@ -320,6 +320,9 @@ class Tracker:
         self._stop = threading.Event()
         self._fanned_out = False
         self._conns = set()         # live client connections
+        # data-plane shard leases (ISSUE 17): dataset name -> lease book
+        self._datasets = {}
+        self._data_ttl = env_positive_float("MXNET_DATA_LEASE_TTL", 30.0)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -392,8 +395,24 @@ class Tracker:
                 self._abort_barrier_locked(
                     name, "barrier %r broken: %s %d (rank %d) died (%s)"
                     % (name, node.role, node_id, node.rank, why))
+        if node.role == "worker":
+            self._data_release_rank_locked(node.rank, "death")
         self._cv.notify_all()
         self._maybe_finish_locked()
+
+    def _data_release_rank_locked(self, rank, cause):
+        """Return a dead/leaving worker's shard leases to the pool with
+        their committed cursors — the rebalance that lets a survivor or
+        the rank's own respawn resume mid-shard."""
+        now = time.monotonic()
+        for book in self._datasets.values():
+            released = book.release_owner(rank, now)
+            if released:
+                self._lifecycle(
+                    "data-rebalance", dataset=book.name, rank=rank,
+                    cause=cause,
+                    shards=",".join(str(r["shard"]) for r in released),
+                    cursors=",".join(str(r["cursor"]) for r in released))
 
     def _maybe_finish_locked(self):
         """All expected workers done (or dead beyond recovery) =>
@@ -720,6 +739,75 @@ class Tracker:
                      "info": dict(n.info)}
                     for n in self._nodes.values()]
 
+    # -- data-plane shard leases (ISSUE 17) ----------------------------------
+    def _data_book_locked(self, name):
+        book = self._datasets.get(name)
+        if book is None:
+            raise ValueError("dataset %r was never data_init'd" % (name,))
+        return book
+
+    def _op_data_init(self, p):
+        from .data.lease import ShardLeaseBook  # stdlib-only, lazy
+
+        name = str(p["name"])
+        shards = [int(n) for n in p["shards"]]
+        with self._cv:
+            book = self._datasets.get(name)
+            if book is None:
+                book = ShardLeaseBook(name, shards, self._data_ttl)
+                self._datasets[name] = book
+                self._lifecycle("data-init", dataset=name,
+                                shards=len(shards),
+                                records=sum(shards))
+            elif book.record_counts() != shards:
+                raise ValueError(
+                    "dataset %r already registered with different shard "
+                    "counts (%r != %r)"
+                    % (name, book.record_counts(), shards))
+            return {"epoch": book.epoch, "shards": len(book.shards)}
+
+    def _op_data_acquire(self, p):
+        with self._cv:
+            book = self._data_book_locked(p["name"])
+            got = book.acquire(int(p["rank"]), int(p["epoch"]),
+                               time.monotonic())
+            if got["status"] == "lease":
+                self._lifecycle(
+                    "data-lease", dataset=book.name, epoch=got["epoch"],
+                    shard=got["shard"], rank=int(p["rank"]),
+                    cursor=got["cursor"],
+                    resumed=int(bool(got["resumed"])),
+                    rebalanced=int(bool(got["rebalanced"])))
+            return got
+
+    def _op_data_renew(self, p):
+        with self._cv:
+            book = self._data_book_locked(p["name"])
+            return book.renew(int(p["rank"]), int(p["epoch"]),
+                              int(p["shard"]), int(p["cursor"]),
+                              time.monotonic())
+
+    def _op_data_complete(self, p):
+        with self._cv:
+            book = self._data_book_locked(p["name"])
+            done = book.complete(int(p["rank"]), int(p["epoch"]),
+                                 int(p["shard"]), int(p["cursor"]),
+                                 time.monotonic())
+            if done.get("epoch_done"):
+                self._lifecycle("data-epoch-done", dataset=book.name,
+                                epoch=int(p["epoch"]))
+            return done
+
+    def _op_data_release(self, p):
+        with self._cv:
+            self._data_book_locked(p["name"])  # typed unknown-name error
+            self._data_release_rank_locked(int(p["rank"]), "release")
+            return None
+
+    def _op_data_state(self, p):
+        with self._cv:
+            return self._data_book_locked(p["name"]).snapshot()
+
     def _dispatch(self, conn_nodes, op, p):
         if op == "register":
             return self._op_register(conn_nodes, p)
@@ -741,6 +829,18 @@ class Tracker:
             return self._op_members(p)
         if op == "nodes":
             return self._op_nodes()
+        if op == "data_init":
+            return self._op_data_init(p)
+        if op == "data_acquire":
+            return self._op_data_acquire(p)
+        if op == "data_renew":
+            return self._op_data_renew(p)
+        if op == "data_complete":
+            return self._op_data_complete(p)
+        if op == "data_release":
+            return self._op_data_release(p)
+        if op == "data_state":
+            return self._op_data_state(p)
         raise ValueError("unknown op %r" % (op,))
 
     # -- connection loop -----------------------------------------------------
@@ -785,6 +885,14 @@ class Tracker:
                     if (n.alive and not n.done
                             and now - n.last_beat > self._heartbeat_timeout):
                         self._mark_dead_locked(n.node_id, "heartbeat lost")
+                # shard leases whose holder stopped committing: back to
+                # the pool (cursor intact) so survivors pick them up
+                for book in self._datasets.values():
+                    for r in book.expire(now):
+                        self._lifecycle(
+                            "data-lease-expired", dataset=book.name,
+                            shard=r["shard"], rank=r["rank"],
+                            cursor=r["cursor"])
 
     def serve_forever(self):
         self._sock.settimeout(0.5)
@@ -961,6 +1069,38 @@ class TrackerClient:
                       timeout=10.0)
         except (TrackerError, OSError, ConnectionError):
             pass
+
+    # -- data-plane shard leases (ISSUE 17) ---------------------------------
+    # explicit-rank signatures, identical to LocalLeaseAuthority's, so
+    # ShardedRecordStream speaks one surface to either authority
+    def data_init(self, name, shards):
+        return self._rpc("data_init",
+                         {"name": str(name),
+                          "shards": [int(n) for n in shards]})
+
+    def data_acquire(self, name, rank, epoch):
+        return self._rpc("data_acquire",
+                         {"name": str(name), "rank": int(rank),
+                          "epoch": int(epoch)})
+
+    def data_renew(self, name, rank, epoch, shard, cursor):
+        return self._rpc("data_renew",
+                         {"name": str(name), "rank": int(rank),
+                          "epoch": int(epoch), "shard": int(shard),
+                          "cursor": int(cursor)})
+
+    def data_complete(self, name, rank, epoch, shard, cursor):
+        return self._rpc("data_complete",
+                         {"name": str(name), "rank": int(rank),
+                          "epoch": int(epoch), "shard": int(shard),
+                          "cursor": int(cursor)})
+
+    def data_release(self, name, rank):
+        return self._rpc("data_release",
+                         {"name": str(name), "rank": int(rank)})
+
+    def data_state(self, name):
+        return self._rpc("data_state", {"name": str(name)})
 
     def done(self):
         """Report graceful completion (idempotent; swallows a dead

@@ -1,0 +1,655 @@
+"""Sharded dataset service (ISSUE 17): shard-lease arithmetic, the
+exactly-once record stream (cursor resume, ledger reconciliation,
+deterministic seeding under rebalance), the record-shard writer +
+manifest corruption matrix, ioStats observability, and the data-knob
+validation satellites. Default tier is subprocess-free (the lease book
+is pure state; streams run against LocalLeaseAuthority or an
+in-process tracker); the launch.py e2e + chaos cases are slow-tier.
+"""
+import hashlib
+import json
+import os
+import re
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.data import (CursorCorruptError, DataPlaneError,
+                            LeaseError, LocalLeaseAuthority,
+                            ManifestCorruptError, ShardCorruptError,
+                            ShardedBatchIter, ShardedRecordStream,
+                            ShardLeaseBook, iter_manifest_records,
+                            merge_ledgers, record_seed,
+                            write_record_shards)
+from mxnet_tpu.data.service import decode_image_f32
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _book(counts=(10, 10, 10), ttl=5.0):
+    return ShardLeaseBook("ds", list(counts), ttl)
+
+
+def _records(n=48, payload=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [struct.pack("<i", i) + rng.bytes(payload) for i in range(n)]
+
+
+def _dataset(tmp_path, n=48, num_shards=4, name="ds", seed=0):
+    return write_record_shards(str(tmp_path), name,
+                               _records(n, seed=seed),
+                               num_shards=num_shards)
+
+
+def decode_index(raw, seed):
+    """First 4 bytes are the record's global index (test decode)."""
+    return struct.unpack_from("<i", raw, 0)[0]
+
+
+def _stream(mpath, auth, rank=0, **kw):
+    kw.setdefault("decode", decode_index)
+    kw.setdefault("workers", 0)
+    kw.setdefault("prefetch", 0)
+    kw.setdefault("chunk", 4)
+    return ShardedRecordStream(mpath, lease_client=auth, rank=rank, **kw)
+
+
+# ---------------------------------------------------------------------------
+# lease-book arithmetic (pure state: `now` is passed explicitly)
+# ---------------------------------------------------------------------------
+def test_book_validates_registration():
+    with pytest.raises(LeaseError, match="non-empty list"):
+        ShardLeaseBook("ds", [], 5.0)
+    with pytest.raises(LeaseError, match="integer >= 0"):
+        ShardLeaseBook("ds", [10, -1], 5.0)
+    with pytest.raises(LeaseError, match="ttl"):
+        ShardLeaseBook("ds", [10], 0.0)
+
+
+def test_acquire_grants_each_shard_once_then_wait():
+    book = _book()
+    leases = [book.acquire(r, 0, now=0.0) for r in range(3)]
+    assert [l["status"] for l in leases] == ["lease"] * 3
+    assert sorted(l["shard"] for l in leases) == [0, 1, 2]
+    assert all(l["cursor"] == 0 and not l["resumed"] for l in leases)
+    # pool exhausted but peers still working -> wait, not epoch_done
+    assert book.acquire(9, 0, now=0.0)["status"] == "wait"
+
+
+def test_release_keeps_cursor_and_prefers_last_owner():
+    book = _book()
+    assert book.acquire(0, 0, now=0.0)["shard"] == 0
+    lease = book.acquire(1, 0, now=0.0)
+    assert lease["shard"] == 1
+    assert book.renew(1, 0, 1, 7, now=1.0)["ok"]
+    book.release_owner(0, now=1.0)
+    released = book.release_owner(1, now=1.0)
+    assert released == [{"shard": 1, "cursor": 7}]
+    # shards 0, 1, 2 are all free; the respawned rank 1 gets its OWN
+    # old shard back (not the lowest id), resumed at cursor 7
+    back = book.acquire(1, 0, now=1.0)
+    assert back["shard"] == 1
+    assert back["cursor"] == 7
+    assert back["resumed"] and not back["rebalanced"]
+
+
+def test_rebalanced_lease_flags_and_cursor_survive_ttl_expiry():
+    book = _book(ttl=5.0)
+    lease = book.acquire(0, 0, now=0.0)
+    book.renew(0, 0, lease["shard"], 4, now=1.0)
+    # rank 0 goes silent past the deadline; rank 1's acquire (which
+    # expires stale leases) steals the shard at the committed cursor
+    got = book.acquire(1, 0, now=100.0)
+    assert got["shard"] == lease["shard"]
+    assert got["cursor"] == 4
+    assert got["rebalanced"] and got["resumed"]
+    assert book.rebalances == 1
+
+
+def test_renew_after_rebalance_reports_lost_not_ok():
+    book = _book(ttl=5.0)
+    lease = book.acquire(0, 0, now=0.0)
+    book.acquire(1, 0, now=100.0)        # steals after TTL
+    out = book.renew(0, 0, lease["shard"], 5, now=101.0)
+    assert out["ok"] is False
+    assert "rebalanced" in out["lost"]
+
+
+def test_renew_rejects_backwards_and_out_of_range_cursor():
+    book = _book()
+    lease = book.acquire(0, 0, now=0.0)
+    book.renew(0, 0, lease["shard"], 6, now=0.0)
+    with pytest.raises(LeaseError, match="moved backwards"):
+        book.renew(0, 0, lease["shard"], 3, now=0.0)
+    with pytest.raises(LeaseError, match="out of range"):
+        book.renew(0, 0, lease["shard"], 11, now=0.0)
+
+
+def test_complete_requires_full_cursor_then_epoch_rolls():
+    book = _book(counts=(4, 4))
+    a = book.acquire(0, 0, now=0.0)
+    with pytest.raises(LeaseError, match="partially read"):
+        book.complete(0, 0, a["shard"], 2, now=0.0)
+    assert book.complete(0, 0, a["shard"], 4, now=0.0)["ok"]
+    b = book.acquire(0, 0, now=0.0)
+    done = book.complete(0, 0, b["shard"], 4, now=0.0)
+    assert done["ok"] and done["epoch_done"]
+    assert book.acquire(0, 0, now=0.0)["status"] == "epoch_done"
+    # the roll happens on the first acquire(epoch+1): cursors reset
+    nxt = book.acquire(0, 1, now=0.0)
+    assert nxt["status"] == "lease" and nxt["cursor"] == 0
+    assert book.epoch == 1
+    # a straggler still asking for epoch 0 learns it is behind
+    assert book.acquire(1, 0, now=0.0) == {"status": "behind",
+                                           "epoch": 1}
+
+
+# ---------------------------------------------------------------------------
+# writer + manifest corruption matrix
+# ---------------------------------------------------------------------------
+def test_writer_roundtrip_preserves_records_and_order(tmp_path):
+    recs = _records(23)
+    mpath = write_record_shards(str(tmp_path), "rt", recs, num_shards=3)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert manifest["total_records"] == 23
+    assert sum(e["records"] for e in manifest["shards"]) == 23
+    got = [raw for _s, _i, raw in iter_manifest_records(mpath)]
+    assert got == recs
+
+
+def test_manifest_corruption_five_ways(tmp_path):
+    mpath = _dataset(tmp_path)
+    with open(mpath) as f:
+        good = json.load(f)
+
+    def rewrite(text):
+        with open(mpath, "w") as f:
+            f.write(text)
+
+    # 1. unreadable / missing
+    with pytest.raises(ManifestCorruptError, match="unreadable"):
+        ShardedRecordStream(str(tmp_path / "nope.manifest.json"),
+                            lease_client=LocalLeaseAuthority(ttl=5.0))
+    # 2. not JSON
+    rewrite("{not json")
+    with pytest.raises(ManifestCorruptError, match="JSON"):
+        _stream(mpath, LocalLeaseAuthority(ttl=5.0))
+    # 3. top level not an object
+    rewrite(json.dumps([1, 2]))
+    with pytest.raises(ManifestCorruptError):
+        _stream(mpath, LocalLeaseAuthority(ttl=5.0))
+    # 4. version mismatch
+    rewrite(json.dumps(dict(good, version=99)))
+    with pytest.raises(ManifestCorruptError, match="version"):
+        _stream(mpath, LocalLeaseAuthority(ttl=5.0))
+    # 5. malformed shard entry
+    bad = dict(good)
+    bad["shards"] = [{"file": "x"}]   # no record count
+    rewrite(json.dumps(bad))
+    with pytest.raises(ManifestCorruptError):
+        _stream(mpath, LocalLeaseAuthority(ttl=5.0))
+    # every manifest failure is also the typed data-plane family
+    assert issubclass(ManifestCorruptError, DataPlaneError)
+    assert issubclass(DataPlaneError, MXNetError)
+
+
+def test_truncated_shard_detected_against_manifest(tmp_path):
+    mpath = _dataset(tmp_path, n=24, num_shards=2)
+    with open(mpath) as f:
+        entry = json.load(f)["shards"][0]
+    rec = str(tmp_path / entry["file"])
+    # chop the tail: recordio reads a short header as clean EOF, so
+    # the count-vs-manifest check is the only truncation signal
+    with open(rec, "r+b") as f:
+        f.truncate(os.path.getsize(rec) // 2)
+    with pytest.raises(ShardCorruptError, match="truncated|EOF|index"):
+        list(iter_manifest_records(mpath))
+
+
+def test_garbage_magic_detected(tmp_path):
+    mpath = _dataset(tmp_path, n=24, num_shards=2)
+    with open(mpath) as f:
+        entry = json.load(f)["shards"][0]
+    rec = str(tmp_path / entry["file"])
+    with open(rec + ".idx") as f:
+        offsets = [int(line.split("\t")[1]) for line in f if line.strip()]
+    with open(rec, "r+b") as f:       # stomp record 1's magic
+        f.seek(offsets[1])
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(ShardCorruptError, match="garbage"):
+        list(iter_manifest_records(mpath))
+
+
+def test_stale_index_detected_at_open(tmp_path):
+    mpath = _dataset(tmp_path, n=24, num_shards=2)
+    with open(mpath) as f:
+        entry = json.load(f)["shards"][0]
+    idx = str(tmp_path / (entry["file"] + ".idx"))
+    with open(idx) as f:
+        lines = f.readlines()
+    with open(idx, "w") as f:
+        f.writelines(lines[:-1])
+    with pytest.raises(ShardCorruptError, match="promises"):
+        list(iter_manifest_records(mpath))
+
+
+def test_garbled_ledger_refuses_to_guess_cursor(tmp_path):
+    mpath = _dataset(tmp_path)
+    ldir = tmp_path / "ledger"
+    ldir.mkdir()
+    (ldir / "old.ledger").write_text("0\tnot-an-int\tx\n")
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=5.0),
+                     ledger_dir=str(ldir))
+    try:
+        with pytest.raises(CursorCorruptError, match="refusing"):
+            next(stream.epoch_records())
+    finally:
+        stream.close()
+
+
+def test_ledger_beyond_shard_is_cursor_corrupt(tmp_path):
+    mpath = _dataset(tmp_path, n=16, num_shards=2)
+    ldir = tmp_path / "ledger"
+    ldir.mkdir()
+    # a ledger claiming consumption past the shard's record count
+    (ldir / "old.ledger").write_text("0\t0\t999\n")
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=5.0),
+                     ledger_dir=str(ldir))
+    try:
+        with pytest.raises(DataPlaneError):
+            list(stream.epoch_records())
+    finally:
+        stream.close()
+
+
+# ---------------------------------------------------------------------------
+# the stream: exactly-once, resume, rebalance, determinism
+# ---------------------------------------------------------------------------
+def test_single_stream_covers_epoch_exactly_once(tmp_path):
+    mpath = _dataset(tmp_path, n=48, num_shards=4)
+    ldir = tmp_path / "ledger"
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=30.0),
+                     ledger_dir=str(ldir))
+    try:
+        got = sorted(rec for _s, _i, rec in stream.epoch_records())
+        assert got == list(range(48))
+        assert stream.epoch == 1
+        counts = merge_ledgers(str(ldir))
+        assert len(counts) == 48
+        assert set(counts.values()) == {1}
+    finally:
+        stream.close()
+
+
+def test_mid_epoch_handoff_resumes_at_cursor(tmp_path):
+    """Stream A consumes part of the epoch and walks away (close =
+    death-with-release); stream B on the SAME authority finishes the
+    pass. Union covers every record exactly once via the ledgers."""
+    mpath = _dataset(tmp_path, n=48, num_shards=4)
+    ldir = tmp_path / "ledger"
+    auth = LocalLeaseAuthority(ttl=30.0)
+    a = _stream(mpath, auth, rank=0, ledger_dir=str(ldir))
+    it = a.epoch_records()
+    first = [next(it) for _ in range(20)]   # 5 chunks of 4
+    it.close()
+    a.close()
+    b = _stream(mpath, auth, rank=1, ledger_dir=str(ldir))
+    try:
+        rest = list(b.epoch_records())
+        assert b.epoch == 1
+        counts = merge_ledgers(str(ldir))
+        assert len(counts) == 48, "ledger under-covered the epoch"
+        assert set(counts.values()) == {1}, "a record was re-consumed"
+        yielded = sorted(r for _s, _i, r in first + rest)
+        assert yielded == list(range(48))
+    finally:
+        b.close()
+
+
+def test_caller_epoch_loop_never_runs_phantom_epochs(tmp_path):
+    mpath = _dataset(tmp_path, n=16, num_shards=2)
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=30.0))
+    seen = 0
+    try:
+        while stream.epoch < 3:
+            seen += sum(1 for _ in stream.epoch_records())
+        assert stream.epoch == 3
+        assert seen == 48
+    finally:
+        stream.close()
+
+
+def test_record_seed_depends_on_position_not_worker():
+    s1 = record_seed(2, 5, 17)
+    assert s1 == record_seed(2, 5, 17)
+    assert s1 != record_seed(2, 5, 18)
+    assert s1 != record_seed(3, 5, 17)
+    # salt (worker identity, non-deterministic mode) changes the seed
+    assert s1 != record_seed(2, 5, 17, salt=0x0101)
+
+
+def _image_dataset(tmp_path, n=32, shape=(3, 8, 8), num_shards=4):
+    rng = np.random.RandomState(3)
+    px = int(np.prod(shape))
+    recs = [struct.pack("<f", float(i))
+            + rng.randint(0, 256, px, dtype=np.uint8).tobytes()
+            for i in range(n)]
+    return write_record_shards(str(tmp_path), "imgs", recs,
+                               num_shards=num_shards)
+
+
+def _decoded_hashes(stream):
+    out = {}
+    for shard, idx, (img, label) in stream.epoch_records():
+        out[(shard, idx)] = hashlib.sha1(
+            img.tobytes() + np.float32(label).tobytes()).hexdigest()
+    return out
+
+
+def test_deterministic_decode_is_byte_identical_under_rebalance(
+        tmp_path):
+    """The determinism acceptance: a full single-owner pass and a pass
+    split across a mid-epoch handoff between two ranks decode to the
+    same bytes, because seeds come from (epoch, shard, index). The
+    seed-driven flip inside decode_image_f32 is the probe."""
+    from functools import partial
+
+    mpath = _image_dataset(tmp_path)
+    decode = partial(decode_image_f32, shape=(3, 8, 8))
+    full = _stream(mpath, LocalLeaseAuthority(ttl=30.0), decode=decode,
+                   deterministic=True, chunk=4)
+    try:
+        want = _decoded_hashes(full)
+    finally:
+        full.close()
+
+    auth = LocalLeaseAuthority(ttl=30.0)
+    a = _stream(mpath, auth, rank=0, decode=decode,
+                deterministic=True, chunk=4)
+    it = a.epoch_records()
+    got = {}
+    for _ in range(16):                 # 4 whole chunks, 2 shards
+        shard, idx, (img, label) = next(it)
+        got[(shard, idx)] = hashlib.sha1(
+            img.tobytes() + np.float32(label).tobytes()).hexdigest()
+    it.close()
+    a.close()
+    b = _stream(mpath, auth, rank=1, decode=decode,
+                deterministic=True, chunk=4)
+    try:
+        got.update(_decoded_hashes(b))
+    finally:
+        b.close()
+    assert got == want
+
+
+def test_nondeterministic_mode_salts_by_worker(tmp_path):
+    mpath = _image_dataset(tmp_path)
+    from functools import partial
+
+    decode = partial(decode_image_f32, shape=(3, 8, 8))
+
+    def hashes(rank, deterministic):
+        s = _stream(mpath, LocalLeaseAuthority(ttl=30.0), rank=rank,
+                    decode=decode, deterministic=deterministic)
+        try:
+            return _decoded_hashes(s)
+        finally:
+            s.close()
+
+    assert hashes(0, True) == hashes(1, True)
+    assert hashes(0, False) != hashes(1, False)
+
+
+def test_batch_iter_contract(tmp_path):
+    """DataIter semantics: fixed batch shapes, remainder dropped,
+    StopIteration persists until reset() (a read-ahead feeder must not
+    silently open an epoch nobody trains), reset starts the NEXT
+    lease-book epoch."""
+    mpath = _dataset(tmp_path, n=22, num_shards=2)
+
+    def decode_pair(raw, seed):
+        return (np.full((3,), float(decode_index(raw, seed)),
+                        dtype=np.float32), 1.0)
+
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=30.0),
+                     decode=decode_pair)
+    it = ShardedBatchIter(stream, 8, (3,))
+    try:
+        assert it.provide_data[0].shape == (8, 3)
+        batches = list(it)
+        assert len(batches) == 2           # 22 records -> remainder 6 dropped
+        assert batches[0].data[0].shape == (8, 3)
+        assert batches[0].label[0].shape == (8,)
+        with pytest.raises(StopIteration):
+            next(it)                       # exhausted until reset()
+        assert stream.epoch == 1
+        it.reset()
+        assert len(list(it)) == 2          # epoch 1
+        assert stream.epoch == 2
+    finally:
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: ioStats
+# ---------------------------------------------------------------------------
+def test_io_record_strict_and_stats_derivations():
+    profiler.io_reset()
+    try:
+        profiler.io_record(records=10, bytes=100, prefetch_hits=3,
+                           prefetch_misses=1, wait_seconds=0.25,
+                           wait_latencies=[0.1, 0.15], queue_depth=5,
+                           resume_cursors={2: 64})
+        with pytest.raises(ValueError, match="unknown counter"):
+            profiler.io_record(recrods=1)   # typo'd counter
+        st = profiler.io_stats()
+        assert st["records"] == 10
+        assert st["prefetch_hit_rate"] == 0.75
+        assert st["resume_cursors"] == {"2": 64}
+        assert st["queue_depth_max"] == 5
+        assert st["input_wait_p50_ms"] > 0
+        assert st["input_wait_p99_ms"] >= st["input_wait_p50_ms"]
+    finally:
+        profiler.io_reset()
+    assert profiler.io_stats() == {}
+
+
+def test_io_stats_ride_dump_profile(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    profiler.io_reset()
+    try:
+        profiler.io_record(records=4, leases=1, epochs=1)
+        profiler.profiler_set_state("stop")
+        profiler.dump_profile()
+        with open(fname) as f:
+            payload = json.load(f)
+        assert payload["ioStats"]["records"] == 4
+        assert payload["ioStats"]["leases"] == 1
+    finally:
+        profiler.io_reset()
+
+
+def test_stream_populates_io_stats(tmp_path):
+    mpath = _dataset(tmp_path, n=48, num_shards=4)
+    profiler.io_reset()
+    stream = _stream(mpath, LocalLeaseAuthority(ttl=30.0), prefetch=2)
+    try:
+        n = sum(1 for _ in stream.epoch_records())
+        st = profiler.io_stats()
+        assert n == 48
+        assert st["records"] == 48
+        assert st["bytes"] > 0
+        assert st["decode_tasks"] == 48
+        assert st["leases"] == 4
+        assert st["shards_done"] == 4
+        assert st["epochs"] == 1
+        assert st["prefetch_hits"] + st["prefetch_misses"] > 0
+    finally:
+        stream.close()
+        profiler.io_reset()
+
+
+# ---------------------------------------------------------------------------
+# knob validation
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("knob,bad", [
+    ("MXNET_DATA_WORKERS", "nope"),
+    ("MXNET_DATA_PREFETCH", "-3"),
+    ("MXNET_DATA_DETERMINISTIC", "maybe"),
+])
+def test_malformed_data_knobs_fail_loudly(tmp_path, monkeypatch,
+                                          knob, bad):
+    mpath = _dataset(tmp_path)
+    monkeypatch.setenv(knob, bad)
+    with pytest.raises(MXNetError, match=knob):
+        ShardedRecordStream(mpath,
+                            lease_client=LocalLeaseAuthority(ttl=5.0),
+                            rank=0, decode=decode_index)
+
+
+def test_malformed_lease_ttl_fails_loudly(monkeypatch):
+    monkeypatch.setenv("MXNET_DATA_LEASE_TTL", "0")
+    with pytest.raises(MXNetError, match="MXNET_DATA_LEASE_TTL"):
+        LocalLeaseAuthority().data_init("ds", [4, 4])
+
+
+def test_bad_shards_knob_rejected_by_writer(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_DATA_SHARDS", "0")
+    with pytest.raises(MXNetError, match="MXNET_DATA_SHARDS"):
+        write_record_shards(str(tmp_path), "k", _records(8))
+
+
+def test_clean_dist_env_strips_data_knobs(monkeypatch):
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    monkeypatch.setenv("MXNET_DATA_WORKERS", "7")
+    env = clean_dist_env(repo_root=ROOT)
+    assert "MXNET_DATA_WORKERS" not in env
+
+
+# ---------------------------------------------------------------------------
+# the tracker as lease authority (in-process, no subprocesses)
+# ---------------------------------------------------------------------------
+def test_tracker_serves_leases_and_rebalances_on_death():
+    import time
+
+    from mxnet_tpu.tracker import Tracker, TrackerClient, TrackerError
+
+    trk = Tracker(num_workers=2, num_servers=0, heartbeat_timeout=2.0,
+                  max_restarts=1)
+    trk.serve_in_background()
+    w0 = w1 = None
+    try:
+        w0 = TrackerClient(trk.addr, "worker")
+        w1 = TrackerClient(trk.addr, "worker")
+        assert w0.data_init("ds", [6, 6]) == {"epoch": 0, "shards": 2}
+        # idempotent re-init; mismatched counts refuse
+        w1.data_init("ds", [6, 6])
+        with pytest.raises(TrackerError, match="different"):
+            w1.data_init("ds", [6, 7])
+        a = w0.data_acquire("ds", w0.rank, 0)
+        b = w1.data_acquire("ds", w1.rank, 0)
+        assert {a["shard"], b["shard"]} == {0, 1}
+        assert w0.data_renew("ds", w0.rank, 0, a["shard"], 3)["ok"]
+        # rank 0 dies: its shard returns to the pool at cursor 3 and
+        # the survivor picks it up marked rebalanced+resumed
+        w0.close()
+        w0 = None
+        deadline = time.monotonic() + 10
+        got = {"status": "wait"}
+        while got["status"] != "lease":
+            assert time.monotonic() < deadline, got
+            got = w1.data_acquire("ds", w1.rank, 0)
+            time.sleep(0.05)
+        assert got["shard"] == a["shard"]
+        assert got["cursor"] == 3
+        assert got["rebalanced"] and got["resumed"]
+        snap = w1.data_state("ds")
+        assert snap["rebalances"] >= 1
+    finally:
+        for c in (w0, w1):
+            if c is not None:
+                c.close()
+        trk.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke (tiny shapes; the real numbers come from tools/bench_data)
+# ---------------------------------------------------------------------------
+def test_bench_data_smoke(tmp_path):
+    from tools.bench_data import measure
+
+    rec = measure(records=96, shape=(3, 8, 8), batch=16, workers=0,
+                  prefetch=2, num_shards=4, compute_ms=1.0,
+                  decode_reps=1, root=str(tmp_path))
+    assert rec["deterministic_replay_identical"] is True
+    assert rec["records_s"] > 0 and rec["sync_records_s"] > 0
+    assert 0.0 <= rec["input_wait_frac_prefetch"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# slow tier: launch.py e2e + chaos
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_worker_e2e_exactly_once_ledger(tmp_path):
+    """Acceptance: 2 workers under launch.py share the epoch through
+    tracker leases; the merged ledgers show every record of every epoch
+    consumed exactly once."""
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    data_dir, ledger_dir = str(tmp_path / "data"), str(tmp_path / "led")
+    train = os.path.join(ROOT, "examples", "recommender", "train.py")
+    subprocess.run([sys.executable, train, "--write-data-only",
+                    "--num-samples", "4000", "--data-dir", data_dir],
+                   env=env, check=True, capture_output=True, timeout=120)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--timeout", "150",
+           sys.executable, train, "--num-epochs", "2",
+           "--num-samples", "4000", "--data-dir", data_dir,
+           "--ledger-dir", ledger_dir]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    counts = merge_ledgers(ledger_dir)
+    per_epoch = {}
+    for (epoch, _s, _i), n in counts.items():
+        assert n == 1, "record consumed %d times" % n
+        per_epoch[epoch] = per_epoch.get(epoch, 0) + 1
+    assert per_epoch == {0: 4000, 1: 4000}, per_epoch
+    assert re.search(r"event=data-lease dataset=\S+ epoch=0", out)
+    losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
+    assert len(losses) == 2, out[-3000:]
+    for _rank, l0, l1 in losses:
+        assert float(l1) < float(l0), out[-2000:]
+
+
+@pytest.mark.slow
+def test_chaos_data_worker_kill_resumes_cursor():
+    """The chaos matrix data case: SIGKILL a worker mid-epoch; the
+    survivor steals its shards at the committed cursors, the respawn
+    rejoins, and the per-record ledger stays exactly-once
+    (tools/chaos_check.py --data)."""
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_check.py"),
+         "--data", "--spec", "worker:1:crash@step=20"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        (proc.stdout + proc.stderr)[-3000:]
+    assert "chaos_check[data]: OK" in proc.stdout

@@ -1,0 +1,224 @@
+"""Sharded-data-service bench (ISSUE 17): sync vs prefetched input
+wait, records/s, and the deterministic-replay check.
+
+Builds an on-disk record-shard dataset of labeled uint8 images, then
+drives :class:`ShardedBatchIter` under a simulated fixed-cost training
+step (the host sleeps, as it does while an accelerator step runs):
+
+- **sync**: ``prefetch=0, workers=0`` — every read+decode lands on
+  the training thread, the baseline the prefetch pipeline exists to
+  beat;
+- **prefetched**: bounded decode pool + prefetch queue — input wait
+  should collapse to a few percent of step time (measured from the
+  profiler's ioStats wait counters, p50/p99 included);
+- **deterministic replay**: the same epoch consumed twice — once by a
+  single stream, once split across a mid-epoch handoff between two
+  consumer identities (the elastic-rebalance shape) — must decode
+  byte-identical records, because seeds derive from (epoch, shard,
+  index), not worker identity.
+
+One JSON line on stdout, bench_input.py style. Pure CPU, no topology.
+"""
+import argparse
+import hashlib
+import json
+import os
+import struct
+import sys
+import tempfile
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import profiler                       # noqa: E402
+from mxnet_tpu.data.lease import LocalLeaseAuthority  # noqa: E402
+from mxnet_tpu.data.service import (ShardedBatchIter,  # noqa: E402
+                                    ShardedRecordStream,
+                                    decode_image_f32)
+from mxnet_tpu.data.writer import (manifest_path,     # noqa: E402
+                                   write_record_shards)
+
+DATASET = "benchimgs"
+
+
+def decode_heavy(raw, seed, shape=(3, 64, 64), reps=12):
+    """decode_image_f32 plus `reps` dense passes over the pixels —
+    stands in for the JPEG-decode + augmentation cost a real vision
+    pipeline pays per record (deterministic, so the replay check still
+    holds). Module-level: the spawn pool pickles it by reference."""
+    img, label = decode_image_f32(raw, seed, shape=shape)
+    x = img
+    for _ in range(reps):
+        x = np.sqrt(x * x + 1e-6)
+    return x.astype(np.float32), label
+
+
+def build_dataset(root, records, shape, num_shards):
+    """Labeled uint8 image records: ``<f label><pixels>``."""
+    mpath = manifest_path(root, DATASET)
+    if os.path.isfile(mpath):
+        return mpath
+    rng = np.random.RandomState(0)
+    n = int(np.prod(shape))
+    packed = []
+    for i in range(records):
+        img = rng.randint(0, 256, n, dtype=np.uint8)
+        packed.append(struct.pack("<f", float(i % 1000)) + img.tobytes())
+    return write_record_shards(root, DATASET, packed,
+                               num_shards=num_shards)
+
+
+def _new_iter(mpath, shape, batch, workers, prefetch, reps):
+    stream = ShardedRecordStream(
+        mpath, lease_client=LocalLeaseAuthority(ttl=600.0), rank=0,
+        decode=partial(decode_heavy, shape=shape, reps=reps),
+        workers=workers, prefetch=prefetch, chunk=batch)
+    return stream, ShardedBatchIter(stream, batch, shape)
+
+def _run_pass(mpath, shape, batch, workers, prefetch, compute_s, reps):
+    """One warmup epoch (pays pool spawn + page cache), then one
+    measured epoch under a fixed simulated step cost. The measured
+    epoch's first batch primes the fresh prefetch queue before the
+    clock starts — steady-state input wait is the metric, not the
+    per-epoch cold start. Returns
+    (records_s, input_wait_frac, p50_ms, p99_ms)."""
+    stream, it = _new_iter(mpath, shape, batch, workers, prefetch, reps)
+    try:
+        for _ in it:        # warmup epoch
+            pass
+        it.reset()
+        next(it)            # prime the queue for the measured epoch
+        profiler.io_reset()
+        consumed = 0
+        t0 = time.perf_counter()
+        for b in it:        # measured epoch (steady state)
+            consumed += b.data[0].shape[0]
+            time.sleep(compute_s)
+        wall = time.perf_counter() - t0
+        st = profiler.io_stats()
+        frac = st.get("wait_seconds", 0.0) / max(wall, 1e-9)
+        return (consumed / max(wall, 1e-9), frac,
+                st.get("input_wait_p50_ms"), st.get("input_wait_p99_ms"))
+    finally:
+        stream.close()
+
+
+def _record_hashes(pairs):
+    out = {}
+    for shard, idx, (img, label) in pairs:
+        h = hashlib.sha1(img.tobytes()
+                         + np.float32(label).tobytes()).hexdigest()
+        out[(shard, idx)] = h
+    return out
+
+
+def replay_identical(mpath, shape, batch):
+    """Epoch 0 consumed whole vs split across a mid-epoch handoff
+    between two consumer identities: every record must decode to the
+    same bytes (augmentation included)."""
+    decode = partial(decode_image_f32, shape=shape)
+
+    full_stream = ShardedRecordStream(
+        mpath, lease_client=LocalLeaseAuthority(ttl=600.0), rank=0,
+        decode=decode, workers=0, prefetch=0, chunk=batch,
+        deterministic=True)
+    try:
+        full = _record_hashes(full_stream.epoch_records())
+    finally:
+        full_stream.close()
+
+    auth = LocalLeaseAuthority(ttl=600.0)
+    a = ShardedRecordStream(mpath, lease_client=auth, rank=0,
+                            decode=decode, workers=0, prefetch=0,
+                            chunk=batch, deterministic=True)
+    half = []
+    it = a.epoch_records()
+    for _ in range(len(full) // 2):
+        half.append(next(it))
+    it.close()
+    a.close()   # rank 0 walks away mid-epoch; leases rebalance
+    b = ShardedRecordStream(mpath, lease_client=auth, rank=1,
+                            decode=decode, workers=0, prefetch=0,
+                            chunk=batch, deterministic=True)
+    try:
+        rest = list(b.epoch_records())
+    finally:
+        b.close()
+    split = _record_hashes(half + rest)
+    return split == full
+
+
+def measure(records=2048, shape=(3, 64, 64), batch=64, workers=2,
+            prefetch=4, num_shards=8, compute_ms=20.0, decode_reps=12,
+            root=None):
+    import jax
+
+    owned = root is None
+    root = root or tempfile.mkdtemp(prefix="bench-data-")
+    try:
+        mpath = build_dataset(root, records, shape, num_shards)
+        sync_rs, sync_frac, _, _ = _run_pass(
+            mpath, shape, batch, workers=0, prefetch=0,
+            compute_s=compute_ms / 1000.0, reps=decode_reps)
+        pre_rs, pre_frac, p50, p99 = _run_pass(
+            mpath, shape, batch, workers=workers, prefetch=prefetch,
+            compute_s=compute_ms / 1000.0, reps=decode_reps)
+        identical = replay_identical(mpath, shape, batch)
+        return {
+            "metric": "data_plane_throughput",
+            "value": round(pre_rs, 1),
+            "unit": "records/s",
+            "variant": "data",
+            "records_s": round(pre_rs, 1),
+            "sync_records_s": round(sync_rs, 1),
+            "speedup_vs_sync": round(pre_rs / max(sync_rs, 1e-9), 2),
+            "input_wait_frac_prefetch": round(pre_frac, 4),
+            "input_wait_frac_sync": round(sync_frac, 4),
+            "input_wait_p50_ms": p50,
+            "input_wait_p99_ms": p99,
+            "deterministic_replay_identical": bool(identical),
+            "records": records,
+            "batch": batch,
+            "decode_workers": workers,
+            "prefetch": prefetch,
+            "compute_ms": compute_ms,
+            "decode_reps": decode_reps,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+        }
+    finally:
+        if owned:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--records", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--prefetch", type=int, default=4)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--compute-ms", type=float, default=20.0,
+                    help="simulated device step cost per batch")
+    ap.add_argument("--decode-reps", type=int, default=12,
+                    help="dense augmentation passes per record")
+    ap.add_argument("--side", type=int, default=64,
+                    help="square image side (records are 3xSxS uint8)")
+    args = ap.parse_args()
+    rec = measure(records=args.records, shape=(3, args.side, args.side),
+                  batch=args.batch, workers=args.workers,
+                  prefetch=args.prefetch, num_shards=args.shards,
+                  compute_ms=args.compute_ms,
+                  decode_reps=args.decode_reps)
+    print(json.dumps(rec))
+    return 0 if rec["deterministic_replay_identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,19 @@ EMBED_MATRIX = [
     "server:0:crash@step=200",
 ]
 
+#: sharded-data-plane fault kind (ISSUE 17): the recommender job
+#: streaming its on-disk record shards through tracker leases, under a
+#: WORKER crash mid-epoch. The tracker must rebalance the dead
+#: worker's leases with their committed cursors (event=data-rebalance)
+#: and the respawn/survivor must resume mid-shard (event=data-lease
+#: ... resumed=1) — with the merged per-record consumption ledger
+#: showing every record exactly once per epoch. step=20 lands in
+#: epoch 1 mid-shard (~16 steps/epoch/worker at 8000 records, batch
+#: 256, 2 workers).
+DATA_MATRIX = [
+    "worker:1:crash@step=20",
+]
+
 
 def _kind(spec):
     m = re.search(r":(crash|nan|preempt)@", spec)
@@ -514,7 +527,11 @@ def run_embed_case(args, spec):
            "--timeout", str(timeout),
            sys.executable,
            os.path.join(ROOT, "examples", "recommender", "train.py"),
-           "--num-epochs", "3"]
+           # the dataset is lease-shared now (each record trains once
+           # per epoch, not once per worker), so double the sample
+           # count to keep the original per-worker push volume the
+           # server:*:crash@step specs were calibrated against
+           "--num-epochs", "3", "--num-samples", "16000"]
     print("chaos_check[embed]: %s  (MXNET_FAULT_SPEC=%s)"
           % (" ".join(cmd), spec), flush=True)
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
@@ -553,6 +570,110 @@ def run_embed_case(args, spec):
     print("chaos_check[embed]: OK — server crash healed via shard "
           "restore (%s) and the recommender converged"
           % ", ".join("keys=%s" % k for k in restores))
+    return 0
+
+
+def run_data_case(args, spec):
+    """One sharded-data fault case: the recommender job streaming an
+    on-disk record dataset through tracker shard leases, with a worker
+    SIGKILLed mid-epoch. Passes only when the crash fired, launch.py
+    respawned the worker, the tracker rebalanced the dead worker's
+    leases (event=data-rebalance), a later lease resumed at a
+    committed cursor (event=data-lease ... cursor>0 resumed=1), the
+    merged consumption ledger shows every record exactly once per
+    epoch with full coverage, and the loss still decreased on every
+    worker."""
+    import tempfile
+
+    from mxnet_tpu.data.service import merge_ledgers
+    from mxnet_tpu.data.writer import load_manifest, manifest_path
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    env = clean_dist_env(repo_root=ROOT)
+    workdir = tempfile.mkdtemp(prefix="chaos-data-")
+    data_dir = os.path.join(workdir, "dataset")
+    ledger_dir = os.path.join(workdir, "ledger")
+    num_epochs = 3
+    train = os.path.join(ROOT, "examples", "recommender", "train.py")
+
+    # materialize the record shards up front (no topology needed) so
+    # the fault run starts streaming immediately
+    wrote = subprocess.run(
+        [sys.executable, train, "--write-data-only",
+         "--data-dir", data_dir],
+        env=env, capture_output=True, text=True, timeout=120)
+    if wrote.returncode != 0:
+        sys.stdout.write(wrote.stdout + wrote.stderr)
+        print("chaos_check[data]: FAIL\n  - dataset writer exited %d"
+              % wrote.returncode, file=sys.stderr)
+        return 1
+    manifest = load_manifest(manifest_path(data_dir, "interactions"))
+    total = manifest["total_records"]
+
+    env["MXNET_FAULT_SPEC"] = spec
+    timeout = max(args.timeout, 150)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2",
+           "--max-restarts", str(args.max_restarts),
+           "--timeout", str(timeout),
+           sys.executable, train,
+           "--num-epochs", str(num_epochs),
+           "--data-dir", data_dir, "--ledger-dir", ledger_dir]
+    print("chaos_check[data]: %s  (MXNET_FAULT_SPEC=%s)"
+          % (" ".join(cmd), spec), flush=True)
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout + 30)
+    out = proc.stdout + proc.stderr
+    sys.stdout.write(out)
+
+    failures = []
+    if proc.returncode != 0:
+        failures.append("job exited %d" % proc.returncode)
+    if "[chaos]" not in out:
+        failures.append("fault spec never fired (no [chaos] line)")
+    if "respawning" not in out:
+        failures.append("no respawn observed")
+    if "event=data-rebalance" not in out:
+        failures.append("dead worker's shard leases were never "
+                        "rebalanced (no event=data-rebalance)")
+    resumed = re.findall(
+        r"event=data-lease dataset=\S+ epoch=\d+ shard=\d+ rank=\d+ "
+        r"cursor=([1-9]\d*) resumed=1", out)
+    if not resumed:
+        failures.append("no lease resumed at a committed mid-shard "
+                        "cursor (no data-lease line with cursor>0 "
+                        "resumed=1)")
+    counts = merge_ledgers(ledger_dir)
+    dups = {k: n for k, n in counts.items() if n != 1}
+    if dups:
+        failures.append("ledger shows %d records consumed more than "
+                        "once (e.g. %s)"
+                        % (len(dups), sorted(dups)[:3]))
+    for epoch in range(num_epochs):
+        seen = sum(1 for (e, _s, _i) in counts if e == epoch)
+        if seen != total:
+            failures.append("epoch %d consumed %d of %d records"
+                            % (epoch, seen, total))
+    extra = sorted({e for (e, _s, _i) in counts if e >= num_epochs})
+    if extra:
+        failures.append("ledger shows phantom epochs %s past the "
+                        "configured %d" % (extra, num_epochs))
+    losses = re.findall(r"worker (\d+) loss ([\d.]+) -> ([\d.]+)", out)
+    if len(losses) != 2:
+        failures.append("expected 2 worker loss reports, got %d"
+                        % len(losses))
+    for rank, loss0, loss1 in losses:
+        if not float(loss1) < float(loss0):
+            failures.append("worker %s loss did not decrease (%s -> %s)"
+                            % (rank, loss0, loss1))
+    if failures:
+        print("chaos_check[data]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[data]: OK — worker crash healed via lease "
+          "rebalance (resume cursors %s), ledger shows %d records x "
+          "%d epochs each exactly once"
+          % (",".join(resumed), total, num_epochs))
     return 0
 
 
@@ -639,13 +760,20 @@ def main():
                          "preempt, the serving-fleet replica "
                          "crash/stall and router drop kinds, the "
                          "generate stall with and without the shared-"
-                         "prefix cache, and the sharded-embedding "
-                         "server-crash case) instead of a single "
+                         "prefix cache, the sharded-embedding "
+                         "server-crash case, and the sharded-data "
+                         "worker-crash case) instead of a single "
                          "--spec")
     ap.add_argument("--embed", action="store_true",
                     help="run --spec against the sharded-embedding "
                          "recommender job (2 workers / 2 value "
                          "servers) instead of the dense trainer")
+    ap.add_argument("--data", action="store_true",
+                    help="run --spec against the recommender job "
+                         "streaming on-disk record shards through "
+                         "tracker leases (ISSUE 17): the dead "
+                         "worker's leases must rebalance and resume "
+                         "at their cursors, ledger exactly-once")
     ap.add_argument("--prefix", action="store_true",
                     help="run --spec against a GenerateServer with the "
                          "shared-prefix KV cache ON (ISSUE 16): the "
@@ -663,14 +791,18 @@ def main():
                  + GENERATE_MATRIX]
         specs += [(s, "prefix") for s in GENERATE_PREFIX_MATRIX]
         specs += [(s, "embed") for s in EMBED_MATRIX]
+        specs += [(s, "data") for s in DATA_MATRIX]
     else:
         mode = "embed" if args.embed \
-            else ("prefix" if args.prefix else None)
+            else ("data" if args.data
+                  else ("prefix" if args.prefix else None))
         specs = [(args.spec, mode)]
     rc = 0
     for spec, mode in specs:
         if mode == "embed":
             rc |= run_embed_case(args, spec)
+        elif mode == "data":
+            rc |= run_data_case(args, spec)
         elif mode == "prefix":
             rc |= run_generate_prefix_case(args, spec)
         elif _is_generate_spec(spec):
