@@ -83,6 +83,15 @@ Rules:
     once (``restart`` gating defaults to ``any`` — the serving loop
     has no incarnations).
 
+``autoscaler:crash@tick=N`` (ISSUE 18)
+    The fleet's autoscale controller hard-exits (``os._exit(137)``) at
+    its N-th control tick — the dead-controller simulation behind the
+    fail-static contract: replicas keep serving, the router keeps
+    routing, and the launcher keeps supervising at the fleet's current
+    size; only *scaling* stops. Counted in controller ticks (the
+    controller has neither steps nor requests); ``restart`` gating
+    defaults to ``any`` (the controller is not launcher-supervised).
+
 ``router:drop@[p=P,seed=S|n=N][,phase=send|reply]`` (ISSUE 11)
     Connection drop on a matching router→replica forward.
     ``phase=send`` (default) drops BEFORE the request leaves the
@@ -106,13 +115,14 @@ import sys
 _EXIT_CODE = 137  # SIGKILL'd processes report 128+9; crash mimics that
 
 _TARGETS = ("worker", "server", "replica", "rpc", "router", "heartbeat",
-            "generate")
+            "generate", "autoscaler")
 _ACTIONS = {"worker": ("crash", "nan", "preempt"),
             "server": ("crash", "preempt"),
             "replica": ("crash", "stall"),
             "rpc": ("drop",), "router": ("drop",),
             "heartbeat": ("stall",),
-            "generate": ("stall",)}
+            "generate": ("stall",),
+            "autoscaler": ("crash",)}
 
 
 class FaultSpecError(ValueError):
@@ -187,6 +197,13 @@ class _Rule:
                 raise FaultSpecError(
                     "fault rule %r: %s %s requires req=N"
                     % (self.text, self.target, self.action))
+        elif self.target == "autoscaler":
+            # autoscaler faults count control ticks — the controller
+            # has neither train steps nor admitted requests
+            if "tick" not in p:
+                raise FaultSpecError(
+                    "fault rule %r: autoscaler crash requires tick=N"
+                    % self.text)
         elif self.action in ("crash", "nan", "preempt") and "step" not in p:
             raise FaultSpecError(
                 "fault rule %r: %s requires step=N"
@@ -201,7 +218,7 @@ class _Rule:
                         "fault rule %r: %s only applies to rpc rules "
                         "(the router drop always targets the "
                         "router→replica forward)" % (self.text, bad))
-        for key in ("step", "after", "req", "n", "seed"):
+        for key in ("step", "after", "req", "n", "seed", "tick"):
             if key in p:
                 _parse_int(self.text, key, p[key])
         if "p" in p:
@@ -286,6 +303,7 @@ class ChaosEngine:
         self._beats = 0
         self._reqs = 0
         self._gen_reqs = 0
+        self._as_ticks = 0
         self._exit = os._exit  # injectable for tests
         self._kill = lambda: os.kill(os.getpid(), signal.SIGTERM)  # ditto
 
@@ -392,6 +410,24 @@ class ChaosEngine:
                 return "stall"
         return None
 
+    def autoscaler_tick(self):
+        """Count one autoscaler control tick; a matching
+        ``autoscaler:crash@tick=N`` rule hard-exits the controller —
+        the dead-controller half of the fail-static contract.
+        Role/rank-free: the controller runs outside the launcher's
+        role topology (``restart`` gating defaults to ``any``)."""
+        self._as_ticks += 1
+        for rule in self.rules:
+            if rule.target != "autoscaler" or rule.action != "crash":
+                continue
+            if not rule.restart_matches(self.restart, default="any"):
+                continue
+            if self._as_ticks == int(rule.params["tick"]) \
+                    and not rule.fired:
+                rule.fired += 1
+                self._step = self._as_ticks  # the crash log's "step"
+                self._crash(rule)
+
     def router_drop(self, phase="send"):
         """True when a matching router:drop rule fires for this
         router→replica forward attempt."""
@@ -496,6 +532,15 @@ def generate_fault():
     emit EOS, None otherwise."""
     e = engine()
     return e.generate_request() if e is not None else None
+
+
+def autoscaler_fault():
+    """Per-control-tick autoscaler hook (serving/autoscale.py): a
+    matching ``autoscaler:crash@tick=N`` rule hard-exits the
+    controller process and never returns."""
+    e = engine()
+    if e is not None:
+        e.autoscaler_tick()
 
 
 def router_fault(phase="send"):

@@ -517,6 +517,60 @@ KNOBS = {
         "(cursor committed) within the TTL returns to the pool for "
         "rebalancing; finite float > 0 (tracker.py lease books, "
         "data/service.py local authority)"),
+    # --- fleet autoscaling + multi-tenant QoS (ISSUE 18) ---
+    "MXNET_FLEET_AUTOSCALE_INTERVAL": (
+        "1.0", "honored",
+        "autoscaler control-tick period in seconds; finite float > 0 "
+        "(serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_MIN": (
+        "1", "honored",
+        "floor on the fleet's desired replica count (scale-down never "
+        "goes below it); integer >= 1, must be <= _MAX "
+        "(serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_MAX": (
+        "4", "honored",
+        "ceiling on the fleet's desired replica count; integer >= 1 "
+        "(serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_UP_LOAD": (
+        "4.0", "honored",
+        "mean queued+in-flight per serving replica at/above which a "
+        "tick votes scale-up; finite float > 0 (serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_DOWN_LOAD": (
+        "0.5", "honored",
+        "mean queued+in-flight per serving replica at/below which a "
+        "tick votes scale-down; float >= 0, must be < _UP_LOAD — the "
+        "gap between them is the anti-flap dead band "
+        "(serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_HYSTERESIS": (
+        "3", "honored",
+        "consecutive agreeing ticks required before a scale decision "
+        "acts (flap guard); integer >= 1 (serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_COOLDOWN": (
+        "5.0", "honored",
+        "seconds after a scale action during which further actions "
+        "are held (counted as holds_cooldown); float >= 0 "
+        "(serving/autoscale.py)"),
+    "MXNET_FLEET_AUTOSCALE_SLO_MS": (
+        "0", "honored",
+        "serving p99 SLO in milliseconds: any serving replica at/"
+        "above it makes the tick vote scale-up regardless of queue "
+        "depth; 0 disables the latency signal; float >= 0 "
+        "(serving/autoscale.py)"),
+    "MXNET_QOS_TENANTS": (
+        "", "honored",
+        "per-tenant QoS spec 'name[:k=v,...];...' with keys prio|"
+        "priority (latency|normal|bulk), req_rate (requests/s > 0), "
+        "tok_rate (rows/s > 0); empty disables QoS; malformed raises "
+        "naming this knob (serving/qos.py)"),
+    "MXNET_QOS_DEFAULT_PRIORITY": (
+        "normal", "honored",
+        "priority class for requests with no tenant label or an "
+        "unconfigured tenant: latency|normal|bulk (serving/qos.py)"),
+    "MXNET_QOS_BURST_SECONDS": (
+        "1.0", "honored",
+        "token-bucket burst window: a tenant may burst rate*burst "
+        "units above its steady rate; finite float > 0 "
+        "(serving/qos.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

@@ -119,6 +119,12 @@ def dump_profile():
     io = io_stats()
     if io:
         payload["ioStats"] = io
+    autoscale = autoscale_stats()
+    if autoscale:
+        payload["autoscaleStats"] = autoscale
+    qos = qos_stats()
+    if qos:
+        payload["qosStats"] = qos
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -910,6 +916,113 @@ def io_reset():
         _IO_CURSORS.clear()
         _IO_QUEUE_DEPTH_MAX = 0
         _IO_WAIT_LAT = None
+
+
+# ---------------------------------------------------------------------------
+# fleet autoscaler observability (ISSUE 18): control-loop counters —
+# ticks, scale decisions, flap-guard holds, retire outcomes — plus
+# replicas/desired gauges. One controller per fleet, so one flat dict.
+# ---------------------------------------------------------------------------
+_AUTOSCALE_LOCK = threading.Lock()
+_AUTOSCALE_ZERO = {
+    "ticks": 0, "decisions": 0, "scale_ups": 0, "scale_downs": 0,
+    "holds_hysteresis": 0, "holds_cooldown": 0, "retires": 0,
+    "retire_races": 0, "errors": 0,
+}
+_AUTOSCALE = dict(_AUTOSCALE_ZERO)
+_AUTOSCALE_GAUGES = {"replicas": 0, "desired": 0}
+_AUTOSCALE_SEEN = False
+
+
+def autoscale_record(replicas=None, desired=None, **adds):
+    """Accumulate autoscaler counters (``replicas``/``desired`` are
+    gauges — assigned, not added). Unknown counter names raise."""
+    global _AUTOSCALE_SEEN
+    with _AUTOSCALE_LOCK:
+        for k, v in adds.items():
+            if k not in _AUTOSCALE_ZERO:
+                raise ValueError(
+                    "autoscale_record: unknown counter %r" % (k,))
+            _AUTOSCALE[k] += int(v)
+        if replicas is not None:
+            _AUTOSCALE_GAUGES["replicas"] = int(replicas)
+        if desired is not None:
+            _AUTOSCALE_GAUGES["desired"] = int(desired)
+        _AUTOSCALE_SEEN = True
+
+
+def autoscale_stats(reset=False):
+    """Snapshot (counters + gauges); empty dict when no controller
+    ever ran."""
+    global _AUTOSCALE_SEEN
+    with _AUTOSCALE_LOCK:
+        seen = _AUTOSCALE_SEEN
+        snap = dict(_AUTOSCALE)
+        snap.update(_AUTOSCALE_GAUGES)
+        if reset:
+            _AUTOSCALE.update(_AUTOSCALE_ZERO)
+            _AUTOSCALE_GAUGES.update(replicas=0, desired=0)
+            _AUTOSCALE_SEEN = False
+    return snap if seen else {}
+
+
+def autoscale_reset():
+    autoscale_stats(reset=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant QoS observability (ISSUE 18): per-tenant admission
+# counters (requests / admitted / quota rejections / shed-at-dequeue /
+# rows) and a completion-latency reservoir for per-tenant p50/p99 —
+# the numbers behind "the bulk tenant sheds before the latency
+# tenant's p99 moves".
+# ---------------------------------------------------------------------------
+_QOS_LOCK = threading.Lock()
+_QOS_ZERO = {"requests": 0, "admitted": 0, "quota_rejections": 0,
+             "shed": 0, "rows": 0, "completed": 0}
+_QOS_LAT_CAP = 8192
+_QOS = {}
+
+
+def qos_record(tenant, latencies=None, **adds):
+    """Accumulate per-tenant QoS counters; ``latencies`` (seconds)
+    extend the tenant's reservoir. Unknown counter names raise."""
+    tenant = str(tenant)
+    with _QOS_LOCK:
+        s = _QOS.get(tenant)
+        if s is None:
+            from collections import deque
+
+            s = _QOS[tenant] = dict(_QOS_ZERO,
+                                    lat=deque(maxlen=_QOS_LAT_CAP))
+        for k, v in adds.items():
+            if k not in _QOS_ZERO:
+                raise ValueError("qos_record: unknown counter %r" % (k,))
+            s[k] += int(v)
+        if latencies:
+            s["lat"].extend(latencies)
+
+
+def qos_stats(reset=False):
+    """Per-tenant snapshot with p50/p99 (ms); empty dict when no
+    tenant-labelled traffic was seen."""
+    with _QOS_LOCK:
+        out = {}
+        for tenant, s in sorted(_QOS.items()):
+            snap = {k: s[k] for k in _QOS_ZERO}
+            lat = sorted(s["lat"])
+            if lat:
+                snap["p50_ms"] = _percentile_ms(lat, 0.50)
+                snap["p99_ms"] = _percentile_ms(lat, 0.99)
+            out[tenant] = snap
+        if reset:
+            _QOS.clear()
+    return out
+
+
+def qos_reset():
+    with _QOS_LOCK:
+        _QOS.clear()
 
 
 def pause():

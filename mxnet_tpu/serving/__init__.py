@@ -15,6 +15,14 @@ processes: tracker-discovered :class:`ReplicaServer` endpoints, a
 :class:`FleetRouter` with failure-classified bounded retry, typed
 health-driven draining, and zero-drop rolling checkpoint swap.
 
+The elastic tier (ISSUE 18, ``autoscale.py`` + ``qos.py``) makes the
+fleet self-regulating: a :class:`FleetAutoscaler` controller that
+grows/shrinks the replica set from tracker-published load signals
+(fail-static when it dies — the fleet keeps serving at its current
+size), and a :class:`QosPolicy` of per-tenant admission quotas and
+priority classes so bulk traffic sheds before a latency tenant's p99
+moves.
+
 The generative tier (ISSUE 12, ``generate.py`` + ``broker.py``) opens
 the autoregressive LLM decoding workload: KV-cache incremental decode
 (prefill + single-token steps against a PAGED per-layer cache,
@@ -55,4 +63,13 @@ from .fleet import (  # noqa: F401
     NoLiveReplica,
     ReplicaConnectionLost,
     ReplicaServer,
+)
+from .qos import (  # noqa: F401
+    QosPolicy,
+    TenantQuotaExceeded,
+    TokenBucket,
+)
+from .autoscale import (  # noqa: F401
+    AutoscaleError,
+    FleetAutoscaler,
 )

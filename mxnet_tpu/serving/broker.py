@@ -83,14 +83,18 @@ class ServerOverloaded(ServingError):
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("inputs", "rows", "future", "t_submit", "deadline",
+                 "tenant", "priority")
 
-    def __init__(self, inputs, rows, deadline=None):
+    def __init__(self, inputs, rows, deadline=None, tenant=None,
+                 priority=1):
         self.inputs = inputs
         self.rows = rows
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline  # absolute time.monotonic(), or None
+        self.tenant = tenant      # QoS label (ISSUE 18), or None
+        self.priority = 1 if priority is None else int(priority)
 
 
 class _ModelWorker:
@@ -141,25 +145,43 @@ class _ModelWorker:
 
     # -- worker side ---------------------------------------------------------
     def _drain_locked(self):
-        """Pop the largest ready batch: requests in FIFO order while the
-        running row total still fits the biggest bucket. Requests whose
-        deadline already expired are SHED here — at dequeue, before
-        they can occupy a batch slot (their clients have given up; an
-        overloaded server must spend its forwards on requests that are
-        still wanted). Returns (reqs, rows, shed); reqs may be empty
-        when everything queued had expired."""
+        """Pop the largest ready batch: requests in priority-then-FIFO
+        order while the running row total still fits the biggest
+        bucket. Requests whose deadline already expired are SHED here —
+        at dequeue, before they can occupy a batch slot (their clients
+        have given up; an overloaded server must spend its forwards on
+        requests that are still wanted). Priority classes (ISSUE 18)
+        reorder only when classes actually mix: a latency request jumps
+        queued bulk work, so under overload bulk waits, expires, and is
+        shed by this same discipline before a latency p99 moves. The
+        sort is stable — FIFO within a class — and the all-one-class
+        fast path is byte-identical to the PR 9 behavior. Returns
+        (reqs, rows, shed); reqs may be empty when everything queued
+        had expired."""
         cap = self.predictor.max_bucket
         now = time.monotonic()
         shed, reqs, total = [], [], 0
-        while self._q:
-            r = self._q[0]
+        queue = self._q
+        if len({r.priority for r in queue}) > 1:
+            queue = sorted(queue, key=lambda r: r.priority)
+        taken = set()
+        for r in queue:
             if r.deadline is not None and now > r.deadline:
-                shed.append(self._q.popleft())
+                shed.append(r)
+                taken.add(id(r))
                 continue
             if reqs and total + r.rows > cap:
                 break
-            reqs.append(self._q.popleft())
+            reqs.append(r)
+            taken.add(id(r))
             total += r.rows
+        if taken:
+            if len(taken) == len(self._q):
+                self._q.clear()
+            else:
+                remaining = [r for r in self._q if id(r) not in taken]
+                self._q.clear()
+                self._q.extend(remaining)
         return reqs, total, shed
 
     def _run(self):
@@ -184,6 +206,8 @@ class _ModelWorker:
                     for r in shed:
                         if not r.future.done():
                             r.future.set_exception(exc)
+                        if r.tenant is not None:
+                            profiler.qos_record(r.tenant, shed=1)
                     profiler.serving_record(self.name, shed=len(shed))
                 if not reqs:
                     continue
@@ -350,7 +374,8 @@ class ModelServer:
             raise ServerClosed("ModelServer is closed")
 
     # -- request surface -----------------------------------------------------
-    def submit(self, name, inputs, timeout=None, deadline=None):
+    def submit(self, name, inputs, timeout=None, deadline=None,
+               tenant=None, priority=None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the list of output arrays (request row count).
         Blocks for queue space up to ``timeout`` (backpressure), then
@@ -359,7 +384,10 @@ class ModelServer:
         the deadline passes, the worker drops it at dequeue and its
         future fails fast with :class:`DeadlineExceeded` instead of
         occupying a batch slot — overload protection for clients that
-        time out anyway (counted as ``shed`` in serving_stats)."""
+        time out anyway (counted as ``shed`` in serving_stats).
+        ``tenant``/``priority`` (ISSUE 18) label the request for QoS:
+        lower priority dequeues first (see qos.PRIORITIES), and sheds
+        of a labelled request are counted per tenant in qos_stats."""
         self._check_open()
         worker = self._worker(name)
         pred = worker.predictor
@@ -371,7 +399,8 @@ class ModelServer:
                 raise ServingError("submit: deadline must be > 0 "
                                    "seconds, got %r" % deadline)
             deadline = time.monotonic() + deadline
-        req = _Request(inputs, rows, deadline=deadline)
+        req = _Request(inputs, rows, deadline=deadline, tenant=tenant,
+                       priority=priority)
         depth = worker.enqueue(
             req, self._submit_timeout if timeout is None else timeout)
         profiler.serving_record(name, requests=1, queue_depth=depth)

@@ -83,6 +83,7 @@ from .broker import (
     ServerOverloaded,
 )
 from .predictor import ServingError
+from .qos import QosPolicy, TenantQuotaExceeded
 
 #: mirrors health.EXIT_PREEMPTED / launch.py: a SIGTERMed replica exits
 #: with this status and the supervisor respawns it for free
@@ -182,6 +183,10 @@ def _error_kind(exc):
         return "deadline"
     if isinstance(exc, ServerOverloaded):
         return "overloaded"
+    if isinstance(exc, TenantQuotaExceeded):
+        # terminal, never retried: the quota is the tenant's contract
+        # fleet-wide, not this replica's state
+        return "quota"
     if isinstance(exc, ServingError):
         return "bad_request"
     return "error"
@@ -192,6 +197,7 @@ _KIND_TO_ERROR = {
     "closed": ServerClosed,
     "deadline": DeadlineExceeded,
     "overloaded": ServerOverloaded,
+    "quota": TenantQuotaExceeded,
     "bad_request": ServingError,
 }
 
@@ -214,11 +220,15 @@ class ReplicaServer:
 
     def __init__(self, server, tracker_uri=None, host="127.0.0.1", port=0,
                  advertise_host=None, rank=None, restart=0,
-                 publish_interval=None, drain_timeout=None):
+                 publish_interval=None, drain_timeout=None, qos=None):
         if not isinstance(server, ModelServer):
             raise FleetError("ReplicaServer wraps a ModelServer, got %r"
                              % type(server).__name__)
         self._server = server
+        # QoS boundary (ISSUE 18): quotas enforced here too, so a
+        # deployment with several routers (or none) still caps tenants.
+        # None with an empty MXNET_QOS_TENANTS — zero per-request cost.
+        self._qos = QosPolicy.from_env() if qos is None else qos
         self._publish_interval = _knob_view_interval() \
             if publish_interval is None else float(publish_interval)
         self._drain_timeout = _knob_drain_timeout() \
@@ -263,10 +273,12 @@ class ReplicaServer:
                   default=0.0)
         p99 = max((s.get("p99_ms") or 0.0 for s in stats.values()),
                   default=0.0)
+        gen = profiler.generate_stats()
         return {"state": state, "models": self._server.models(),
                 "ladder": list(self._server._ladder),
                 "queued": self._server.pending(), "inflight": inflight,
                 "admitted": admitted, "p50_ms": p50, "p99_ms": p99,
+                "gen_occupancy": gen.get("slot_occupancy", 0.0),
                 "swap_gen": swap_gen, "pid": os.getpid()}
 
     def _publish(self):
@@ -309,9 +321,20 @@ class ReplicaServer:
                 inputs = {str(k): _np_from_wire(v)
                           for k, v in wire.items()}
             deadline = p.get("deadline")
+            tenant = p.get("tenant")
+            priority = p.get("priority")
+            if self._qos is not None:
+                sample = inputs if not isinstance(inputs, dict) \
+                    else next(iter(inputs.values()))
+                rows = int(np.asarray(sample).shape[0]) \
+                    if np.asarray(sample).ndim else 1
+                # raises the typed TenantQuotaExceeded (wire kind
+                # "quota") — never queued, never retried elsewhere
+                priority = self._qos.admit(tenant, rows=rows)
             fut = self._server.submit(
                 model, inputs,
-                deadline=float(deadline) if deadline else None)
+                deadline=float(deadline) if deadline else None,
+                tenant=tenant, priority=priority)
             outs = fut.result(
                 timeout=(float(deadline) if deadline else 60.0) + 60.0)
             return {"outputs": [_np_to_wire(o) for o in outs]}
@@ -582,13 +605,16 @@ class FleetRouter:
 
     def __init__(self, tracker_uri=None, replicas=None, view_fn=None,
                  retries=None, timeout=None, backoff=None,
-                 view_interval=None, connect_deadline=None):
+                 view_interval=None, connect_deadline=None, qos=None):
         sources = sum(x is not None for x in (tracker_uri, replicas,
                                               view_fn))
         if sources != 1:
             raise FleetError("FleetRouter: pass exactly one of "
                              "tracker_uri=, replicas=, view_fn=")
         self._tracker_uri = tracker_uri
+        # QoS admission boundary (ISSUE 18): quotas charged BEFORE the
+        # retry loop — a rejected request never queues, never retries
+        self._qos = QosPolicy.from_env() if qos is None else qos
         self._static = list(replicas) if replicas is not None else None
         self._view_fn = view_fn
         self._retries = _knob_retries() if retries is None \
@@ -740,7 +766,8 @@ class FleetRouter:
                 for h in self._handles.values())
 
     # -- request path ---------------------------------------------------------
-    def request(self, model, inputs, timeout=None, idempotent=True):
+    def request(self, model, inputs, timeout=None, idempotent=True,
+                tenant=None, priority=None):
         """Route one request; returns the list of output arrays.
 
         ``timeout`` overrides ``MXNET_FLEET_TIMEOUT`` as this request's
@@ -748,7 +775,14 @@ class FleetRouter:
         remaining budget rides to the replica as its shed deadline).
         ``idempotent=False`` disables the in-flight-loss retry: a
         request whose connection died after the send then raises
-        :class:`ReplicaConnectionLost` instead of re-executing."""
+        :class:`ReplicaConnectionLost` instead of re-executing.
+        ``tenant`` labels the request for QoS (ISSUE 18): the router
+        charges the tenant's quota HERE, before any replica is picked —
+        an over-quota request raises the typed
+        :class:`TenantQuotaExceeded` without queueing or retrying —
+        and the label rides the wire so the broker sheds by priority
+        class at dequeue. ``priority`` overrides the tenant's class
+        (an int from qos.PRIORITIES)."""
         self._check_open()
         budget = self._timeout if timeout is None else float(timeout)
         if not budget > 0:
@@ -757,6 +791,12 @@ class FleetRouter:
         deadline = time.monotonic() + budget
         if not isinstance(inputs, dict):
             inputs = {"__single__": inputs}
+        if self._qos is not None:
+            sample = np.asarray(next(iter(inputs.values())))
+            rows = int(sample.shape[0]) if sample.ndim else 1
+            admitted_priority = self._qos.admit(tenant, rows=rows)
+            if priority is None:
+                priority = admitted_priority
         wire = {k: _np_to_wire(v) for k, v in inputs.items()}
         profiler.fleet_record(requests=1)
         t0 = time.perf_counter()
@@ -798,11 +838,22 @@ class FleetRouter:
                 remaining / (attempts_left + 1.0), 0.05)
             try:
                 outs = self._forward(h, model, wire, attempt_timeout,
-                                     remaining)
+                                     remaining, tenant=tenant,
+                                     priority=priority)
                 profiler.fleet_record(
                     completed=1,
                     latencies=[time.perf_counter() - t0])
+                if tenant is not None:
+                    profiler.qos_record(
+                        str(tenant), completed=1,
+                        latencies=[time.perf_counter() - t0])
                 return outs
+            except TenantQuotaExceeded:
+                # replica-enforced quota: terminal by contract — the
+                # budget is fleet-wide per tenant, retrying elsewhere
+                # would just spend capacity circumventing it
+                profiler.fleet_record(failed=1)
+                raise
             except _NeverSent as e:
                 profiler.fleet_record(failovers=1)
                 h.cooldown_until = time.monotonic() + self._view_interval
@@ -865,7 +916,8 @@ class FleetRouter:
         handle.state = state  # routed around until the next view says
         # otherwise (the replica re-publishes on resume)
 
-    def _forward(self, h, model, wire, attempt_timeout, remaining):
+    def _forward(self, h, model, wire, attempt_timeout, remaining,
+                 tenant=None, priority=None):
         if chaos.router_fault("send"):
             raise _NeverSent("chaos: router drop (send)")
         try:
@@ -880,7 +932,8 @@ class FleetRouter:
                 sock.settimeout(attempt_timeout)
                 _send_msg(sock, ("predict", {
                     "model": model, "inputs": wire,
-                    "deadline": remaining}))
+                    "deadline": remaining, "tenant": tenant,
+                    "priority": priority}))
                 sent = True
                 if chaos.router_fault("reply"):
                     raise ConnectionError("chaos: router drop (reply)")
@@ -908,7 +961,8 @@ class FleetRouter:
         msg = (reply or {}).get("msg", "replica error")
         err_cls = _KIND_TO_ERROR.get(kind)
         if err_cls is not None and kind in ("draining", "closed",
-                                            "deadline", "overloaded"):
+                                            "deadline", "overloaded",
+                                            "quota"):
             raise err_cls("%s: %s" % (h.addr, msg))
         raise FleetRemoteError(kind, "%s: %s" % (h.addr, msg))
 
@@ -1195,12 +1249,16 @@ def _router_main(argv):
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] not in ("replica", "router"):
+    if not argv or argv[0] not in ("replica", "router", "autoscaler"):
         print("usage: python -m mxnet_tpu.serving.fleet "
-              "{replica|router} ...", file=sys.stderr)
+              "{replica|router|autoscaler} ...", file=sys.stderr)
         return 2
     if argv[0] == "replica":
         return _replica_main(argv[1:])
+    if argv[0] == "autoscaler":
+        from .autoscale import main as autoscale_main
+
+        return autoscale_main(argv[1:])
     return _router_main(argv[1:])
 
 

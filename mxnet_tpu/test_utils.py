@@ -281,7 +281,8 @@ def clean_dist_env(repo_root=None):
     for k in list(env):
         if k.startswith(("DMLC_", "MXNET_TPU_", "MXNET_PS_", "MXNET_MAX_",
                          "MXNET_CHECKPOINT_", "MXNET_FAULT_",
-                         "MXNET_EMBED_", "MXNET_DATA_")):
+                         "MXNET_EMBED_", "MXNET_DATA_",
+                         "MXNET_FLEET_AUTOSCALE_", "MXNET_QOS_")):
             del env[k]
     env["JAX_PLATFORMS"] = "cpu"
     if repo_root:

@@ -322,6 +322,8 @@ class Tracker:
         self._conns = set()         # live client connections
         # data-plane shard leases (ISSUE 17): dataset name -> lease book
         self._datasets = {}
+        # elastic scale directives (ISSUE 18): role -> latest directive
+        self._scale = {}
         self._data_ttl = env_positive_float("MXNET_DATA_LEASE_TTL", 30.0)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -808,6 +810,38 @@ class Tracker:
         with self._cv:
             return self._data_book_locked(p["name"]).snapshot()
 
+    # -- elastic scale directives (ISSUE 18) ---------------------------------
+    # The tracker is a mailbox, not a policymaker: the autoscaler writes
+    # the latest desired fleet size + retired ranks here, the launch.py
+    # supervisor polls it. Plain data only (the launcher reads it with a
+    # stdlib unpickler), monotonically sequenced so a poller applies each
+    # directive exactly once, and fail-static by construction: when no
+    # directive was ever set (or the autoscaler dies) scale_get returns
+    # the last word — or None — and the fleet keeps its current shape.
+    def _op_scale_set(self, p):
+        role = str(p.get("role", "replica"))
+        desired = int(p["desired"])
+        if desired < 0:
+            raise ValueError("scale_set: desired must be >= 0, got %d"
+                             % desired)
+        retired = sorted({int(r) for r in (p.get("retired") or ())})
+        with self._cv:
+            prev = self._scale.get(role)
+            directive = {"role": role, "desired": desired,
+                         "retired": retired,
+                         "seq": (prev["seq"] + 1) if prev else 1}
+            self._scale[role] = directive
+            self._lifecycle("scale-directive", role=role, desired=desired,
+                            retired=",".join(map(str, retired)) or "-",
+                            seq=directive["seq"])
+            self._cv.notify_all()
+            return dict(directive)
+
+    def _op_scale_get(self, p):
+        with self._cv:
+            d = self._scale.get(str(p.get("role", "replica")))
+            return dict(d) if d else None
+
     def _dispatch(self, conn_nodes, op, p):
         if op == "register":
             return self._op_register(conn_nodes, p)
@@ -841,6 +875,10 @@ class Tracker:
             return self._op_data_release(p)
         if op == "data_state":
             return self._op_data_state(p)
+        if op == "scale_set":
+            return self._op_scale_set(p)
+        if op == "scale_get":
+            return self._op_scale_get(p)
         raise ValueError("unknown op %r" % (op,))
 
     # -- connection loop -----------------------------------------------------
@@ -1101,6 +1139,20 @@ class TrackerClient:
 
     def data_state(self, name):
         return self._rpc("data_state", {"name": str(name)})
+
+    # -- elastic scale directives (ISSUE 18) ---------------------------------
+    def scale_set(self, desired, retired=(), role="replica"):
+        """Publish the autoscaler's directive (desired size + retired
+        ranks) for the launch.py supervisor to poll via ``scale_get``."""
+        return self._rpc("scale_set",
+                         {"role": str(role), "desired": int(desired),
+                          "retired": [int(r) for r in retired]},
+                         timeout=10.0)
+
+    def scale_get(self, role="replica"):
+        """Latest scale directive for ``role``, or None if none was
+        ever set (the fail-static default)."""
+        return self._rpc("scale_get", {"role": str(role)}, timeout=10.0)
 
     def done(self):
         """Report graceful completion (idempotent; swallows a dead

@@ -1,0 +1,901 @@
+"""Elastic autoscaling + multi-tenant QoS (ISSUE 18).
+
+Default tier is subprocess-free: the token-bucket / tenant-grammar /
+admission units, the router and replica quota boundaries against faked
+views and in-process ReplicaServers, the broker's priority dequeue,
+the autoscaler decide loop (stepped load 1->3->1, hysteresis, cooldown,
+dead band, retire race, fail-static) against injected seams, the
+tracker scale-directive mailbox, and the launcher's pure directive
+fold (tools/launch.py).
+
+The slow tier adds the ISSUE acceptance e2e: a real ``launch.py
+--serve`` fleet scaled 1->3->1 by a real controller subprocess under
+stepped load with zero failed requests, plus the two chaos_check
+cases (controller crash fail-static; SIGKILL mid-drain retire race).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.chaos import ChaosEngine, FaultSpecError, parse_spec
+from mxnet_tpu.serving import (
+    AutoscaleError,
+    FleetAutoscaler,
+    FleetRouter,
+    ModelServer,
+    QosPolicy,
+    ReplicaServer,
+    TenantQuotaExceeded,
+    TokenBucket,
+)
+from mxnet_tpu.serving.qos import DEFAULT_PRIORITY, PRIORITIES, parse_tenants
+from mxnet_tpu.test_utils import clean_dist_env
+from mxnet_tpu.tracker import Tracker, TrackerClient
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+DIM = 5
+
+
+@pytest.fixture(autouse=True)
+def _reset_stats():
+    profiler.fleet_reset()
+    profiler.serving_reset()
+    profiler.autoscale_reset()
+    profiler.qos_reset()
+    yield
+    profiler.fleet_reset()
+    profiler.serving_reset()
+    profiler.autoscale_reset()
+    profiler.qos_reset()
+
+
+# ---------------------------------------------------------------------------
+# knob registration + strict accessors (satellite)
+# ---------------------------------------------------------------------------
+def test_autoscale_knob_validation(monkeypatch):
+    fns = dict(members_fn=lambda: [], actuate_fn=lambda d: None)
+    for name, bad in [("MXNET_FLEET_AUTOSCALE_INTERVAL", "0"),
+                      ("MXNET_FLEET_AUTOSCALE_MIN", "0"),
+                      ("MXNET_FLEET_AUTOSCALE_MAX", "-1"),
+                      ("MXNET_FLEET_AUTOSCALE_UP_LOAD", "nan"),
+                      ("MXNET_FLEET_AUTOSCALE_DOWN_LOAD", "-2"),
+                      ("MXNET_FLEET_AUTOSCALE_HYSTERESIS", "1.5"),
+                      ("MXNET_FLEET_AUTOSCALE_COOLDOWN", "abc"),
+                      ("MXNET_FLEET_AUTOSCALE_SLO_MS", "-1")]:
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(MXNetError, match=name):
+            FleetAutoscaler(**fns)
+        monkeypatch.delenv(name)
+
+
+def test_autoscale_knob_cross_validation(monkeypatch):
+    fns = dict(members_fn=lambda: [], actuate_fn=lambda d: None)
+    monkeypatch.setenv("MXNET_FLEET_AUTOSCALE_MIN", "5")
+    monkeypatch.setenv("MXNET_FLEET_AUTOSCALE_MAX", "2")
+    with pytest.raises(MXNetError, match="MXNET_FLEET_AUTOSCALE_MIN"):
+        FleetAutoscaler(**fns)
+    monkeypatch.delenv("MXNET_FLEET_AUTOSCALE_MIN")
+    monkeypatch.delenv("MXNET_FLEET_AUTOSCALE_MAX")
+    # the dead band between down and up is the flap guard
+    monkeypatch.setenv("MXNET_FLEET_AUTOSCALE_DOWN_LOAD", "4.0")
+    monkeypatch.setenv("MXNET_FLEET_AUTOSCALE_UP_LOAD", "4.0")
+    with pytest.raises(MXNetError, match="DOWN_LOAD"):
+        FleetAutoscaler(**fns)
+    # explicit constructor args hit the same wall
+    monkeypatch.delenv("MXNET_FLEET_AUTOSCALE_DOWN_LOAD")
+    monkeypatch.delenv("MXNET_FLEET_AUTOSCALE_UP_LOAD")
+    with pytest.raises(AutoscaleError):
+        FleetAutoscaler(min_replicas=3, max_replicas=1, **fns)
+    with pytest.raises(AutoscaleError):
+        FleetAutoscaler()  # neither tracker_uri nor test seams
+
+
+def test_qos_knob_validation(monkeypatch):
+    monkeypatch.setenv("MXNET_QOS_BURST_SECONDS", "0")
+    with pytest.raises(MXNetError, match="MXNET_QOS_BURST_SECONDS"):
+        QosPolicy(tenants={})
+    monkeypatch.delenv("MXNET_QOS_BURST_SECONDS")
+    monkeypatch.setenv("MXNET_QOS_DEFAULT_PRIORITY", "vip")
+    with pytest.raises(MXNetError, match="MXNET_QOS_DEFAULT_PRIORITY"):
+        QosPolicy(tenants={})
+
+
+def test_clean_dist_env_strips_the_new_families(monkeypatch):
+    monkeypatch.setenv("MXNET_FLEET_AUTOSCALE_MIN", "2")
+    monkeypatch.setenv("MXNET_QOS_TENANTS", "bulk:prio=bulk")
+    env = clean_dist_env()
+    assert not any(k.startswith(("MXNET_FLEET_AUTOSCALE_", "MXNET_QOS_"))
+                   for k in env)
+
+
+# ---------------------------------------------------------------------------
+# token bucket + tenant grammar
+# ---------------------------------------------------------------------------
+def test_token_bucket_continuous_refill():
+    b = TokenBucket(rate=2.0, burst_seconds=1.0)  # capacity 2
+    assert b.try_take(1, now=0.0) and b.try_take(1, now=0.0)
+    assert not b.try_take(1, now=0.0), "burst exhausted"
+    assert not b.try_take(1, now=0.4), "0.8 tokens refilled, need 1"
+    assert b.try_take(1, now=0.6)
+    # capacity clamps: a long idle stretch never banks more than burst
+    assert b.try_take(2, now=100.0)
+    assert not b.try_take(1, now=100.0)
+
+
+def test_token_bucket_capacity_floor():
+    # rate*burst < 1 still admits single requests eventually
+    b = TokenBucket(rate=0.5, burst_seconds=1.0)
+    assert b.capacity == 1.0
+    assert b.try_take(1, now=0.0)
+    assert not b.try_take(1, now=1.0)
+    assert b.try_take(1, now=2.0)
+
+
+def test_tenant_grammar_parses():
+    t = parse_tenants("latency:prio=latency;"
+                      "bulk:priority=bulk,req_rate=10,tok_rate=500;"
+                      "plain")
+    assert set(t) == {"latency", "bulk", "plain"}
+    assert t["latency"]["priority"] == PRIORITIES["latency"]
+    assert t["bulk"] == {"priority": PRIORITIES["bulk"],
+                         "req_rate": 10.0, "tok_rate": 500.0}
+    assert t["plain"] == {"priority": None, "req_rate": None,
+                          "tok_rate": None}
+    assert parse_tenants("") == {}
+    assert parse_tenants(None) == {}
+
+
+@pytest.mark.parametrize("bad", [
+    ":prio=bulk",                 # empty tenant name
+    "a:prio=bulk;a:prio=latency", # duplicate tenant
+    "a:prio",                     # k without =v
+    "a:speed=fast",               # unknown key
+    "a:prio=vip",                 # unknown priority class
+    "a:req_rate=0",               # rate must be > 0
+    "a:req_rate=-3",
+    "a:tok_rate=many",
+    "a:req_rate=nan",
+])
+def test_tenant_grammar_rejects(bad):
+    with pytest.raises(MXNetError, match="MXNET_QOS_TENANTS"):
+        parse_tenants(bad)
+
+
+def test_qos_policy_from_env(monkeypatch):
+    monkeypatch.delenv("MXNET_QOS_TENANTS", raising=False)
+    assert QosPolicy.from_env() is None, \
+        "no tenants configured -> no policy object, zero request cost"
+    monkeypatch.setenv("MXNET_QOS_TENANTS", "bulk:prio=bulk,req_rate=2")
+    pol = QosPolicy.from_env()
+    assert pol is not None and pol.tenants() == ["bulk"]
+    assert pol.priority_of("bulk") == PRIORITIES["bulk"]
+    assert pol.priority_of("stranger") == DEFAULT_PRIORITY
+    assert pol.priority_of(None) == DEFAULT_PRIORITY
+
+
+def test_qos_admit_quota_and_priorities():
+    pol = QosPolicy(tenants={"bulk": {"priority": "bulk",
+                                      "req_rate": 2.0},
+                             "fat": {"tok_rate": 4.0}},
+                    burst_seconds=1.0)
+    assert pol.admit("bulk", now=0.0) == PRIORITIES["bulk"]
+    assert pol.admit("bulk", now=0.0) == PRIORITIES["bulk"]
+    with pytest.raises(TenantQuotaExceeded) as exc:
+        pol.admit("bulk", now=0.0)
+    assert exc.value.tenant == "bulk"
+    assert "never queued" in str(exc.value)
+    pol.admit("bulk", now=1.0)  # budget refills with time
+    # token budget counts ROWS, not requests
+    assert pol.admit("fat", rows=4, now=0.0) == DEFAULT_PRIORITY
+    with pytest.raises(TenantQuotaExceeded, match="token-rate"):
+        pol.admit("fat", rows=1, now=0.0)
+    # unlabelled + unknown tenants are never charged
+    for _ in range(10):
+        assert pol.admit(None, now=0.0) == DEFAULT_PRIORITY
+        assert pol.admit("anon", now=0.0) == DEFAULT_PRIORITY
+    stats = profiler.qos_stats()
+    assert stats["bulk"]["quota_rejections"] == 1
+    assert stats["bulk"]["admitted"] == 3
+    assert stats["fat"]["rows"] == 4
+
+
+# ---------------------------------------------------------------------------
+# router boundary: over-quota is typed, never queued, never retried
+# ---------------------------------------------------------------------------
+def _fake_view():
+    return [{"rank": 0, "addr": "127.0.0.1:1", "alive": True,
+             "done": False,
+             "info": {"state": "serving", "models": ["m"],
+                      "ladder": [1, 4], "queued": 0, "inflight": 0,
+                      "p50_ms": 1.0, "p99_ms": 2.0}}]
+
+
+def test_router_quota_rejects_before_any_forward(monkeypatch):
+    pol = QosPolicy(tenants={"bulk": {"priority": "bulk",
+                                      "req_rate": 1.0},
+                             "free": {"priority": "bulk"}},
+                    burst_seconds=1.0)
+    router = FleetRouter(view_fn=_fake_view, qos=pol)
+    forwards = []
+    monkeypatch.setattr(
+        FleetRouter, "_forward",
+        lambda self, h, model, wire, t, r, tenant=None, priority=None:
+        forwards.append((tenant, priority)) or {"outputs": []})
+    x = np.zeros((1, DIM), np.float32)
+    router.request("m", x, tenant="bulk")
+    assert forwards == [("bulk", PRIORITIES["bulk"])]
+    with pytest.raises(TenantQuotaExceeded):
+        router.request("m", x, tenant="bulk")
+    assert len(forwards) == 1, \
+        "an over-quota request must never reach a replica"
+    stats = profiler.fleet_stats()
+    assert stats["requests"] == 1, \
+        "over-quota is rejected before it counts as a fleet request"
+    assert stats["retries"] == 0
+    assert profiler.qos_stats()["bulk"]["quota_rejections"] == 1
+    # an explicit priority= must win over the tenant's class
+    router.request("m", x, tenant="free", priority=0, timeout=5.0)
+    assert forwards[-1] == ("free", 0)
+    router.close()
+
+
+def test_router_success_records_tenant_latency(monkeypatch):
+    router = FleetRouter(
+        view_fn=_fake_view,
+        qos=QosPolicy(tenants={"lat": {"priority": "latency"}},
+                      burst_seconds=1.0))
+    monkeypatch.setattr(
+        FleetRouter, "_forward",
+        lambda self, h, model, wire, t, r, tenant=None, priority=None:
+        {"outputs": []})
+    router.request("m", np.zeros((1, DIM), np.float32), tenant="lat")
+    stats = profiler.qos_stats()
+    assert stats["lat"]["completed"] == 1
+    assert stats["lat"]["p99_ms"] is not None
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# replica boundary: quota rides the wire as the terminal "quota" kind
+# ---------------------------------------------------------------------------
+def _linear(seed=1):
+    rng = np.random.RandomState(seed)
+    out = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    args = {"fc_weight": rng.randn(4, DIM).astype(np.float32),
+            "fc_bias": rng.randn(4).astype(np.float32)}
+    return out, args
+
+
+def test_replica_side_quota_is_terminal_over_the_wire():
+    trk = Tracker(num_workers=0, num_servers=0, heartbeat_timeout=2.0)
+    trk.serve_in_background()
+    sym, args = _linear()
+    srv = ModelServer(ladder=(1, 4))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))
+    rep = ReplicaServer(
+        srv, tracker_uri=trk.addr, publish_interval=0.2,
+        qos=QosPolicy(tenants={"bulk": {"req_rate": 1.0,
+                                        "priority": "bulk"}},
+                      burst_seconds=1.0))
+    rep.serve_in_background()
+    router = FleetRouter(tracker_uri=trk.addr, view_interval=0.2,
+                         timeout=10.0)
+    try:
+        x = np.zeros((1, DIM), np.float32)
+        router.request("m", x, tenant="bulk")
+        with pytest.raises(TenantQuotaExceeded, match="bulk"):
+            router.request("m", x, tenant="bulk")
+        assert profiler.fleet_stats()["retries"] == 0, \
+            "quota is a fleet-wide tenant contract: retrying elsewhere " \
+            "would just spend the budget twice"
+        # unlabelled traffic is untouched by the tenant's empty bucket
+        router.request("m", x)
+    finally:
+        router.close()
+        rep.shutdown()
+        trk.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# broker: priority classes order the dequeue; sheds are per-tenant
+# ---------------------------------------------------------------------------
+def test_broker_dequeues_by_priority_class():
+    sym, args = _linear()
+    srv = ModelServer(ladder=(1,))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))
+    gate = threading.Event()
+    order = []
+
+    def hook(reqs):
+        order.extend((r.tenant, r.priority) for r in reqs)
+        gate.wait(10)
+
+    srv._workers["m"]._batch_hook = hook
+    x = np.zeros((1, DIM), np.float32)
+    try:
+        first = srv.submit("m", x)          # occupies the batch loop
+        while not order:
+            time.sleep(0.01)
+        futs = [srv.submit("m", x, tenant="bulk",
+                           priority=PRIORITIES["bulk"])
+                for _ in range(2)]
+        futs += [srv.submit("m", x, tenant="lat",
+                            priority=PRIORITIES["latency"])
+                 for _ in range(2)]
+        futs.append(srv.submit("m", x))     # default class, FIFO tail
+        gate.set()
+        first.result(timeout=10)
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        gate.set()
+        srv.close()
+    assert [t for t, _p in order] == \
+        [None, "lat", "lat", None, "bulk", "bulk"], \
+        "latency dequeues first, bulk last, FIFO within a class"
+
+
+def test_broker_shed_at_dequeue_counts_per_tenant():
+    sym, args = _linear()
+    srv = ModelServer(ladder=(1,))
+    srv.add_model("m", symbol=sym, arg_params=args,
+                  data_shapes={"data": (1, DIM)})
+    srv.predict("m", np.zeros((1, DIM), np.float32))
+    gate = threading.Event()
+    srv._workers["m"]._batch_hook = lambda reqs: gate.wait(10)
+    x = np.zeros((1, DIM), np.float32)
+    try:
+        first = srv.submit("m", x)
+        time.sleep(0.05)
+        doomed = srv.submit("m", x, deadline=0.01, tenant="bulk",
+                            priority=PRIORITIES["bulk"])
+        time.sleep(0.05)                    # expires while queued
+        gate.set()
+        first.result(timeout=10)
+        from mxnet_tpu.serving import DeadlineExceeded
+
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+    finally:
+        gate.set()
+        srv.close()
+    assert profiler.qos_stats()["bulk"]["shed"] == 1, \
+        "PR 9 discipline: shed at dequeue, charged to the tenant"
+
+
+# ---------------------------------------------------------------------------
+# autoscaler decide loop (all seams injected; no sockets, no sleeps)
+# ---------------------------------------------------------------------------
+class _Fleet:
+    """Fake fleet: members view + recorded actuations/admin calls."""
+
+    def __init__(self, ranks=(0,), load=0.0, p99=1.0, occ=0.0):
+        self.ranks = list(ranks)
+        self.load = load
+        self.p99 = p99
+        self.occ = occ
+        self.directives = []
+        self.admin_calls = []
+        self.events = []
+        self.admin_raises = False
+
+    def members(self):
+        return [{"rank": r, "addr": "127.0.0.1:%d" % (1000 + r),
+                 "alive": True, "done": False,
+                 "info": {"state": "serving",
+                          "queued": int(self.load), "inflight": 0,
+                          "p99_ms": self.p99,
+                          "gen_occupancy": self.occ}}
+                for r in self.ranks]
+
+    def actuate(self, directive):
+        self.directives.append(dict(directive))
+
+    def admin(self, addr, op, payload=None, **kw):
+        self.admin_calls.append((addr, op))
+        if self.admin_raises:
+            raise ConnectionError("replica died mid-%s" % op)
+        if op == "stop":
+            rank = int(addr.rsplit(":", 1)[1]) - 1000
+            self.ranks.remove(rank)
+        return {}
+
+    def scaler(self, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 3)
+        kw.setdefault("up_load", 4.0)
+        kw.setdefault("down_load", 0.5)
+        kw.setdefault("hysteresis", 2)
+        kw.setdefault("cooldown", 10.0)
+        kw.setdefault("interval", 1.0)
+        return FleetAutoscaler(
+            members_fn=self.members, actuate_fn=self.actuate,
+            admin_fn=self.admin,
+            event_fn=lambda ev, **f: self.events.append(ev), **kw)
+
+
+def test_autoscaler_stepped_load_one_three_one():
+    """The decide-loop half of the acceptance trace: stepped load
+    drives desired 1 -> 3 -> 1; every scale-down retires the highest
+    rank through drain-then-stop."""
+    f = _Fleet(ranks=[0], load=0.0)
+    s = f.scaler(hysteresis=2, cooldown=5.0)
+    t = [0.0]
+
+    def tick():
+        t[0] += 1.0
+        return s.tick(now=t[0])
+
+    assert tick() is None and s.desired == 1   # adopt
+    f.load = 8.0                                # step up
+    assert tick() is None, "one over tick is not a trend"
+    assert tick() == "up" and s.desired == 2
+    f.ranks = [0, 1]                            # launcher spawned rank 1
+    assert tick() is None, "hysteresis: streak restarts after acting"
+    assert tick() is None, "cooldown holds even with the streak ripe"
+    t[0] += 5.0
+    assert tick() == "up" and s.desired == 3
+    f.ranks = [0, 1, 2]
+    t[0] += 5.0
+    assert tick() is None, "over at max: nowhere to go"
+    f.load = 0.0                                # step back down
+    assert tick() is None, "under-streak builds"
+    assert tick() == "down", "cooldown long since expired"
+    assert s.desired == 2 and s.retired == {2}
+    assert f.admin_calls[-2:] == [("127.0.0.1:1002", "drain"),
+                                  ("127.0.0.1:1002", "stop")]
+    assert tick() is None and tick() is None    # streak, then cooldown
+    t[0] += 10.0
+    assert tick() == "down"
+    assert s.desired == 1 and s.retired == {1, 2}
+    t[0] += 10.0
+    for _ in range(5):
+        assert tick() is None, "at min: scale-down stops"
+    assert [d["desired"] for d in f.directives] == [2, 3, 2, 1]
+    assert f.directives[-1]["retired"] == [1, 2]
+    stats = profiler.autoscale_stats()
+    assert stats["scale_ups"] == 2 and stats["scale_downs"] == 2
+    assert stats["retires"] == 2 and stats["retire_races"] == 0
+
+
+def test_autoscaler_hysteresis_and_dead_band_stop_flapping():
+    f = _Fleet(ranks=[0], load=0.0)
+    s = f.scaler(up_load=4.0, down_load=0.5, hysteresis=3, cooldown=0.0)
+    now = [0.0]
+
+    def tick(load):
+        f.load = load
+        now[0] += 1.0
+        return s.tick(now=now[0])
+
+    tick(0.0)                                   # adopt
+    # oscillating across the threshold: the dead band (between
+    # down_load and up_load) resets the streak every time
+    for load in (8.0, 8.0, 2.0, 8.0, 8.0, 2.0, 8.0, 8.0, 2.0):
+        assert tick(load) is None
+    assert f.directives == [], "no action without a sustained trend"
+    assert profiler.autoscale_stats()["holds_hysteresis"] >= 4
+    # a sustained trend still gets through
+    assert tick(8.0) is None and tick(8.0) is None
+    assert tick(8.0) == "up"
+
+
+def test_autoscaler_cooldown_holds_after_an_action():
+    f = _Fleet(ranks=[0], load=9.0)
+    s = f.scaler(hysteresis=1, cooldown=30.0, max_replicas=5)
+    # hysteresis=1: the adopt tick already satisfies the streak
+    assert s.tick(now=1.0) == "up" and s.desired == 2
+    for now in (3.0, 10.0, 30.9):
+        assert s.tick(now=now) is None, "cooldown"
+    assert profiler.autoscale_stats()["holds_cooldown"] == 3
+    assert s.tick(now=31.5) == "up"
+
+
+def test_autoscaler_slo_and_occupancy_also_trigger():
+    f = _Fleet(ranks=[0], load=0.0, p99=120.0)
+    s = f.scaler(hysteresis=1, cooldown=0.0, slo_ms=100.0)
+    assert s.tick(now=1.0) == "up", "p99 over the SLO is overload"
+    f2 = _Fleet(ranks=[0], load=0.0, occ=0.95)
+    s2 = f2.scaler(hysteresis=1, cooldown=0.0)
+    assert s2.tick(now=1.0) == "up", \
+        "generate slots saturated is overload even with a calm queue"
+
+
+def test_autoscaler_retire_race_is_terminal_and_single():
+    """A replica dying mid-drain must not be double-retired or rolled
+    back: the directive already names it, the launcher lets it go."""
+    f = _Fleet(ranks=[0, 1], load=0.0)
+    f.admin_raises = True
+    s = f.scaler(hysteresis=1, cooldown=0.0)
+    # hysteresis=1: the adopt tick already sees a calm 2-replica fleet
+    assert s.tick(now=1.0) == "down"
+    assert s.retired == {1} and s.desired == 1
+    assert f.directives[-1] == {"role": "replica", "desired": 1,
+                                "retired": [1]}
+    stats = profiler.autoscale_stats()
+    assert stats["retire_races"] == 1 and stats["retires"] == 0
+    # the dead rank is excluded from every later view; no second try
+    f.ranks = [0]
+    for now in (3.0, 4.0, 5.0):
+        assert s.tick(now=now) is None
+    assert profiler.autoscale_stats()["retire_races"] == 1
+    assert "scale-retire-race" in f.events
+
+
+def test_autoscaler_members_failure_is_fail_static():
+    calls = []
+    s = FleetAutoscaler(
+        members_fn=lambda: (_ for _ in ()).throw(OSError("tracker gone")),
+        actuate_fn=calls.append, min_replicas=1, max_replicas=3,
+        up_load=4.0, down_load=0.5)
+    for now in (1.0, 2.0, 3.0):
+        assert s.tick(now=now) is None
+    assert calls == [], "a blind controller must not steer"
+    assert profiler.autoscale_stats()["errors"] == 3
+
+
+def test_autoscaler_adopts_the_fleet_it_finds():
+    f = _Fleet(ranks=[0, 1, 2, 3, 4], load=2.0)
+    s = f.scaler(min_replicas=1, max_replicas=3)
+    s.tick(now=1.0)
+    assert s.desired == 3, "adoption clamps into [min, max]"
+
+
+def test_controller_death_by_chaos_is_fail_static(monkeypatch):
+    """autoscaler:crash@tick=N through the real hook: the injected
+    hard-exit fires at the exact tick, and nothing was actuated that
+    tick — the fleet never hears from the dying controller."""
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "autoscaler:crash@tick=3")
+    chaos.reset_engine()
+    exits = []
+    chaos.engine()._exit = exits.append
+    try:
+        f = _Fleet(ranks=[0], load=0.0)
+        s = f.scaler()
+        s.tick(now=1.0)
+        s.tick(now=2.0)
+        assert exits == [], "fired early"
+        before = list(f.directives)
+        s.tick(now=3.0)
+        assert exits == [137], "hard-exit at the third control tick"
+        assert f.directives == before
+    finally:
+        monkeypatch.delenv("MXNET_FAULT_SPEC")
+        chaos.reset_engine()
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: the autoscaler target
+# ---------------------------------------------------------------------------
+def test_chaos_autoscaler_grammar():
+    (rule,) = parse_spec("autoscaler:crash@tick=3")
+    assert (rule.target, rule.action, rule.rank) == \
+        ("autoscaler", "crash", None)
+    for bad in ("autoscaler:crash@step=3",   # ticks, not steps
+                "autoscaler:crash@req=3",
+                "autoscaler:stall@tick=3",   # crash is the only action
+                "autoscaler:crash@tick=x"):
+        with pytest.raises(FaultSpecError):
+            parse_spec(bad)
+
+
+def test_chaos_autoscaler_fires_once_at_exact_tick():
+    eng = ChaosEngine("autoscaler:crash@tick=2", role="worker", rank=0,
+                      restart=1)
+    exits = []
+    eng._exit = exits.append
+    eng.autoscaler_tick()
+    assert exits == []
+    eng.autoscaler_tick()
+    assert exits == [137], "restart gating defaults to any: the " \
+        "controller is not launcher-supervised"
+    for _ in range(3):
+        eng.autoscaler_tick()
+    assert exits == [137], "fires once"
+
+
+# ---------------------------------------------------------------------------
+# tracker mailbox + the launcher's pure directive fold
+# ---------------------------------------------------------------------------
+def test_tracker_scale_directive_roundtrip():
+    trk = Tracker(num_workers=0, num_servers=0)
+    trk.serve_in_background()
+    c = TrackerClient(trk.addr, role="replica", rank=0)
+    try:
+        assert c.scale_get() is None, "no directive until one is set"
+        d1 = c.scale_set(desired=2, retired=())
+        d2 = c.scale_set(desired=1, retired=[2, 1])
+        assert (d1["seq"], d2["seq"]) == (1, 2), "seq is monotonic"
+        got = c.scale_get()
+        assert got["desired"] == 1 and got["retired"] == [1, 2]
+        assert c.scale_get(role="worker") is None, "per-role mailbox"
+        with pytest.raises(Exception):
+            c.scale_set(desired=-1)
+    finally:
+        c.close()
+        trk.shutdown()
+
+
+def test_launcher_directive_fold_is_pure_and_capped():
+    import launch
+
+    class N:
+        def __init__(self, rank, failed=False, finished=False):
+            self.rank, self.failed, self.finished = rank, failed, finished
+
+    workers = [N(0), N(1), N(2, failed=True)]
+    # stale seq: no-op
+    spawn, newly, seq = launch._apply_scale_directive(
+        {"seq": 3, "desired": 5, "retired": []}, workers, set(), 3, "replica")
+    assert (spawn, newly, seq) == ([], set(), 3)
+    # scale up: failed nodes don't count as active; fresh ranks fill
+    spawn, newly, seq = launch._apply_scale_directive(
+        {"seq": 4, "desired": 4, "retired": []}, workers, set(), 3, "replica")
+    assert (spawn, newly, seq) == ([3, 4], set(), 4)
+    # retire folds once; retired ranks leave the active count
+    spawn, newly, seq = launch._apply_scale_directive(
+        {"seq": 5, "desired": 1, "retired": [1]}, workers, set(), 4, "replica")
+    assert (spawn, newly, seq) == ([], {1}, 5)
+    spawn, newly, seq = launch._apply_scale_directive(
+        {"seq": 6, "desired": 2, "retired": [1]}, workers, {1}, 5, "replica")
+    assert (spawn, newly, seq) == ([3], set(), 6), \
+        "desired 2 with only rank 0 active spawns one fresh rank"
+    # a corrupt directive cannot fork-bomb the host
+    spawn, _n, _s = launch._apply_scale_directive(
+        {"seq": 7, "desired": 10 ** 9, "retired": []}, [N(0)], set(), 6,
+        "replica")
+    assert len(spawn) == launch.FLEET_SIZE_CAP - 1
+
+
+def test_launcher_fold_never_resurrects_a_stopped_fleet():
+    """Regression: a directive published before the router's fleet stop
+    must not refill cleanly-finished replicas afterwards. The race: the
+    autoscaler pushes desired=1 + retire, drains the victim (exit 0,
+    classified finished before the cadence poll folds the directive),
+    the router then stops the survivor (exit 0, finished) — and only
+    THEN does the launcher's poll fold the directive. With every
+    replica finished the old fold saw active=0 < desired=1 and spawned
+    a fresh rank nobody would ever stop, so launch.py never exited."""
+    import launch
+
+    class N:
+        def __init__(self, rank, failed=False, finished=False):
+            self.rank, self.failed, self.finished = rank, failed, finished
+
+    # all three replicas exited cleanly (retire-drain x2 + fleet stop)
+    workers = [N(0, finished=True), N(1, finished=True),
+               N(2, finished=True)]
+    spawn, newly, seq = launch._apply_scale_directive(
+        {"seq": 4, "desired": 1, "retired": [1, 2]}, workers, set(), 3,
+        "replica")
+    assert spawn == [], "stopped capacity is never refilled"
+    assert newly == {1, 2} and seq == 4
+    # partial stop: rank 0 still live, rank 1 deliberately stopped —
+    # a late scale-up fold must not replace the stopped one either
+    workers = [N(0), N(1, finished=True)]
+    spawn, _n, _s = launch._apply_scale_directive(
+        {"seq": 5, "desired": 2, "retired": []}, workers, set(), 4,
+        "replica")
+    assert spawn == [], "clean exits count against the gap"
+    # ...but genuinely missing capacity (no clean exits) still fills
+    spawn, _n, _s = launch._apply_scale_directive(
+        {"seq": 6, "desired": 2, "retired": []}, [N(0)], set(), 5,
+        "replica")
+    assert spawn == [1]
+
+
+def test_launcher_scale_poll_refuses_code_bearing_pickles():
+    import io
+    import pickle
+
+    import launch
+
+    evil = pickle.dumps({"find": os.getpid})
+    with pytest.raises(pickle.UnpicklingError, match="plain data"):
+        launch._PlainUnpickler(io.BytesIO(evil)).load()
+
+
+# ---------------------------------------------------------------------------
+# profiler families (satellite: typo-loud counters, dump_profile ride)
+# ---------------------------------------------------------------------------
+def test_profiler_autoscale_family_contract():
+    assert profiler.autoscale_stats() == {}, "empty until seen"
+    profiler.autoscale_record(ticks=1, scale_ups=1, replicas=2, desired=3)
+    s = profiler.autoscale_stats()
+    assert s["ticks"] == 1 and s["scale_ups"] == 1
+    assert (s["replicas"], s["desired"]) == (2, 3), "gauges, not sums"
+    profiler.autoscale_record(replicas=1)
+    assert profiler.autoscale_stats()["replicas"] == 1
+    with pytest.raises(ValueError, match="unknown counter"):
+        profiler.autoscale_record(scale_upz=1)
+    assert profiler.autoscale_stats(reset=True)["ticks"] == 1
+    assert profiler.autoscale_stats() == {}
+
+
+def test_profiler_qos_family_contract():
+    assert profiler.qos_stats() == {}
+    profiler.qos_record("bulk", requests=2, admitted=1, rows=8,
+                        latencies=[0.01, 0.02])
+    with pytest.raises(ValueError, match="unknown counter"):
+        profiler.qos_record("bulk", sheds=1)
+    s = profiler.qos_stats()
+    assert s["bulk"]["requests"] == 2 and s["bulk"]["rows"] == 8
+    assert s["bulk"]["p50_ms"] is not None
+
+
+def test_dump_profile_carries_both_families(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="symbolic", filename=fname)
+    profiler.profiler_set_state("run")
+    profiler.autoscale_record(ticks=3, replicas=1, desired=1)
+    profiler.qos_record("bulk", shed=2)
+    try:
+        profiler.dump_profile()
+    finally:
+        profiler.profiler_set_state("stop")
+    import json
+
+    with open(fname) as f:
+        payload = json.load(f)
+    assert payload["autoscaleStats"]["ticks"] == 3
+    assert payload["qosStats"]["bulk"]["shed"] == 2
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the ISSUE acceptance e2e through real processes
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_e2e_stepped_load_scales_one_three_one():
+    """launch.py --serve fleet of 1, a REAL controller subprocess, and
+    stepped load: the fleet must grow under load (launcher spawns the
+    directive's fresh ranks), shrink back to 1 when it fades
+    (drain-then-stop retires, zero drops), with every request served."""
+    from mxnet_tpu.serving.autoscale import _TrackerLink
+    from bench_serve import REPLICA_BOOT_CODE, build_model
+    from mxnet_tpu.model import save_checkpoint
+    from mxnet_tpu import nd
+    import socket
+    import tempfile
+
+    sym, args_np = build_model(16, 32, 2, 4)
+    tmpdir = tempfile.mkdtemp(prefix="autoscale_e2e_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, sym,
+                    {k: nd.array(v) for k, v in args_np.items()}, {})
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    env = clean_dist_env(repo_root=ROOT)
+    fleet = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "--serve", "-n", "1", "--max-restarts", "2",
+         "--coordinator", coord, "--timeout", "240",
+         sys.executable, "-c", REPLICA_BOOT_CODE, "replica",
+         "--prefix", prefix, "--epoch", "0",
+         "--data-shape", "data:1,16", "--ladder", "1,4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    fleet_box = {"out": ""}
+
+    def _drain_fleet():
+        fleet_box["out"] = fleet.stdout.read()
+
+    threading.Thread(target=_drain_fleet, daemon=True).start()
+    scaler = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.serving.autoscale",
+         "--tracker", coord, "--min", "1", "--max", "3",
+         "--interval", "0.25", "--up-load", "1.5", "--down-load",
+         "0.25", "--hysteresis", "2", "--cooldown", "1.0"],
+        env=clean_dist_env(repo_root=ROOT), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    router = None
+    errors = []
+    try:
+        router = FleetRouter(tracker_uri=coord, view_interval=0.25,
+                             timeout=20.0)
+        deadline = time.monotonic() + 90
+        while sum(1 for _a, st, alive, _l in router.replicas()
+                  if alive and st == "serving") < 1:
+            assert time.monotonic() < deadline, "fleet never came up"
+            time.sleep(0.25)
+            router.refresh_view(force=True)
+
+        def count_serving():
+            router.refresh_view(force=True)
+            return sum(1 for _a, st, alive, _l in router.replicas()
+                       if alive and st == "serving")
+
+        x = np.zeros((1, 16), np.float32)
+        stop = threading.Event()
+
+        def client(seed):
+            while not stop.is_set():
+                try:
+                    router.request("model", x, timeout=20.0)
+                except Exception as e:
+                    errors.append("%s: %s" % (type(e).__name__, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        while count_serving() < 3:
+            assert time.monotonic() < deadline, \
+                "fleet never scaled to 3 under load"
+            time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        deadline = time.monotonic() + 120
+        while count_serving() > 1:
+            assert time.monotonic() < deadline, \
+                "fleet never scaled back to 1 after the load faded"
+            time.sleep(0.5)
+        # a few requests against the settled fleet
+        for _ in range(5):
+            router.request("model", x, timeout=20.0)
+        assert errors == [], \
+            "zero failed requests across every scale event: %s" \
+            % errors[:3]
+        link = _TrackerLink(coord)
+        directive = link.rpc("scale_get", {"role": "replica"})
+        link.close()
+        assert directive["desired"] == 1 and len(directive["retired"]) == 2
+    finally:
+        stop_set = locals().get("stop")
+        if stop_set is not None:
+            stop_set.set()
+        scaler.terminate()
+        try:
+            scaler.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            scaler.kill()
+        if router is not None:
+            try:
+                router.stop_fleet()
+            except Exception:
+                pass
+            router.close()
+    rc = fleet.wait(timeout=120)
+    time.sleep(0.5)  # let the drain thread swallow the tail
+    out = fleet_box["out"]
+    assert rc == 0, out[-3000:]
+    assert "scale-up directive: spawning" in out
+    assert "rank 1 retired" in out or "rank 2 retired" in out, out[-2000:]
+
+
+@pytest.mark.slow
+def test_e2e_chaos_controller_crash_fail_static():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_check.py"),
+         "--spec", "autoscaler:crash@tick=3", "--autoscale",
+         "--timeout", "120"],
+        env=clean_dist_env(repo_root=ROOT), capture_output=True,
+        text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_e2e_chaos_scale_down_race():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_check.py"),
+         "--autoscale-race", "--timeout", "120"],
+        env=clean_dist_env(repo_root=ROOT), capture_output=True,
+        text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

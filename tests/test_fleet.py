@@ -183,7 +183,8 @@ def test_drained_rejection_is_retried_but_genuine_failure_is_not():
     view = [_entry("a:1", 0), _entry("b:2", 1)]
     calls = []
 
-    def forward(h, model, wire, attempt_timeout, remaining):
+    def forward(h, model, wire, attempt_timeout, remaining,
+                tenant=None, priority=None):
         calls.append(h.addr)
         if h.addr == "a:1":
             raise ReplicaDraining("a:1 draining")
@@ -196,7 +197,8 @@ def test_drained_rejection_is_retried_but_genuine_failure_is_not():
 
     calls.clear()
 
-    def forward_fail(h, model, wire, attempt_timeout, remaining):
+    def forward_fail(h, model, wire, attempt_timeout, remaining,
+                     tenant=None, priority=None):
         calls.append(h.addr)
         raise FleetRemoteError("bad_request", "unknown input")
 
@@ -210,7 +212,8 @@ def test_never_sent_retries_even_non_idempotent():
     view = [_entry("a:1", 0), _entry("b:2", 1)]
     calls = []
 
-    def forward(h, model, wire, attempt_timeout, remaining):
+    def forward(h, model, wire, attempt_timeout, remaining,
+                tenant=None, priority=None):
         calls.append(h.addr)
         if len(calls) == 1:
             raise _NeverSent("connect refused")
@@ -228,7 +231,8 @@ def test_inflight_loss_retries_only_idempotent():
     view = [_entry("a:1", 0), _entry("b:2", 1)]
     calls = []
 
-    def forward(h, model, wire, attempt_timeout, remaining):
+    def forward(h, model, wire, attempt_timeout, remaining,
+                tenant=None, priority=None):
         calls.append(h.addr)
         if len(calls) == 1:
             raise ReplicaConnectionLost("sent, no reply")
@@ -251,7 +255,8 @@ def test_overload_raises_typed_fleet_overloaded():
     view = [_entry("a:1", 0), _entry("b:2", 1)]
     calls = []
 
-    def forward(h, model, wire, attempt_timeout, remaining):
+    def forward(h, model, wire, attempt_timeout, remaining,
+                tenant=None, priority=None):
         calls.append(h.addr)
         raise ServerOverloaded("queue full")
 
@@ -262,7 +267,7 @@ def test_overload_raises_typed_fleet_overloaded():
     stats = profiler.fleet_stats()
     assert stats["overload_rejections"] == 3 and stats["failed"] == 1
     # a replica-side deadline shed routes through the same typed path
-    router2 = _stub_router(view, lambda *a: (_ for _ in ()).throw(
+    router2 = _stub_router(view, lambda *a, **kw: (_ for _ in ()).throw(
         DeadlineExceeded("shed at dequeue")))
     with pytest.raises(FleetOverloaded):
         router2.request("m", np.zeros((1, DIM), np.float32))
@@ -271,11 +276,11 @@ def test_overload_raises_typed_fleet_overloaded():
 def test_no_live_replica_is_typed():
     router = _stub_router([_entry("a:1", 0, state="draining"),
                            _entry("b:2", 1, alive=False)],
-                          lambda *a: ["never"])
+                          lambda *a, **kw: ["never"])
     with pytest.raises(NoLiveReplica):
         router.request("m", np.zeros((1, DIM), np.float32))
     with pytest.raises(NoLiveReplica):
-        _stub_router([], lambda *a: ["never"]).request(
+        _stub_router([], lambda *a, **kw: ["never"]).request(
             "m", np.zeros((1, DIM), np.float32))
 
 
@@ -286,7 +291,8 @@ def test_least_loaded_selection_and_model_filter():
             _entry("e:5", 4, queued=0, models=("other",))]
     calls = []
 
-    def forward(h, model, wire, attempt_timeout, remaining):
+    def forward(h, model, wire, attempt_timeout, remaining,
+                tenant=None, priority=None):
         calls.append(h.addr)
         return ["ok"]
 
@@ -308,7 +314,7 @@ def test_backoff_grows_and_respects_budget():
     t0 = time.perf_counter()
     router = FleetRouter(view_fn=lambda: view, retries=2, timeout=10.0,
                          backoff=0.05, view_interval=0.05)
-    router._forward = lambda *a: (_ for _ in ()).throw(
+    router._forward = lambda *a, **kw: (_ for _ in ()).throw(
         ServerOverloaded("full"))
     with pytest.raises(FleetOverloaded):
         router.request("m", np.zeros((1, DIM), np.float32))
@@ -317,7 +323,7 @@ def test_backoff_grows_and_respects_budget():
     # a tight budget cuts the retry loop off early with the typed error
     router2 = FleetRouter(view_fn=lambda: view, retries=50, timeout=0.3,
                           backoff=0.05, view_interval=0.05)
-    router2._forward = lambda *a: (_ for _ in ()).throw(
+    router2._forward = lambda *a, **kw: (_ for _ in ()).throw(
         ServerOverloaded("full"))
     t0 = time.perf_counter()
     with pytest.raises(FleetOverloaded, match="budget"):

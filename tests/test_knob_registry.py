@@ -70,6 +70,25 @@ def test_allowlist_entries_are_still_in_use():
         % stale)
 
 
+def test_autoscale_and_qos_knobs_are_registered():
+    """The ISSUE 18 knob surface, by name: the autoscaler's control
+    loop and the tenant QoS grammar are operator-facing — a rename
+    that forgets the registry entry must fail here, not in a fleet."""
+    for name in ("MXNET_FLEET_AUTOSCALE_INTERVAL",
+                 "MXNET_FLEET_AUTOSCALE_MIN",
+                 "MXNET_FLEET_AUTOSCALE_MAX",
+                 "MXNET_FLEET_AUTOSCALE_UP_LOAD",
+                 "MXNET_FLEET_AUTOSCALE_DOWN_LOAD",
+                 "MXNET_FLEET_AUTOSCALE_HYSTERESIS",
+                 "MXNET_FLEET_AUTOSCALE_COOLDOWN",
+                 "MXNET_FLEET_AUTOSCALE_SLO_MS",
+                 "MXNET_QOS_TENANTS",
+                 "MXNET_QOS_DEFAULT_PRIORITY",
+                 "MXNET_QOS_BURST_SECONDS"):
+        assert name in config.KNOBS, name
+        assert config.KNOBS[name][1] == "honored", name
+
+
 def test_new_self_healing_knobs_are_registered():
     """The ISSUE 9 knob surface, by name (a rename that forgets the
     registry entry must fail here, not in a job)."""

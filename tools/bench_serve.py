@@ -439,6 +439,302 @@ def measure_fleet(replicas=3, clients=24, seconds=6.0, think_ms=1.0,
 
 
 # ---------------------------------------------------------------------------
+# autoscale mode (ISSUE 18): a stepped offered load (low → high → low)
+# against an ELASTIC fleet — in-process FleetAutoscaler actuating real
+# replica subprocesses — vs the same trace against the static
+# initial-size fleet, plus a two-tenant QoS trace (bulk capped at its
+# quota, the latency tenant's p99 compared with and without the flood).
+# ---------------------------------------------------------------------------
+def _qos_client(router, stop_at, think_s, dim, rows, seed, out, tenant):
+    """Closed-loop client labelled with a tenant. Typed quota
+    rejections (the bulk tenant hitting its budget) and overload sheds
+    are EXPECTED and counted separately from genuine failures."""
+    import numpy as np
+
+    from mxnet_tpu.serving import FleetOverloaded, TenantQuotaExceeded
+
+    rng = random.Random(seed)
+    nrng = np.random.RandomState(seed)
+    x = nrng.randn(rows, dim).astype(np.float32)
+    lat, quota, overloaded, errors = [], 0, 0, []
+    while time.perf_counter() < stop_at:
+        if think_s > 0:
+            time.sleep(rng.expovariate(1.0 / think_s))
+        t0 = time.perf_counter()
+        try:
+            router.request("model", x, timeout=20.0, tenant=tenant)
+            lat.append(time.perf_counter() - t0)
+        except TenantQuotaExceeded:
+            # typed rejection at admission: back off like a real bulk
+            # client would (otherwise the rejection loop busy-spins and
+            # the measurement charges CPU contention, not queueing, to
+            # the latency tenant)
+            quota += 1
+            time.sleep(0.01)
+        except FleetOverloaded:
+            overloaded += 1
+        except Exception as e:
+            errors.append("%s: %s" % (type(e).__name__, e))
+    out.append((lat, quota, overloaded, errors))
+
+
+def _drive_phase(router, clients, seconds, think_ms, dim, rows, seed0,
+                 tenant=None):
+    """One load phase: ``clients`` closed-loop threads for ``seconds``;
+    returns the phase record."""
+    results = []
+    stop_at = time.perf_counter() + seconds
+    threads = [threading.Thread(
+        target=_qos_client,
+        args=(router, stop_at, think_ms / 1e3, dim, rows, seed0 + i,
+              results, tenant)) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lats = sorted(x for lat, _q, _o, _e in results for x in lat)
+    errors = [e for _l, _q, _o, es in results for e in es]
+    return {
+        "clients": clients,
+        "requests": len(lats),
+        "failed": len(errors),
+        "failed_examples": errors[:3],
+        "quota_rejected": sum(q for _l, q, _o, _e in results),
+        "overloaded": sum(o for _l, _q, o, _e in results),
+        "p50_ms": round(_pctl(lats, 0.50) * 1e3, 2) if lats else None,
+        "p99_ms": round(_pctl(lats, 0.99) * 1e3, 2) if lats else None,
+    }
+
+
+def run_autoscale_mode(prefix, dim, phases, think_ms, rows,
+                       autoscale, max_replicas=3):
+    """One stepped-load trace against a fleet that starts at 1 replica.
+    With ``autoscale`` an in-process :class:`FleetAutoscaler` reads the
+    tracker and actuates replica subprocesses directly (the bench
+    plays the launcher's half through the ``actuate_fn`` seam);
+    without it the fleet is the static baseline. Returns the trace
+    record: per-phase p50/p99 + the replica trajectory."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetRouter
+    from mxnet_tpu.serving.autoscale import FleetAutoscaler
+    from mxnet_tpu.tracker import Tracker
+
+    tracker = Tracker(num_workers=0, num_servers=0)
+    tracker.serve_in_background()
+    procs = {0: _spawn_replica(0, tracker.addr, prefix, dim, (1, 4, 16))}
+    profiler.fleet_reset()
+    profiler.autoscale_reset()
+    router = FleetRouter(tracker_uri=tracker.addr, view_interval=0.25,
+                         timeout=20.0)
+    scaler = None
+    scaler_thread = None
+    retired = set()
+
+    def actuate(directive):
+        # the launcher's half, in-process: retire set is the
+        # autoscaler's (it drains + stops the victim itself over the
+        # admin wire); scale-up spawns fresh ranks to fill desired
+        retired.update(int(r) for r in directive.get("retired") or ())
+        live = [r for r, p in procs.items()
+                if r not in retired and p.poll() is None]
+        next_rank = max(procs) + 1
+        for r in range(next_rank,
+                       next_rank + max(int(directive["desired"])
+                                       - len(live), 0)):
+            procs[r] = _spawn_replica(r, tracker.addr, prefix, dim,
+                                      (1, 4, 16))
+
+    try:
+        deadline = time.monotonic() + 120
+        while sum(1 for _a, s, alive, _l in router.replicas()
+                  if alive and s == "serving") < 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never came up")
+            time.sleep(0.25)
+            router.refresh_view(force=True)
+        if autoscale:
+            scaler = FleetAutoscaler(
+                tracker_uri=tracker.addr, actuate_fn=actuate,
+                min_replicas=1, max_replicas=max_replicas,
+                interval=0.25, up_load=2.0, down_load=0.25,
+                hysteresis=2, cooldown=1.0)
+            scaler_thread = threading.Thread(target=scaler.run_forever,
+                                             daemon=True)
+            scaler_thread.start()
+        recs = []
+        peak = 1
+        for i, (clients, seconds) in enumerate(phases):
+            rec = _drive_phase(router, clients, seconds, think_ms, dim,
+                               rows, 3000 + 100 * i)
+            router.refresh_view(force=True)
+            serving = sum(1 for _a, s, alive, _l in router.replicas()
+                          if alive and s == "serving")
+            peak = max(peak, serving)
+            rec["replicas_after"] = serving
+            recs.append(rec)
+        if autoscale:
+            # let the scale-down streak + cooldown settle before
+            # reading the final size
+            time.sleep(4.0)
+            router.refresh_view(force=True)
+        final = sum(1 for _a, s, alive, _l in router.replicas()
+                    if alive and s == "serving")
+        out = {
+            "phases": recs,
+            "replicas_peak": peak,
+            "replicas_final": final,
+            "requests": sum(r["requests"] for r in recs),
+            "failed": sum(r["failed"] for r in recs),
+        }
+        if autoscale:
+            out["autoscale"] = profiler.autoscale_stats(reset=True)
+        return out
+    finally:
+        if scaler is not None:
+            scaler.close()
+            scaler_thread.join(timeout=10)
+        try:
+            router.stop_fleet()
+        except Exception:
+            pass
+        router.close()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        tracker.shutdown()
+
+
+def run_two_tenant_mode(prefix, dim, seconds, think_ms, rows,
+                        bulk_req_rate=25.0):
+    """The QoS half: the latency tenant's p99 measured alone, then
+    with a bulk-tenant flood sharing the fleet — bulk capped at its
+    request-rate quota (typed rejections at admission, never queued),
+    latency priority class ahead of bulk at the broker."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetRouter, QosPolicy
+    from mxnet_tpu.tracker import Tracker
+
+    policy = QosPolicy(
+        tenants={"latency": {"priority": "latency"},
+                 "bulk": {"priority": "bulk",
+                          "req_rate": bulk_req_rate}},
+        burst_seconds=1.0)
+    tracker = Tracker(num_workers=0, num_servers=0)
+    tracker.serve_in_background()
+    procs = [_spawn_replica(0, tracker.addr, prefix, dim, (1, 4, 16))]
+    profiler.fleet_reset()
+    profiler.qos_reset()
+    router = FleetRouter(tracker_uri=tracker.addr, view_interval=0.5,
+                         timeout=20.0, qos=policy)
+    try:
+        deadline = time.monotonic() + 120
+        while sum(1 for _a, s, alive, _l in router.replicas()
+                  if alive and s == "serving") < 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never came up")
+            time.sleep(0.25)
+            router.refresh_view(force=True)
+        alone = _drive_phase(router, 4, seconds, think_ms, dim, rows,
+                             5000, tenant="latency")
+        profiler.qos_reset()
+        results = []
+        stop_at = time.perf_counter() + seconds
+        threads = [threading.Thread(
+            target=_qos_client,
+            args=(router, stop_at, think_ms / 1e3, dim, rows, 6000 + i,
+                  results, "latency")) for i in range(4)]
+        threads += [threading.Thread(
+            target=_qos_client,
+            args=(router, stop_at, think_ms / 1e3, dim, rows, 7000 + i,
+                  results, "bulk")) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lat_lats = sorted(x for lat, _q, _o, _e in results[:4]
+                          for x in lat)
+        qos = profiler.qos_stats(reset=True)
+        together = {
+            "latency_p99_ms": round(_pctl(lat_lats, 0.99) * 1e3, 2)
+            if lat_lats else None,
+            "latency_requests": len(lat_lats),
+            "qos": qos,
+        }
+        return {
+            "bulk_req_rate": bulk_req_rate,
+            "seconds": seconds,
+            "latency_alone": alone,
+            "together": together,
+            "bulk_admitted": qos.get("bulk", {}).get("admitted", 0),
+            "bulk_quota_rejections":
+                qos.get("bulk", {}).get("quota_rejections", 0),
+        }
+    finally:
+        try:
+            router.stop_fleet()
+        except Exception:
+            pass
+        router.close()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        tracker.shutdown()
+
+
+def measure_autoscale(seconds=5.0, think_ms=1.0, dim=128, hidden=256,
+                      layers=4, classes=32, rows=1, max_replicas=3,
+                      low_clients=2, high_clients=16):
+    """The --autoscale record: the stepped trace low→high→low against
+    the elastic fleet vs the static 1-replica baseline (the headline
+    number is the high-phase p99 ratio), plus the two-tenant QoS
+    trace. CPU-honest: the record carries the core count — on a small
+    host the elastic fleet's replicas contend for the same cores and
+    the p99 gap narrows."""
+    import jax
+
+    from mxnet_tpu.model import save_checkpoint
+
+    symbol, args_np = build_model(dim, hidden, layers, classes)
+    tmpdir = tempfile.mkdtemp(prefix="bench_autoscale_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, symbol,
+                    {k: _nd(v) for k, v in args_np.items()}, {})
+    cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
+    phases = [(low_clients, seconds), (high_clients, seconds),
+              (low_clients, seconds)]
+    static = run_autoscale_mode(prefix, dim, phases, think_ms, rows,
+                                autoscale=False,
+                                max_replicas=max_replicas)
+    elastic = run_autoscale_mode(prefix, dim, phases, think_ms, rows,
+                                 autoscale=True,
+                                 max_replicas=max_replicas)
+    qos = run_two_tenant_mode(prefix, dim, seconds, think_ms, rows)
+    high_e = elastic["phases"][1]["p99_ms"]
+    high_s = static["phases"][1]["p99_ms"]
+    return {
+        "metric": "autoscale_high_phase_p99",
+        "value": high_e,
+        "unit": "ms",
+        "static_high_p99_ms": high_s,
+        "p99_ratio_vs_static": round(high_e / high_s, 3)
+        if high_e and high_s else None,
+        "elastic": elastic,
+        "static": static,
+        "two_tenant": qos,
+        "phases": [{"clients": c, "seconds": s} for c, s in phases],
+        "think_ms": think_ms,
+        "cores": cores,
+        "model": {"dim": dim, "hidden": hidden, "layers": layers},
+        "backend": jax.default_backend(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # generate mode (ISSUE 12): continuous batching vs drain-whole-batch on
 # an autoregressive decode workload — Poisson arrivals, sampled
 # prompt/output lengths, tokens/s + p99 TTFT + slot occupancy.
@@ -955,6 +1251,14 @@ def main():
                          "--replicas replica PROCESSES behind a "
                          "FleetRouter, with a mid-run replica SIGKILL")
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="autoscale mode (ISSUE 18): stepped load "
+                         "low→high→low against an elastic fleet "
+                         "(in-process FleetAutoscaler actuating "
+                         "replica subprocesses) vs the static "
+                         "1-replica baseline, plus a two-tenant QoS "
+                         "trace — bulk capped at its quota, latency "
+                         "tenant p99 with and without the flood")
     ap.add_argument("--generate", action="store_true",
                     help="generate mode (ISSUE 12): autoregressive "
                          "decode under Poisson arrivals — continuous "
@@ -1005,6 +1309,12 @@ def main():
     elif args.generate:
         rec = measure_generate(requests=args.requests, rate=args.rate,
                                slots=args.slots, page_size=args.page_size)
+    elif args.autoscale:
+        rec = measure_autoscale(seconds=args.seconds,
+                                think_ms=args.think_ms, dim=args.dim,
+                                hidden=args.hidden, layers=args.layers,
+                                rows=args.rows,
+                                max_replicas=args.replicas)
     elif args.fleet:
         rec = measure_fleet(replicas=args.replicas, clients=args.clients,
                             seconds=args.seconds, think_ms=args.think_ms,

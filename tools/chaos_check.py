@@ -58,6 +58,20 @@ free only its PRIVATE pages, the surviving borrowers' outputs must be
 bit-identical to a no-fault run, and the pool must drain to exactly
 the index's pins — then to zero after ``clear_prefix``.
 
+Elastic-autoscaler kinds (ISSUE 18; a launch.py --serve fleet plus a
+REAL autoscale controller subprocess):
+
+- ``autoscaler:crash@tick=N`` (``--autoscale``): the controller
+  hard-exits mid-run — fail-static means the fleet keeps serving every
+  request at its current size (zero failed, membership unchanged) and
+  the job still exits 0; only *scaling* stops.
+- scale-down race (``--autoscale-race``, driven by a fleet-side
+  ``replica:1:stall@req=1``): the retiring replica is SIGKILLed while
+  its zero-drop drain is blocked on a wedged in-flight request. The
+  retire directive was published FIRST, so the launcher lets the rank
+  go (exactly one retire, no respawn), the controller logs the race,
+  and the survivor serves every subsequent request.
+
 Usage:
     python tools/chaos_check.py                      # worker crash
     python tools/chaos_check.py --spec 'server:0:crash@step=130'
@@ -65,6 +79,8 @@ Usage:
     python tools/chaos_check.py --spec 'worker:1:preempt@step=16'
     python tools/chaos_check.py --spec 'replica:1:crash@req=10'
     python tools/chaos_check.py --spec 'generate:stall@req=2' --prefix
+    python tools/chaos_check.py --spec 'autoscaler:crash@tick=3' --autoscale
+    python tools/chaos_check.py --autoscale-race
     python tools/chaos_check.py --matrix             # all of the above
 """
 import argparse
@@ -126,6 +142,17 @@ GENERATE_PREFIX_MATRIX = [
 #: applied pushes per epoch on server 0).
 EMBED_MATRIX = [
     "server:0:crash@step=200",
+]
+
+#: elastic-autoscaler fault kinds (ISSUE 18): a launch.py --serve
+#: fleet plus a real autoscale-controller subprocess. The crash case
+#: proves fail-static (a dead controller costs scaling, never
+#: serving); the race case proves a replica SIGKILLed while its
+#: retire-drain is blocked still retires exactly once — directive
+#: already published, launcher never respawns it, no double-retire.
+AUTOSCALE_MATRIX = [
+    ("autoscaler:crash@tick=3", "autoscale"),
+    ("replica:1:stall@req=1", "autoscale-race"),
 ]
 
 #: sharded-data-plane fault kind (ISSUE 17): the recommender job
@@ -335,6 +362,350 @@ def run_generate_prefix_case(args, spec):
     print("chaos_check[generate-prefix]: OK — wedged borrower capped, "
           "only its private pages reclaimed, survivors bit-identical, "
           "pool drained to the index pins then zero")
+    return 0
+
+
+def _free_coord():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    return coord
+
+
+def _spawn_serve_fleet(args, env, coord, n):
+    """Boot a launch.py --serve fleet of ``n`` replicas over the tiny
+    bench model; returns (proc, stdout-drain thread, output box). The
+    model's data dim is 16 — drive it with ``np.zeros((1, 16))``."""
+    import tempfile
+    import threading
+
+    from bench_serve import REPLICA_BOOT_CODE, build_model
+    from mxnet_tpu.model import save_checkpoint
+    from mxnet_tpu import nd
+
+    sym, model_args = build_model(16, 32, 2, 4)
+    tmpdir = tempfile.mkdtemp(prefix="chaos_fleet_")
+    prefix = os.path.join(tmpdir, "model")
+    save_checkpoint(prefix, 0, sym,
+                    {k: nd.array(v) for k, v in model_args.items()}, {})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "--serve", "-n", str(n), "--max-restarts",
+           str(args.max_restarts), "--coordinator", coord,
+           "--timeout", str(args.timeout),
+           sys.executable, "-c", REPLICA_BOOT_CODE, "replica",
+           "--prefix", prefix, "--epoch", "0",
+           "--data-shape", "data:1,16", "--ladder", "1,4"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    box = {"out": ""}
+
+    def _drain():
+        box["out"] = proc.stdout.read()
+
+    t = threading.Thread(target=_drain, daemon=True)
+    t.start()
+    return proc, t, box
+
+
+def _serving_count(router):
+    return sum(1 for _a, st, alive, _l in router.replicas()
+               if alive and st == "serving")
+
+
+def _await_serving(router, n, timeout=60):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _serving_count(router) < n:
+        if _time.monotonic() > deadline:
+            raise RuntimeError("fleet never reached %d serving "
+                               "replicas" % n)
+        _time.sleep(0.25)
+        router.refresh_view(force=True)
+
+
+def run_autoscale_case(args, spec):
+    """The dead-controller case (ISSUE 18): a 1-replica --serve fleet
+    plus a REAL autoscale controller subprocess carrying
+    ``autoscaler:crash@tick=N``. Passes only when the controller
+    hard-exited with the chaos exit code, the fleet then served EVERY
+    request at its unchanged size (fail-static: a dead controller
+    costs scaling, never serving), and the job exits 0."""
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetRouter
+    from mxnet_tpu.test_utils import clean_dist_env
+
+    coord = _free_coord()
+    # the spec rides ONLY the controller's env: the fleet must stay
+    # fault-free so every failure below is attributable to the crash
+    proc, t, box = _spawn_serve_fleet(
+        args, clean_dist_env(repo_root=ROOT), coord, n=1)
+    as_env = clean_dist_env(repo_root=ROOT)
+    as_env["MXNET_FAULT_SPEC"] = spec
+
+    failures = []
+    errors = []
+    router = None
+    scaler = None
+    try:
+        profiler.fleet_reset()
+        router = FleetRouter(tracker_uri=coord, view_interval=0.5,
+                             timeout=15.0)
+        _await_serving(router, 1)
+        x = np.zeros((1, 16), np.float32)
+        for i in range(5):
+            try:
+                router.request("model", x)
+            except Exception as e:
+                errors.append("pre-crash req %d: %s: %s"
+                              % (i, type(e).__name__, e))
+        as_cmd = [sys.executable, "-m", "mxnet_tpu.serving.autoscale",
+                  "--tracker", coord, "--min", "1", "--max", "2",
+                  "--interval", "0.2", "--up-load", "1000",
+                  "--down-load", "0.5"]
+        print("chaos_check[autoscale]: %s  (MXNET_FAULT_SPEC=%s, "
+              "controller-side)" % (" ".join(as_cmd), spec), flush=True)
+        scaler = subprocess.Popen(as_cmd, env=as_env,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+        as_out = scaler.communicate(timeout=90)[0]
+        sys.stdout.write(as_out)
+        if scaler.returncode != 137:
+            failures.append("controller exited %d, expected the chaos "
+                            "hard-exit 137" % scaler.returncode)
+        if "[chaos]" not in as_out:
+            failures.append("fault spec never fired in the controller")
+        # fail-static: traffic and membership must not notice the death
+        for i in range(20):
+            try:
+                router.request("model", x)
+            except Exception as e:
+                errors.append("post-crash req %d: %s: %s"
+                              % (i, type(e).__name__, e))
+        _time.sleep(1.0)            # a wrong respawn/retire would land now
+        router.refresh_view(force=True)
+        serving = _serving_count(router)
+        if serving != 1:
+            failures.append("membership moved after the controller "
+                            "died: %d serving, expected 1" % serving)
+        if errors:
+            failures.append("requests failed (%d): %s"
+                            % (len(errors), errors[:3]))
+        stats = profiler.fleet_stats()
+        if stats.get("failed", 0):
+            failures.append("fleet counters show %d failed requests"
+                            % stats["failed"])
+    except Exception as e:
+        failures.append("driver failed: %s: %s" % (type(e).__name__, e))
+    finally:
+        if scaler is not None and scaler.poll() is None:
+            scaler.kill()
+        if router is not None:
+            try:
+                router.stop_fleet()
+            except Exception:
+                pass
+            router.close()
+    try:
+        rc = proc.wait(timeout=args.timeout + 30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+    t.join(timeout=10)
+    sys.stdout.write(box["out"])
+    if rc != 0:
+        failures.append("fleet job exited %d" % rc)
+    if failures:
+        print("chaos_check[autoscale]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[autoscale]: OK — controller crash was "
+          "fail-static: every request served, membership unchanged")
+    return 0
+
+
+def run_autoscale_race_case(args, spec):
+    """The scale-down race (ISSUE 18): rank 1 is wedged (fleet-side
+    ``replica:1:stall@req=1``) so its zero-drop drain blocks, the
+    controller retires it (directive published FIRST, then drain), and
+    the driver SIGKILLs the replica mid-drain. Passes only when the
+    controller logged exactly one retire race, the directive holds
+    exactly rank 1 retired at desired=1, the launcher let the rank go
+    WITHOUT respawning it, every subsequent request succeeded on the
+    survivor, and the job exits 0."""
+    import signal as _signal
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetRouter
+    from mxnet_tpu.serving.autoscale import _TrackerLink
+    from mxnet_tpu.test_utils import clean_dist_env
+    from mxnet_tpu.tracker import _send_msg, connect_with_backoff
+
+    env = clean_dist_env(repo_root=ROOT)
+    env["MXNET_FAULT_SPEC"] = spec      # the stall lives fleet-side
+    coord = _free_coord()
+    proc, t, box = _spawn_serve_fleet(args, env, coord, n=2)
+
+    failures = []
+    errors = []
+    router = None
+    scaler = None
+    link = None
+    wedge = None
+    as_lines = []
+    try:
+        profiler.fleet_reset()
+        router = FleetRouter(tracker_uri=coord, view_interval=0.5,
+                             timeout=15.0)
+        _await_serving(router, 2)
+        link = _TrackerLink(coord)
+        members = link.rpc("members", {"role": "replica"})
+        victim = next(m for m in members if int(m["rank"]) == 1)
+        victim_addr = victim["addr"]
+        victim_pid = int(victim["info"]["pid"])
+        # wedge rank 1 deterministically: one raw predict straight at
+        # it — the stall rule fires inside admission and the handler
+        # blocks with the request in flight, so the coming drain blocks
+        wedge = connect_with_backoff(victim_addr, deadline=10.0)
+        _send_msg(wedge, ("predict", {"model": "model", "inputs": {}}))
+        deadline = _time.monotonic() + 30
+        while True:
+            members = link.rpc("members", {"role": "replica"})
+            v = next((m for m in members if int(m["rank"]) == 1), None)
+            if v and int((v.get("info") or {}).get("inflight", 0)) >= 1:
+                break
+            if _time.monotonic() > deadline:
+                raise RuntimeError("rank 1 never wedged")
+            _time.sleep(0.05)
+        # under-loaded thresholds + hysteresis 1: the controller's
+        # first tick retires the highest-rank replica — the wedged one
+        as_cmd = [sys.executable, "-m", "mxnet_tpu.serving.autoscale",
+                  "--tracker", coord, "--min", "1", "--max", "2",
+                  "--interval", "0.2", "--up-load", "1000",
+                  "--down-load", "100", "--hysteresis", "1",
+                  "--cooldown", "0.1"]
+        print("chaos_check[autoscale-race]: %s  (fleet-side "
+              "MXNET_FAULT_SPEC=%s wedges rank 1)"
+              % (" ".join(as_cmd), spec), flush=True)
+        scaler = subprocess.Popen(as_cmd, env=clean_dist_env(
+            repo_root=ROOT), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+        def _pump():
+            for line in scaler.stdout:
+                as_lines.append(line)
+
+        pump = threading.Thread(target=_pump, daemon=True)
+        pump.start()
+        deadline = _time.monotonic() + 60
+        while True:
+            directive = link.rpc("scale_get", {"role": "replica"})
+            if directive and directive.get("retired"):
+                break
+            if scaler.poll() is not None:
+                raise RuntimeError("controller exited before retiring")
+            if _time.monotonic() > deadline:
+                raise RuntimeError("controller never published a "
+                                   "retire directive")
+            _time.sleep(0.02)
+        # SIGKILL mid-drain: the directive is already at the tracker,
+        # but the drain RPC is still blocked on the wedged request
+        os.kill(victim_pid, 9)
+        deadline = _time.monotonic() + 30
+        while not any("retire race" in ln for ln in as_lines):
+            if _time.monotonic() > deadline:
+                failures.append("controller never logged the retire "
+                                "race after the SIGKILL")
+                break
+            _time.sleep(0.05)
+        # let the controller settle a tick or two, then stop it cleanly
+        _time.sleep(1.0)
+        scaler.send_signal(_signal.SIGTERM)
+        as_rc = scaler.wait(timeout=30)
+        pump.join(timeout=10)
+        as_out = "".join(as_lines)
+        sys.stdout.write(as_out)
+        if as_rc != 0:
+            failures.append("controller exited %d after SIGTERM, "
+                            "expected a clean 0" % as_rc)
+        if as_out.count("retire race") != 1 \
+                or as_out.count("scale-down ->") != 1:
+            failures.append("expected exactly one retire (race) of "
+                            "rank 1, controller log shows otherwise")
+        directive = link.rpc("scale_get", {"role": "replica"})
+        if directive.get("retired") != [1] \
+                or directive.get("desired") != 1:
+            failures.append("directive is not {retired=[1], desired=1}:"
+                            " %r" % directive)
+        # the survivor carries all traffic; the retired rank stays gone
+        router.refresh_view(force=True)
+        x = np.zeros((1, 16), np.float32)
+        for i in range(10):
+            try:
+                router.request("model", x)
+            except Exception as e:
+                errors.append("post-race req %d: %s: %s"
+                              % (i, type(e).__name__, e))
+        _time.sleep(1.5)            # a wrong respawn would re-register now
+        router.refresh_view(force=True)
+        serving = _serving_count(router)
+        if serving != 1:
+            failures.append("expected 1 surviving replica, view shows "
+                            "%d serving" % serving)
+        if errors:
+            failures.append("requests failed (%d): %s"
+                            % (len(errors), errors[:3]))
+    except Exception as e:
+        failures.append("driver failed: %s: %s" % (type(e).__name__, e))
+    finally:
+        if wedge is not None:
+            try:
+                wedge.close()
+            except OSError:
+                pass
+        if scaler is not None and scaler.poll() is None:
+            scaler.kill()
+        if link is not None:
+            link.close()
+        if router is not None:
+            try:
+                router.stop_fleet()
+            except Exception:
+                pass
+            router.close()
+    try:
+        rc = proc.wait(timeout=args.timeout + 30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+    t.join(timeout=10)
+    out = box["out"]
+    sys.stdout.write(out)
+    if rc != 0:
+        failures.append("fleet job exited %d" % rc)
+    if "retired by the autoscaler" not in out:
+        failures.append("launcher never classified rank 1's death as "
+                        "a retire")
+    if "; respawning" in out:
+        failures.append("launcher respawned a node — the retired rank "
+                        "must be let go")
+    if failures:
+        print("chaos_check[autoscale-race]: FAIL\n  - %s"
+              % "\n  - ".join(failures), file=sys.stderr)
+        return 1
+    print("chaos_check[autoscale-race]: OK — SIGKILL mid-drain retired "
+          "rank 1 exactly once, no respawn, survivor served everything")
     return 0
 
 
@@ -779,6 +1150,18 @@ def main():
                          "shared-prefix KV cache ON (ISSUE 16): the "
                          "wedged borrower's reclaim must free only its "
                          "private pages, survivors bit-identical")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run --spec (autoscaler:crash@tick=N) against "
+                         "a 1-replica fleet plus a real autoscale "
+                         "controller subprocess (ISSUE 18): the crash "
+                         "must be fail-static — fleet keeps serving at "
+                         "its current size, zero failed requests")
+    ap.add_argument("--autoscale-race", action="store_true",
+                    help="run the ISSUE 18 scale-down race: the "
+                         "retiring replica is SIGKILLed while its "
+                         "zero-drop drain is blocked — it must retire "
+                         "exactly once, never respawn (--spec sets the "
+                         "fleet-side stall that wedges the drain)")
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--max-restarts", type=int, default=1)
@@ -792,10 +1175,17 @@ def main():
         specs += [(s, "prefix") for s in GENERATE_PREFIX_MATRIX]
         specs += [(s, "embed") for s in EMBED_MATRIX]
         specs += [(s, "data") for s in DATA_MATRIX]
+        specs += list(AUTOSCALE_MATRIX)
     else:
         mode = "embed" if args.embed \
             else ("data" if args.data
-                  else ("prefix" if args.prefix else None))
+                  else ("prefix" if args.prefix
+                        else ("autoscale" if args.autoscale
+                              else ("autoscale-race"
+                                    if args.autoscale_race else None))))
+        if mode == "autoscale-race" \
+                and args.spec == ap.get_default("spec"):
+            args.spec = AUTOSCALE_MATRIX[1][0]
         specs = [(args.spec, mode)]
     rc = 0
     for spec, mode in specs:
@@ -805,6 +1195,10 @@ def main():
             rc |= run_data_case(args, spec)
         elif mode == "prefix":
             rc |= run_generate_prefix_case(args, spec)
+        elif mode == "autoscale":
+            rc |= run_autoscale_case(args, spec)
+        elif mode == "autoscale-race":
+            rc |= run_autoscale_race_case(args, spec)
         elif _is_generate_spec(spec):
             rc |= run_generate_case(args, spec)
         elif _is_serve_spec(spec):

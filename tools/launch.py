@@ -59,9 +59,12 @@ Modes:
                       orchestrator (k8s, slurm, GKE).
 """
 import argparse
+import io
 import os
+import pickle
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import time
@@ -82,6 +85,12 @@ EXIT_PREEMPTED = 75
 # forever — past this many, exit 75 is treated like any other nonzero
 # status and burns the normal restart budget.
 MAX_FREE_RESTARTS = 16
+# Ceiling on the replica count a scale directive can ask for: the
+# autoscaler enforces MXNET_FLEET_AUTOSCALE_MAX itself, this bound only
+# keeps a corrupt/hostile directive from forking the host to death.
+FLEET_SIZE_CAP = 64
+# How often the serve-mode supervisor polls the tracker's scale mailbox.
+SCALE_POLL_INTERVAL = 1.0
 
 
 def _free_port():
@@ -271,6 +280,91 @@ def _stop_tracker(args, coord):
         pass
 
 
+class _PlainUnpickler(pickle.Unpickler):
+    """Mirror of the tracker's _SafeUnpickler: scale directives are
+    plain data; any global reference in a reply is refused."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            "scale directive must be plain data (refusing %s.%s)"
+            % (module, name))
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("tracker connection closed")
+        buf += chunk
+    return buf
+
+
+def _scale_poll(coord, timeout=2.0):
+    """Ask the tracker for the latest replica scale directive
+    (ISSUE 18) over its own wire, stdlib-only — the supervisor must
+    never import the framework in-process. Best-effort: any failure
+    (tracker not up yet, mid-teardown, garbage frame) returns None and
+    the fleet keeps its current shape — the launcher half of the
+    autoscaler's fail-static contract."""
+    try:
+        host, port = coord.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=timeout)
+        try:
+            sock.settimeout(timeout)
+            raw = pickle.dumps(("scale_get", {"role": "replica"}),
+                               protocol=2)
+            sock.sendall(struct.pack(">I", len(raw)) + raw)
+            (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+            if n & 0x80000000:
+                return None  # extended frame: not a plain directive
+            payload = _recv_exact(sock, n)
+        finally:
+            sock.close()
+        status, reply = _PlainUnpickler(io.BytesIO(payload)).load()
+    except (OSError, EOFError, struct.error, pickle.UnpicklingError,
+            ValueError):
+        return None
+    if status != "ok" or not isinstance(reply, dict):
+        return None
+    return reply
+
+
+def _apply_scale_directive(directive, workers, retired_ranks,
+                           last_seq, primary_role):
+    """Pure half of the serve-mode scale poll: fold one directive into
+    (new ranks to spawn, newly retired ranks, seq). A directive is
+    applied at most once (monotonic seq); desired counts the NON-
+    retired replica slots, so spawns fill the gap between the live
+    non-retired population and desired with fresh ranks.
+
+    Cleanly-finished non-retired replicas count AGAINST the gap, not
+    as holes to refill: in serve mode a replica only exits 0 when
+    something deliberately stopped it (the router's fleet ``stop``),
+    and a directive published before that stop must not resurrect the
+    capacity afterwards — the launcher would then supervise a replica
+    nobody will ever stop and the job could never end."""
+    seq = int(directive.get("seq", 0))
+    if seq <= last_seq:
+        return [], set(), last_seq
+    newly_retired = {int(r) for r in (directive.get("retired") or ())}
+    newly_retired -= retired_ranks
+    all_retired = retired_ranks | newly_retired
+    desired = min(max(int(directive.get("desired", 0)), 0),
+                  FLEET_SIZE_CAP)
+    active = [n for n in workers
+              if n.rank not in all_retired
+              and not n.failed and not n.finished]
+    stopped = [n for n in workers
+               if n.rank not in all_retired and n.finished]
+    next_rank = max((n.rank for n in workers), default=-1) + 1
+    spawn = list(range(
+        next_rank,
+        next_rank + max(desired - len(active) - len(stopped), 0)))
+    return spawn, newly_retired, seq
+
+
 def _spawn_topology(args, coord):
     """scheduler + S servers + W workers; workers' collective exit
     status is the job's. With --max-restarts K a worker/server that
@@ -309,6 +403,40 @@ def _spawn_topology(args, coord):
     workers = [n for n in nodes if n.role == primary_role]
     deadline = (time.monotonic() + args.timeout) if args.timeout else None
     rc = 0
+    # elastic-fleet state (ISSUE 18, serve mode only): ranks the
+    # autoscaler retired (never respawned, any exit is terminal) and
+    # the last applied directive seq
+    retired_ranks = set()
+    scale_seq = 0
+    next_scale_poll = time.monotonic() + SCALE_POLL_INTERVAL
+
+    def _poll_scale_now():
+        """One serve-mode scale poll: fold the tracker's latest
+        directive into the supervised set. Called on cadence AND
+        before classifying a primary-role death — the autoscaler
+        publishes retire directives BEFORE touching the replica, so a
+        death that races the cadence poll must not be mistaken for a
+        failure and respawned."""
+        nonlocal scale_seq
+        directive = _scale_poll(coord)
+        if directive is None:
+            return
+        spawn, newly_retired, scale_seq = _apply_scale_directive(
+            directive, workers, retired_ranks, scale_seq, primary_role)
+        retired_ranks.update(newly_retired)
+        for r in sorted(newly_retired):
+            print("launch.py: scale-down directive: rank %d retired "
+                  "(drain-then-exit; no respawn)" % r, file=sys.stderr)
+        for r in spawn:
+            new = _Node("%s%d" % (primary_role, r), primary_role, r,
+                        list(args.command), env_fn(primary_role, r))
+            print("launch.py: scale-up directive: spawning %s "
+                  "(desired=%s)" % (new.name, directive.get("desired")),
+                  file=sys.stderr)
+            new.spawn()
+            nodes.append(new)
+            workers.append(new)
+
     try:
         for node in nodes:
             node.spawn()
@@ -327,6 +455,25 @@ def _spawn_topology(args, coord):
                     continue
                 progressed = True
                 node.exit_history.append(code)
+                if serve and node.role == primary_role \
+                        and node.rank not in retired_ranks \
+                        and code != 0:
+                    # a replica death can race the cadence poll: the
+                    # retire directive lands at the tracker before the
+                    # autoscaler's drain touches the process, so check
+                    # for one more directive before classifying
+                    _poll_scale_now()
+                if serve and node.role == primary_role \
+                        and node.rank in retired_ranks:
+                    # the autoscaler retired this rank before touching
+                    # the process, so ANY exit — the clean drain+stop,
+                    # or a SIGKILL that raced the drain — is a terminal
+                    # successful retire: exactly one, never respawned
+                    node.finished = True
+                    print("launch.py: %s retired by the autoscaler "
+                          "(exit %s); not respawning"
+                          % (node.name, code), file=sys.stderr)
+                    continue
                 if code == 0:
                     node.finished = True
                     continue
@@ -366,6 +513,9 @@ def _spawn_topology(args, coord):
                           "exhausted (%d/%d); failing the job"
                           % (node.name, code, node.restarts,
                              args.max_restarts), file=sys.stderr)
+            if serve and time.monotonic() >= next_scale_poll:
+                next_scale_poll = time.monotonic() + SCALE_POLL_INTERVAL
+                _poll_scale_now()
             failed = [n for n in nodes if n.failed]
             if failed and args.max_restarts:
                 # elastic mode promises CLEAN failure: tear everything
