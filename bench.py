@@ -142,6 +142,16 @@ def _measure(variant):
             img_s = batch * n_steps / dt
             rec = {"img_s": round(img_s, 2), "variant": variant,
                    "batch": per_dev_batch}
+            try:
+                # compiled-program peak bytes (ISSUE 19): the jitted step
+                # is already compiled, so lower().compile() is a cache
+                # hit and memory_analysis() is free. Best-effort — some
+                # backends don't expose it.
+                mem = ts.compiled_memory_stats(carry, batch_dev, key)
+                rec["peak_bytes"] = mem["peak_bytes"]
+                rec["temp_bytes"] = mem["temp_bytes"]
+            except Exception:
+                pass
             if variant == "zero":
                 # measured per-device optimizer-state bytes next to the
                 # analytic replicated baseline (momentum = one fp32

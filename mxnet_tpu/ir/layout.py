@@ -1,0 +1,215 @@
+"""Layout-selection pass: whole-graph NCHW<->NHWC placement (ISSUE 19).
+
+The fusion pass leaves each Pallas unit bracketed by its own
+NCHW<->NHWC transpose pair, and ``TransposeCancelRule`` erases brackets
+only where two fused units touch. Everything else — a BatchNorm, ReLU
+or residual add sitting between units, the stem before the first unit,
+the head after the last — keeps paying a layout round-trip per
+boundary. This pass generalizes the cancellation to the whole graph:
+
+- **compose** — adjacent transposes merge into one
+  (``transpose_compose``); the identity case is the registered
+  ``transpose_cancel`` rule, reused verbatim.
+- **sink** — a transpose feeding a layout-oblivious op moves BELOW it
+  (``transpose_sink_unary`` / ``transpose_sink_binary``): elementwise
+  ops commute with any permutation, a binary op commutes when both
+  operands carry the SAME permutation (two transposes become one).
+- **BatchNorm sink** — ``BN_axis=a(T_p(x)) == T_p(BN_axis=p[a](x))``:
+  the channel axis is remapped through the permutation
+  (``transpose_sink_batchnorm``), so a BN between fused units stops
+  forcing the stack back to NCHW.
+
+Transposes only ever move toward the outputs and their count never
+grows, so the fixpoint terminates; regions settle into ONE layout with
+transposes pushed to region boundaries, where compose/cancel collapse
+them. Rewrites preserve shapes and values (BatchNorm reductions are
+reassociated, so equality is numerical, not bitwise — the same
+contract as the fused kernels).
+
+Registered as the ``layout`` pass (``MXNET_IR_PASSES`` /
+``MXNET_IR_TRAIN_PASSES``); ``MXNET_IR_LAYOUT=0`` is the kill switch
+(the pass runs with no rules, a no-op). Cancelled-transpose counts
+ride ``profiler.pass_stats`` as ``transposes_cancelled``.
+"""
+from __future__ import annotations
+
+from ..base import auto_name
+from ..symbol.symbol import Symbol, _Node
+from .match import Pat, node_attr
+from .rules import Rule
+
+# Elementwise single-input ops a permutation commutes with. An op name
+# here never matching a graph is harmless (the Pat simply never fires);
+# axis-sensitive ops (pad, Pooling, slice, ...) are deliberately absent.
+SINK_UNARY_OPS = (
+    "Activation",
+    "LeakyReLU",
+    "Cast",
+    "clip",
+    "_mul_scalar",
+    "_plus_scalar",
+    "_minus_scalar",
+    "_div_scalar",
+    "relu",
+)
+
+# Elementwise binary ops; both inputs must carry the SAME permutation.
+SINK_BINARY_OPS = (
+    "broadcast_add",
+    "broadcast_sub",
+    "broadcast_mul",
+    "broadcast_div",
+    "broadcast_maximum",
+    "broadcast_minimum",
+)
+
+
+def _perm(node):
+    axes = node_attr(node, "axes")
+    return tuple(int(a) for a in axes) if axes else ()
+
+
+def _is_identity(perm):
+    return all(p == i for i, p in enumerate(perm))
+
+
+def _sym(entry):
+    return Symbol([entry])
+
+
+def _clone_op(node, new_inputs, attrs=None):
+    """The matched op re-applied to permuted-away inputs: same op, same
+    name (remat plans key on node names), same attr dict."""
+    return _Node(node.op, dict(attrs if attrs is not None else node.attrs),
+                 list(new_inputs), node.name, dict(node.attr_dict),
+                 node._arity)
+
+
+def _transpose_of(entry, axes, prefix):
+    from .. import symbol as sym
+
+    return sym.transpose(_sym(entry), axes=tuple(axes),
+                         name=auto_name(prefix + "_t"))
+
+
+class TransposeComposeRule(Rule):
+    """transpose(transpose(x, i), o) -> transpose(x, i∘o) for
+    non-identity compositions (the identity case is the registered
+    ``transpose_cancel`` rule, which runs first)."""
+
+    name = "transpose_compose"
+
+    def __init__(self):
+        inner = Pat("transpose", inputs=[Pat(name="x")], name="inner")
+        self.pattern = Pat("transpose", inputs=[inner], name="outer")
+
+    def where(self, m):
+        o = _perm(m.node("outer"))
+        i = _perm(m.node("inner"))
+        if not o or not i or len(o) != len(i):
+            return False
+        return not _is_identity(tuple(i[o[b]] for b in range(len(o))))
+
+    def rewrite(self, m):
+        o = _perm(m.node("outer"))
+        i = _perm(m.node("inner"))
+        comp = tuple(i[o[b]] for b in range(len(o)))
+        return _transpose_of(m["x"], comp, m.node("outer").name)
+
+
+class TransposeSinkUnaryRule(Rule):
+    """op(transpose(x, p)) -> transpose(op(x), p) for one elementwise
+    op name (one rule instance per name — the matcher is one-op-per-Pat
+    by design)."""
+
+    kernels = ()
+
+    def __init__(self, op_name):
+        self.name = "transpose_sink_%s" % op_name.lower().lstrip("_")
+        self.op_name = op_name
+        t = Pat("transpose", inputs=[Pat(name="x")], name="t")
+        self.pattern = Pat(op_name, inputs=[t], name="root")
+
+    def where(self, m):
+        return bool(_perm(m.node("t")))
+
+    def rewrite(self, m):
+        root = m.node("root")
+        inner = _clone_op(root, [m["x"]])
+        return _transpose_of((inner, 0), _perm(m.node("t")), root.name)
+
+
+class TransposeSinkBinaryRule(Rule):
+    """op(transpose(x, p), transpose(y, p)) -> transpose(op(x, y), p):
+    two layout round-trips become one, below the op."""
+
+    kernels = ()
+
+    def __init__(self, op_name):
+        self.name = "transpose_sink_%s" % op_name.lower().lstrip("_")
+        self.op_name = op_name
+        t1 = Pat("transpose", inputs=[Pat(name="x")], name="t1")
+        t2 = Pat("transpose", inputs=[Pat(name="y")], name="t2")
+        self.pattern = Pat(op_name, inputs=[t1, t2], name="root")
+
+    def where(self, m):
+        p = _perm(m.node("t1"))
+        return bool(p) and p == _perm(m.node("t2"))
+
+    def rewrite(self, m):
+        root = m.node("root")
+        inner = _clone_op(root, [m["x"], m["y"]])
+        return _transpose_of((inner, 0), _perm(m.node("t1")), root.name)
+
+
+class TransposeSinkBatchNormRule(Rule):
+    """BatchNorm(transpose(x, p), ..., axis=a) ->
+    transpose(BatchNorm(x, ..., axis=p[a]), p): the channel axis rides
+    the permutation, so a BN between NHWC regions stops forcing the
+    graph back to NCHW. Numerically equivalent (reduction order over
+    the normalized axes changes); aux-state updates are keyed on the
+    moving_mean/moving_var VARIABLE names, which the clone preserves."""
+
+    name = "transpose_sink_batchnorm"
+    kernels = ()
+
+    def __init__(self):
+        t = Pat("transpose", inputs=[Pat(name="x")], name="t")
+        self.pattern = Pat(
+            "BatchNorm",
+            inputs=[t, Pat.var("gamma"), Pat.var("beta"),
+                    Pat.var("mm"), Pat.var("mv")],
+            name="bn")
+
+    def where(self, m):
+        p = _perm(m.node("t"))
+        if not p:
+            return False
+        a = node_attr(m.node("bn"), "axis")
+        a = 1 if a is None else int(a)
+        return 0 <= a < len(p)
+
+    def rewrite(self, m):
+        bn = m.node("bn")
+        p = _perm(m.node("t"))
+        a = node_attr(bn, "axis")
+        a = 1 if a is None else int(a)
+        attrs = dict(bn.attrs)
+        attrs["axis"] = int(p[a])
+        inner = _clone_op(
+            bn, [m["x"], m["gamma"], m["beta"], m["mm"], m["mv"]],
+            attrs=attrs)
+        return _transpose_of((inner, 0), p, bn.name)
+
+
+def layout_rules():
+    """The ``layout`` pass's rule list. Order matters: cancel first
+    (identity pairs vanish before compose could touch them), compose
+    second (transpose chains collapse before sinking), sinks last."""
+    from .rules import get_rule
+
+    rules = [get_rule("transpose_cancel"), TransposeComposeRule(),
+             TransposeSinkBatchNormRule()]
+    rules += [TransposeSinkUnaryRule(op) for op in SINK_UNARY_OPS]
+    rules += [TransposeSinkBinaryRule(op) for op in SINK_BINARY_OPS]
+    return rules

@@ -7,11 +7,13 @@ Module training scripts consume. The Gluon model zoo lives separately in
 long-context flagship) in ``transformer.py``.
 """
 from . import (  # noqa: F401
-    alexnet, inception, lenet, mlp, mobilenet, resnet, resnext, ssd, vgg,
+    alexnet, bench_transformer, inception, lenet, mlp, mobilenet, resnet,
+    resnext, ssd, vgg,
 )
 
 _BUILDERS = {
     "mlp": mlp,
+    "bench-transformer": bench_transformer,
     "lenet": lenet,
     "resnet": resnet,
     "resnext": resnext,

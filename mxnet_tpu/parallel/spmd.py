@@ -46,6 +46,22 @@ __all__ = [
     "cross_entropy_loss",
 ]
 
+# Primitives whose outputs the remat="conv" policy SAVES. The fused
+# Pallas units trace as custom_vjp/jvp call primitives (on CPU
+# reference too), and pallas_call is what a kernel lowers to when the
+# custom-vjp wrapper is absent — without these, a fused ResNet under
+# remat="conv" recomputes its most expensive kernels in backward, the
+# exact ops the policy exists to save (ISSUE 19 bugfix).
+_SAVEABLE_PRIMS = (
+    "conv_general_dilated",
+    "dot_general",
+    "pallas_call",
+    "custom_vjp_call",
+    "custom_vjp_call_jaxpr",
+    "custom_jvp_call",
+    "custom_jvp_call_jaxpr",
+)
+
 
 # ---------------------------------------------------------------------------
 # sharding rules
@@ -314,12 +330,31 @@ class TrainStep:
     def __init__(self, symbol, optimizer, mesh=None, data_axes=("dp",),
                  param_rules=None, label_names=("softmax_label",),
                  data_names=("data",), compute_dtype=None, loss_fn=None,
-                 zero=None, remat=False, normalize_grads=True,
+                 zero=None, remat=None, normalize_grads=True,
                  return_outputs=False, metric_stats=False, zero_wire=None,
-                 zero_min_size=None, sentinel=None):
+                 zero_min_size=None, sentinel=None, train_passes=None):
         from .. import config
         from ..executor import _graph_closure
 
+        # ISSUE 19: training-graph pass pipeline — explicit arg wins,
+        # None consults MXNET_IR_TRAIN_PASSES; names are validated
+        # against the ir.PASSES registry by apply_passes. The rewritten
+        # symbol IS self.symbol: shapes/params/remat plan all follow it.
+        if train_passes is None:
+            raw = config.get("MXNET_IR_TRAIN_PASSES")
+            train_passes = tuple(
+                p.strip() for p in str(raw).split(",") if p.strip())
+        elif isinstance(train_passes, str):
+            train_passes = tuple(
+                p.strip() for p in train_passes.split(",") if p.strip())
+        else:
+            train_passes = tuple(str(p).strip() for p in train_passes
+                                 if str(p).strip())
+        self.train_passes = train_passes
+        if train_passes:
+            from ..ir import apply_passes
+
+            symbol = apply_passes(symbol, list(train_passes))
         self.symbol = symbol
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
@@ -358,6 +393,18 @@ class TrainStep:
         self.data_names = tuple(data_names)
         self.compute_dtype = compute_dtype
         self.loss_fn = loss_fn or cross_entropy_loss
+        # ISSUE 19: remat — explicit arg wins; None consults the
+        # strictly-validated MXNET_TPU_REMAT knob. False/off: no remat;
+        # True: full recompute; "conv": prim-name policy; "pass": the
+        # per-site IR plan (ir/remat.py) via named checkpointing.
+        if remat is None:
+            raw = config.get_choice("MXNET_TPU_REMAT",
+                                    ("0", "1", "off", "conv", "pass"))
+            remat = {"0": False, "off": False, "1": True}.get(raw, raw)
+        elif remat not in (False, True, "conv", "pass"):
+            raise MXNetError(
+                "TrainStep: remat=%r must be False|True|'conv'|'pass'"
+                % (remat,))
         self.remat = remat
         self.normalize_grads = normalize_grads
         self.return_outputs = return_outputs
@@ -372,8 +419,21 @@ class TrainStep:
             n for n in arg_names if n not in self.data_names and n not in self.label_names
         ]
         self.aux_names = symbol.list_auxiliary_states()
-        self._graph = _graph_closure(symbol, is_train=True)
+        # ISSUE 19: remat="pass" plans save/recompute per NODE and the
+        # closure tags each to-save node's outputs with checkpoint_name;
+        # every other mode builds the tag-free closure (bit-identical
+        # graphs to the pre-pass behavior).
+        self._remat_plan = None
+        remat_names = None
+        if self.remat == "pass":
+            from ..ir.remat import plan_remat
+
+            self._remat_plan = plan_remat(symbol)
+            remat_names = frozenset(self._remat_plan.save)
+        self._graph = _graph_closure(symbol, is_train=True,
+                                     remat_names=remat_names)
         self._step_fn = None
+        self._jit_fn = None
 
     # -- initialization ------------------------------------------------------
     def init_params(self, data_shapes, initializer=None, dtype=_np.float32, seed=0):
@@ -591,12 +651,14 @@ class TrainStep:
         return ps, opt_s, aux_s
 
     # -- compile -------------------------------------------------------------
-    def _build(self, params, opt_state, aux, param_rules=None):
+    def _loss_closure(self):
+        """The (params, aux, batch, key) -> (loss, (outs, aux_updates))
+        closure with the remat mode applied — shared between
+        :meth:`_build` and :meth:`residual_stats` so the measured
+        residual set is exactly the compiled step's."""
         graph = self._graph
-        opt = self.optimizer
         loss_fn = self.loss_fn
         data_names, label_names = self.data_names, self.label_names
-        aux_names = list(self.aux_names)
         cdtype = self.compute_dtype
 
         def loss_of(params_c, aux_c, batch, key):
@@ -615,17 +677,65 @@ class TrainStep:
 
         if self.remat:
             # remat=True: full recompute (the reference's
-            # MXNET_BACKWARD_DO_MIRROR). remat="conv": save only conv/dot
-            # outputs and recompute the cheap elementwise tail (BN apply,
-            # ReLU, pad) inside backward — on a bandwidth-bound graph this
-            # trades spare MXU FLOPs for HBM traffic (see PROFILE.md).
-            if self.remat == "conv":
+            # MXNET_BACKWARD_DO_MIRROR). remat="conv": save outputs of the
+            # MXU-bound primitives (_SAVEABLE_PRIMS — convs, matmuls AND
+            # the custom_vjp/pallas prims the fused units trace as) and
+            # recompute the cheap elementwise tail (BN apply, ReLU, pad)
+            # inside backward — on a bandwidth-bound graph this trades
+            # spare MXU FLOPs for HBM traffic (see PROFILE.md).
+            # remat="pass": the per-SITE IR plan (ir/remat.py) — saved
+            # node outputs carry checkpoint_name tags from the graph
+            # closure and the policy keeps exactly those names.
+            if self.remat == "pass":
+                from ..ir.remat import policy_for
+
+                loss_of = jax.checkpoint(
+                    loss_of, policy=policy_for(self._remat_plan))
+            elif self.remat == "conv":
                 def _policy(prim, *_, **__):
-                    return prim.name in ("conv_general_dilated", "dot_general")
+                    return prim.name in _SAVEABLE_PRIMS
 
                 loss_of = jax.checkpoint(loss_of, policy=_policy)
             else:
                 loss_of = jax.checkpoint(loss_of, static_argnums=())
+        return loss_of
+
+    def residual_stats(self, params, aux, batch, key=None):
+        """AD-level backward-residual accounting for the loss under the
+        current remat mode (``jax.ad_checkpoint.saved_residuals``):
+        ``residual_bytes`` is the total the backward pass must hold,
+        ``n_residuals`` the entry count. This is the remat decision's
+        direct, backend-independent measure — XLA's CPU pipeline strips
+        optimization barriers and CSE-merges the recompute back into
+        the forward, so ``compiled_memory_stats`` on CPU cannot see
+        what the TPU compiler (which honors the barriers) does; the
+        residual set is what the policy actually changed."""
+        try:
+            from jax.ad_checkpoint import saved_residuals
+        except ImportError:  # not re-exported publicly on jax 0.4.x
+            from jax._src.ad_checkpoint import saved_residuals
+
+        if key is None:
+            from .. import random as _rnd
+
+            key = _rnd.next_key()
+        loss_of = self._loss_closure()
+        res = saved_residuals(
+            lambda p: loss_of(p, aux, batch, key), params)
+        total = 0
+        for aval, _src in res:
+            n = 1
+            for d in aval.shape:
+                n *= int(d)
+            total += n * aval.dtype.itemsize
+        return {"residual_bytes": int(total), "n_residuals": len(res)}
+
+    def _build(self, params, opt_state, aux, param_rules=None):
+        opt = self.optimizer
+        data_names, label_names = self.data_names, self.label_names
+        aux_names = list(self.aux_names)
+        loss_of = self._loss_closure()
+        cdtype = self.compute_dtype
 
         normalize = self.normalize_grads
         want_stats = self.metric_stats
@@ -815,7 +925,8 @@ class TrainStep:
             return new_carry, loss
 
         if mesh is None:
-            return self._bind_fused_scope(jax.jit(step, donate_argnums=(0,)))
+            self._jit_fn = jax.jit(step, donate_argnums=(0,))
+            return self._bind_fused_scope(self._jit_fn)
 
         # in_shardings reflect the carry layout place() produces: make
         # sure a logical-layout opt_state handed to a raw compile() call
@@ -837,12 +948,13 @@ class TrainStep:
                      else (rep, out_sh))
         else:
             out_s = (carry_s, rep)
-        return self._bind_fused_scope(jax.jit(
+        self._jit_fn = jax.jit(
             step,
             in_shardings=(carry_s, batch_s, rep),
             out_shardings=out_s,
             donate_argnums=(0,),
-        ))
+        )
+        return self._bind_fused_scope(self._jit_fn)
 
     def compile(self, params, opt_state, aux, param_rules=None):
         if param_rules is not None:
@@ -851,6 +963,54 @@ class TrainStep:
         if self._step_fn is None:
             self._step_fn = self._build(params, opt_state, aux, self.param_rules)
         return self._step_fn
+
+    def compiled_memory_stats(self, carry, batch, key=None):
+        """COMPILED-step memory/cost footprint from XLA's own analyses
+        (ISSUE 19) — distinct from :meth:`memory_stats`, which measures
+        the resident carry: ``temp_bytes`` is the compiler's peak
+        scratch (activations + workspace — the number selective remat
+        exists to cut), ``peak_bytes`` adds the non-aliased I/O the
+        program holds live. ``flops``/``bytes_accessed`` come from
+        ``cost_analysis`` and feed the pipeline ranker's features."""
+        if key is None:
+            from .. import random as _rnd
+
+            key = _rnd.next_key()
+        self.compile(*carry[:3])
+        lower = self._jit_fn.lower
+        if self.mesh is not None:
+            axes = tuple(a for a in self.data_axes
+                         if a in self.mesh.axis_names)
+            if axes:
+                from ..kernels import fused_block as _fb
+
+                with _fb.spmd_scope(self.mesh, axes):
+                    compiled = lower(carry, batch, key).compile()
+            else:
+                compiled = lower(carry, batch, key).compile()
+        else:
+            compiled = lower(carry, batch, key).compile()
+        mem = compiled.memory_analysis()
+        temp = int(getattr(mem, "temp_size_in_bytes", 0))
+        arg = int(getattr(mem, "argument_size_in_bytes", 0))
+        out = int(getattr(mem, "output_size_in_bytes", 0))
+        alias = int(getattr(mem, "alias_size_in_bytes", 0))
+        stats = {
+            "temp_bytes": temp,
+            "argument_bytes": arg,
+            "output_bytes": out,
+            "alias_bytes": alias,
+            "peak_bytes": temp + arg + out - alias,
+        }
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if isinstance(cost, dict):
+            if cost.get("flops") is not None:
+                stats["flops"] = float(cost["flops"])
+            if cost.get("bytes accessed") is not None:
+                stats["bytes_accessed"] = float(cost["bytes accessed"])
+        return stats
 
     def place(self, params, opt_state, aux, param_rules=None):
         """device_put the carry with its shardings (host → HBM once).

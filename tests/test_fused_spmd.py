@@ -164,6 +164,7 @@ def test_fused_trainstep_mesh_matches_single(axes, mesh_kw):
                                    atol=2e-6, err_msg=k)
 
 
+@pytest.mark.slow
 def test_fused_trainstep_mixed_dp_tp_mesh():
     """Fused Pallas units over dp while fc1 is tensor-sharded over tp —
     the dryrun's mixed-mesh layout with the fused graph: shard_map

@@ -259,6 +259,7 @@ def test_record_iter_seed_and_partition(tmp_path):
     assert sorted(a + b) == sorted(float(i % 5) for i in range(12))
 
 
+@pytest.mark.slow
 def test_bench_e2e_artifact(tmp_path):
     """tools/bench_e2e.py couples the RecordIO iterator to the fused
     train step and emits one JSON artifact with coupled, decode-only,

@@ -103,6 +103,7 @@ def _tiny_vals(s, seed=0):
 # ---------------------------------------------------------------------------
 # fusion: rules reproduce the old fused=True builder bit-exactly
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_fusion_bit_exact_vs_legacy_emission():
     legacy = _legacy_fused(**TINY)
     fused = resnet(bottle_neck=True, fused=True, **TINY)

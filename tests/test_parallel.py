@@ -174,6 +174,7 @@ def test_models_infer_shapes():
     assert d["fc1_weight"] == (1000, 2048)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import sys, pathlib
 

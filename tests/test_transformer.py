@@ -45,6 +45,7 @@ def test_transformer_loss_parity_across_meshes(axes):
     assert abs(ref - got) < 1e-4, (axes, ref, got)
 
 
+@pytest.mark.slow
 def test_transformer_grad_parity_dp_tp_sp():
     cfg = _cfg()
     params = tfm.init_params(cfg, seed=0)

@@ -227,6 +227,7 @@ def _assert_ranked_vs_exhaustive(exh, ranked, banked, ratio=5.0):
         % (timed_best, exh_best)
 
 
+@pytest.mark.slow
 def test_ranked_sweep_acceptance_fused(tune_env):
     import itertools
 
@@ -261,6 +262,7 @@ def test_ranked_sweep_acceptance_fused(tune_env):
     assert skipped and all("predicted_ms" in e for e in skipped)
 
 
+@pytest.mark.slow
 def test_ranked_sweep_acceptance_flash(tune_env):
     import itertools
 
@@ -291,6 +293,7 @@ def test_ranked_sweep_acceptance_flash(tune_env):
     _assert_ranked_vs_exhaustive(exh, ranked, banked)
 
 
+@pytest.mark.slow
 def test_transfer_across_shapes(tune_env):
     import itertools
 

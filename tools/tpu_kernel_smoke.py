@@ -103,6 +103,13 @@ def main():
                          "and commit winners to the on-disk schedule table")
     ap.add_argument("--tune-budget", type=int, default=None,
                     help="timed-candidate budget per kernel for --tune")
+    ap.add_argument("--passes", action="store_true",
+                    help="after the smoke passes, run the training-graph "
+                         "pipeline sweep (tools/tune_pipeline.py): "
+                         "compile + featurize every remat x layout "
+                         "candidate on the bench transformer, rank with "
+                         "the learned cost model, and commit the winner "
+                         "to the schedule table (ISSUE 19)")
     ap.add_argument("--ranked", dest="ranked", action="store_true",
                     default=None,
                     help="with --tune: force learned-cost-model ranked "
@@ -286,6 +293,20 @@ def main():
         elif args.ranked is False:
             cmd.append("--no-ranked")
         print("--- schedule sweep ---", flush=True)
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            return rc
+    if args.passes and ok and not _LOWER_ONLY:
+        # graph-level mirror of --tune: parity first, then the pipeline
+        # sweep banks remat x layout winners for this backend. The
+        # sweep's last stdout line is a JSON report.
+        import subprocess
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tune_pipeline.py")]
+        if args.cpu:
+            cmd.append("--cpu")
+        print("--- training-pipeline sweep ---", flush=True)
         rc = subprocess.call(cmd)
         if rc != 0:
             return rc
