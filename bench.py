@@ -84,6 +84,8 @@ def _measure(variant):
         return _measure_data()
     if variant == "autoscale":
         return _measure_autoscale()
+    if variant == "mp":
+        return _measure_mp(n_dev)
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224),
                             fused=(variant == "fused"))
@@ -430,6 +432,27 @@ def _measure_generate():
         print(json.dumps({"error": "generate: %s" % str(e)[:500]}))
 
 
+def _measure_mp(n_dev):
+    """Tensor-parallel variant (ISSUE 20): the megatron-sharded
+    transformer step on the (dp, mp=2) mesh vs the replicated step
+    (tools/bench_e2e.measure_mp) — tokens/s, per-chip argument bytes
+    (acceptance ~1/mp of the replicated bytes), and the structural
+    collective counts (exactly 2 psums per block)."""
+    try:
+        if n_dev < 2 or n_dev % 2:
+            print(json.dumps(
+                {"error": "mp: needs an even device count, have %d"
+                 % n_dev}))
+            return
+        from tools.bench_e2e import measure_mp
+
+        rec = measure_mp(mp=2)
+        rec["variant"] = "mp"
+        print(json.dumps(rec))
+    except Exception as e:
+        print(json.dumps({"error": "mp: %s" % str(e)[:500]}))
+
+
 def _measure_quant():
     """Quantized-serving variant (ISSUE 13): int8 post-training-
     quantized serving vs bf16 on the same closed-loop Poisson trace
@@ -680,10 +703,10 @@ def main():
     # number.
     for variant in ("unfused", "fused", "fit", "zero", "serve", "fleet",
                     "generate", "quant", "embed", "tune", "data",
-                    "autoscale",
+                    "autoscale", "mp",
                     "unfused", "fused", "fit", "zero", "serve", "fleet",
                     "generate", "quant", "embed", "tune", "data",
-                    "autoscale"):
+                    "autoscale", "mp"):
         if variant in results:
             continue
         if time.time() > deadline - 60:
@@ -707,11 +730,12 @@ def main():
                     continue  # stray brace-looking log line
                 if "img_s" in parsed or "req_s" in parsed \
                         or "rows_s" in parsed or "tuned" in parsed \
-                        or "records_s" in parsed or "error" in parsed:
+                        or "records_s" in parsed or "tokens_s" in parsed \
+                        or "error" in parsed:
                     line = parsed
             if line and ("img_s" in line or "req_s" in line
                          or "rows_s" in line or "tuned" in line
-                         or "records_s" in line):
+                         or "records_s" in line or "tokens_s" in line):
                 results[variant] = line
                 _report(results)
             else:

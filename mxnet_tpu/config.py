@@ -590,6 +590,26 @@ KNOBS = {
         "token-bucket burst window: a tenant may burst rate*burst "
         "units above its steady rate; finite float > 0 "
         "(serving/qos.py)"),
+    # --- tensor-parallel execution (ISSUE 20) ---
+    "MXNET_MP_SIZE": (
+        "1", "honored",
+        "tensor-parallel ('mp') mesh-axis size for the fused SPMD step "
+        "and the sharded serving group: the visible devices split into "
+        "a (dp = N // mp) x mp mesh, so mp must divide the device "
+        "count; 1 (the default) is bit-identical to the pure "
+        "data-parallel path; integer >= 1 (parallel/mesh.py "
+        "train_mesh, module/spmd_group.py, serving/predictor.py)"),
+    "MXNET_MP_RULES": (
+        "", "honored",
+        "extra parameter-sharding rules 'regex:spec;regex:spec' where "
+        "spec is a comma list with one entry per dim, each '*' "
+        "(replicate that dim) or a mesh-axis name — e.g. "
+        "'.*proj_weight:*,mp' column-shards the last dim over mp. "
+        "Applied AFTER the transformer's built-in megatron rules; a "
+        "matched rule that names a missing axis or does not divide "
+        "the dim raises (no silent replication); malformed grammar "
+        "raises naming this knob (parallel/spmd.py parse_rules, "
+        "module/spmd_group.py)"),
     # --- misc ---
     "MXNET_TPU_NO_NATIVE": (
         "0", "honored", "force pure-Python fallbacks (_native.py)"),

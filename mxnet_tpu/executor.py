@@ -12,6 +12,15 @@ jitted JAX functions — XLA performs memory planning, fusion, scheduling and
 - ``fwd_bwd``    : fused forward+backward → (outputs, grads, aux updates) —
   the Module training hot path, one XLA module per step (the analogue of
   the reference's bulked op segments, graph_executor.cc:1502).
+
+Model parallelism note (ISSUE 20): ``bind(group2ctx=...)`` below is the
+LEGACY per-op device-placement style (ctx_group attributes → explicit
+devices, the reference's PlaceDevice pass). The TPU-native path shards
+tensors instead: a ``(dp, mp)`` mesh (``parallel/mesh.py:train_mesh``)
+with megatron column/row ``PartitionSpec`` rules applied by
+``parallel/spmd.py:param_shardings`` — GSPMD then partitions this same
+traced program across the mesh. Prefer ``MXNET_MP_SIZE`` over group2ctx
+for anything larger than a two-device demo.
 """
 from __future__ import annotations
 

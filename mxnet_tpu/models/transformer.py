@@ -8,8 +8,12 @@ parallelism surface, exercising every mesh axis with *manual* SPMD
 
 - **dp**  batch sharding; gradient psum comes out of shard_map's
   unvarying-param transpose automatically.
-- **tp**  megatron tensor parallel: vocab- and head-sharded embedding /
-  qkv (column), row-parallel out-proj and ffn-down with one psum each.
+- **mp**  megatron tensor parallel (ISSUE 20; ``tp`` is the legacy
+  alias — whichever axis the mesh carries is resolved by
+  :func:`_mp_axis`): vocab- and head-sharded embedding / qkv (column),
+  row-parallel out-proj and ffn-down with ONE psum per block half —
+  2 psums per block, asserted exact by
+  :func:`block_collective_counts`.
 - **sp**  sequence sharding with ring attention (parallel/ring.py) —
   K/V chunks ride ICI collective-permute while the MXU works.
 - **ep**  expert parallel MoE ffn (soft top-k gating, experts sharded
@@ -42,7 +46,19 @@ from ..parallel.ring import ring_attention_inner, full_attention
 __all__ = ["TransformerConfig", "init_params", "param_specs", "make_loss_fn",
            "make_train_step", "make_forward_fn", "init_kv_cache",
            "make_prefill_fn", "make_decode_fn", "make_extend_fn",
-           "draft_from_layers", "decode_schedule_shape"]
+           "draft_from_layers", "decode_schedule_shape",
+           "block_collective_counts", "kv_cache_spec"]
+
+
+def _mp_axis(axes):
+    """The tensor-parallel axis this mesh carries: ``mp`` (ISSUE 20),
+    falling back to the legacy ``tp`` alias; None when the mesh has
+    neither (the replicated-model path)."""
+    if "mp" in axes:
+        return "mp"
+    if "tp" in axes:
+        return "tp"
+    return None
 
 
 @dataclasses.dataclass
@@ -98,9 +114,15 @@ def init_params(config, seed=0):
 
 
 def param_specs(config, mesh):
-    """PartitionSpec per param — megatron tp + ep expert sharding."""
+    """PartitionSpec per param — megatron mp/tp + ep expert sharding.
+
+    Column sharding (QKV heads, FFN-up output) and row sharding
+    (attention out-proj input heads, FFN-down input) over the mesh's
+    tensor-parallel axis (``mp``, or the legacy ``tp`` alias), the
+    classic megatron split: each block needs exactly one psum after
+    the attention out-proj and one after FFN-down."""
     ax = set(mesh.axis_names)
-    tp = "tp" if "tp" in ax else None
+    tp = _mp_axis(ax)
     ep = "ep" if "ep" in ax else None
     sp = {
         "embed_weight": P(tp, None),
@@ -173,8 +195,9 @@ def _block(x, lp, c, axes, cdt):
     o = _attention(q, k, v, axes=axes, attn=c.attn,
                    blocks=(c.attn_block_q, c.attn_block_k))
     o = jnp.einsum("bhse,hed->bsd", o, lp["attn_out_weight"].astype(cdt))
-    if "tp" in axes:
-        o = lax.psum(o, "tp")      # row-parallel out-proj
+    t = _mp_axis(axes)
+    if t:
+        o = lax.psum(o, t)         # row-parallel out-proj
     x = x + o
     return _ffn(x, lp, c, axes, cdt)
 
@@ -184,6 +207,7 @@ def _ffn(x, lp, c, axes, cdt):
     shared verbatim between the training forward and the incremental
     decode step, so the two paths cannot drift numerically."""
     h = _layernorm(x, lp["ln2_gamma"], lp["ln2_beta"])
+    t = _mp_axis(axes)
     if c.n_experts:
         gate = jax.nn.softmax(
             jnp.einsum("bsd,de->bse", h.astype(jnp.float32),
@@ -198,14 +222,14 @@ def _ffn(x, lp, c, axes, cdt):
         f = jnp.einsum("besd,bse->bsd", down, g_loc)
         if "ep" in axes:
             f = lax.psum(f, "ep")
-        if "tp" in axes:
-            f = lax.psum(f, "tp")  # d_ff was also tp-sharded
+        if t:
+            f = lax.psum(f, t)     # d_ff was also mp-sharded
     else:
         up = jax.nn.relu(jnp.einsum("bsd,df->bsf", h,
                                     lp["ffn_up_weight"].astype(cdt)))
         f = jnp.einsum("bsf,fd->bsd", up, lp["ffn_down_weight"].astype(cdt))
-        if "tp" in axes:
-            f = lax.psum(f, "tp")
+        if t:
+            f = lax.psum(f, t)     # row-parallel ffn-down
     return x + f
 
 
@@ -214,16 +238,17 @@ def _forward_local(params, tokens, c, axes):
     cdt = jnp.dtype(c.dtype)
     B, S_loc = tokens.shape
 
-    # vocab(tp)-sharded embedding: mask + psum
+    # vocab(mp)-sharded embedding: mask + psum
+    t = _mp_axis(axes)
     emb_w = params["embed_weight"]
     v_loc = emb_w.shape[0]
-    v0 = lax.axis_index("tp") * v_loc if "tp" in axes else 0
+    v0 = lax.axis_index(t) * v_loc if t else 0
     local_ids = tokens - v0
     in_range = (local_ids >= 0) & (local_ids < v_loc)
     x = jnp.take(emb_w, jnp.clip(local_ids, 0, v_loc - 1), axis=0)
     x = jnp.where(in_range[..., None], x, 0.0)
-    if "tp" in axes:
-        x = lax.psum(x, "tp")
+    if t:
+        x = lax.psum(x, t)
     s0 = lax.axis_index("sp") * S_loc if "sp" in axes else 0
     pos = lax.dynamic_slice_in_dim(params["pos_embed_weight"], s0, S_loc, 0)
     x = (x + pos).astype(cdt)
@@ -243,8 +268,8 @@ def _forward_local(params, tokens, c, axes):
 
     x = _layernorm(x, params["final_ln_gamma"], params["final_ln_beta"])
     logits_loc = jnp.einsum("bsd,vd->bsv", x, emb_w.astype(cdt))
-    if "tp" in axes:
-        logits = lax.all_gather(logits_loc, "tp", axis=2, tiled=True)
+    if t:
+        logits = lax.all_gather(logits_loc, t, axis=2, tiled=True)
     else:
         logits = logits_loc
     return logits.astype(jnp.float32)
@@ -342,7 +367,81 @@ def make_train_step(config, mesh, optimizer=None, data_axes=("dp",)):
 
 
 # ---------------------------------------------------------------------------
-# incremental decode (ISSUE 12): prefill + single-token decode against a
+# collective accounting (ISSUE 20): the megatron sharding's contract is
+# ONE psum per block half — 2 per transformer block. Assert it from the
+# traced jaxpr, not the compiled HLO: the count is backend-independent
+# and survives the CPU pipeline's CSE/barrier stripping that makes HLO
+# text counting unstable (the PR 19 lesson).
+# ---------------------------------------------------------------------------
+def _sub_jaxprs(eqn):
+    try:
+        from jax.extend import core as _core
+    except ImportError:  # jax 0.4.x
+        from jax import core as _core
+
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if isinstance(x, _core.ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, _core.Jaxpr):
+                yield x
+
+
+def _count_prims(jaxpr, names):
+    n = {k: 0 for k in names}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in n:
+            n[eqn.primitive.name] += 1
+        for sub in _sub_jaxprs(eqn):
+            for k, v in _count_prims(sub, names).items():
+                n[k] += v
+    return n
+
+
+def _scan_bodies(jaxpr):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            yield eqn.params["jaxpr"].jaxpr
+        else:
+            for sub in _sub_jaxprs(eqn):
+                yield from _scan_bodies(sub)
+
+
+def block_collective_counts(config, mesh, data_axes=("dp",)):
+    """Per-step collective bill of the shard_map'd loss forward, from
+    the traced jaxpr: ``psum_per_block`` counts psums inside the
+    scanned transformer-block body (exactly 2 on an mp mesh — the
+    attention out-proj and ffn-down row-parallel reductions; 0 when
+    the model is replicated), ``psum_outside`` the psums outside the
+    scan (vocab-sharded embedding + the dp/sp loss reductions), and
+    ``all_gather`` the logit gathers. Feeds ``profiler.mp_record`` and
+    the exactness assert in tests/test_model_parallel.py."""
+    loss_fn, _specs = make_loss_fn(config, mesh, data_axes)
+    params = jax.eval_shape(lambda: init_params(config))
+    B = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
+                     if a in data_axes]) or 1)
+    tokens = jax.ShapeDtypeStruct((B, 9), jnp.int32)
+    jaxpr = jax.make_jaxpr(loss_fn)(params, tokens).jaxpr
+    bodies = list(_scan_bodies(jaxpr))
+    per_block = max((_count_prims(b, ("psum",))["psum"] for b in bodies),
+                    default=0)
+    total = _count_prims(jaxpr, ("psum", "all_gather"))
+    return {
+        "psum_per_block": per_block,
+        "psum_outside": total["psum"] - per_block * len(bodies),
+        "all_gather": total["all_gather"],
+        "n_blocks": config.n_layers,
+    }
+
+
+def kv_cache_spec(mesh):
+    """PartitionSpec of the paged KV cache (L, 2, P+1, page, H, Dh)
+    on an mp mesh: heads sharded over the tensor-parallel axis — each
+    chip holds 1/mp of every page (the sharded-serving-group memory
+    claim). Replicated when the mesh has no mp/tp axis."""
+    t = _mp_axis(set(mesh.axis_names))
+    return P(None, None, None, None, t, None)
 # PAGED per-layer KV cache. The serving tier (serving/generate.py) owns
 # page allocation and batch-slot bookkeeping; the functions here are the
 # pure compiled programs:

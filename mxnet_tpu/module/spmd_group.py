@@ -24,7 +24,6 @@ from .. import config, profiler
 from ..base import MXNetError
 from ..ndarray import ndarray as nd
 from ..parallel.feed import is_preplaced, place_batch_array
-from ..parallel.mesh import make_mesh
 from ..parallel.spmd import (
     TrainStep,
     data_sharding,
@@ -102,10 +101,25 @@ class FusedSPMDGroup:
                 "fused SPMD step: batch size %d not divisible by %d devices"
                 % (batch_size, len(devices)))
         self.distributed = bool(distributed)
+        # ISSUE 20: tensor parallelism — the strictly-validated knobs
+        # split the contexts into a (dp, mp) mesh and hand the parsed
+        # MXNET_MP_RULES to TrainStep's param_shardings. mp=1 (the
+        # default) builds the identical 1-axis {"dp": N} mesh as before
+        # — bit-identical to the pure data-parallel path.
+        from ..parallel.mesh import mp_size, train_mesh
+        from ..parallel.spmd import parse_rules
+
+        mp = mp_size()
+        self._param_rules = parse_rules(config.get("MXNET_MP_RULES"))
         if self.distributed:
             from .. import dist
 
             self._dist = dist
+            if mp > 1:
+                raise MXNetError(
+                    "fused dist step: MXNET_MP_SIZE=%d is single-process "
+                    "only for now (the multi-host (dcn, dp, mp) mesh is "
+                    "the scripted on-chip follow-up — see ROADMAP)" % mp)
             if len(devices) != jax.local_device_count():
                 raise MXNetError(
                     "fused dist step: contexts must cover all %d local "
@@ -115,9 +129,15 @@ class FusedSPMDGroup:
             data_axes = self.mesh.axis_names  # ("dcn","dp") when multi-proc
         else:
             self._dist = None
-            self.mesh = make_mesh({"dp": len(devices)}, devices=devices)
+            self.mesh = train_mesh(devices=devices, mp=mp)
             data_axes = ("dp",)
         self._data_axes = tuple(data_axes)
+        if mp > 1 and batch_size is not None \
+                and batch_size % (len(devices) // mp) != 0:
+            raise MXNetError(
+                "fused SPMD step: batch size %d not divisible by the "
+                "dp size %d (MXNET_MP_SIZE=%d over %d devices)"
+                % (batch_size, len(devices) // mp, mp, len(devices)))
         # ISSUE 5 knobs: bound on compiled steps dispatched ahead of the
         # device (donated carry makes >1 safe) and the in-step metric
         # statistics that keep the hot loop free of per-batch host syncs
@@ -142,6 +162,7 @@ class FusedSPMDGroup:
         # rescale_grad already carries the 1/batch normalization Module set.
         self._ts = TrainStep(
             symbol, self._fopt, mesh=self.mesh, data_axes=self._data_axes,
+            param_rules=self._param_rules,
             data_names=tuple(data_names), label_names=tuple(label_names),
             compute_dtype=None, normalize_grads=False, return_outputs=True,
             metric_stats=self._device_metrics, zero=self.zero,
@@ -153,6 +174,17 @@ class FusedSPMDGroup:
         params, aux = self._sync_rank0(params, aux)
         opt_state = self._fopt.init(params)
         self._carry = self._ts.place(params, opt_state, aux)
+        if mp > 1:
+            # mpStats gauge (ISSUE 20): the measured per-chip footprint
+            # of the freshly placed carry — the ~1/mp memory claim
+            ms = self._ts.memory_stats(self._carry)
+            profiler.mp_record(
+                mp_size=mp, dp_size=len(devices) // mp,
+                group_size=len(devices),
+                param_bytes_per_chip=ms["param_bytes_per_dev"],
+                live_bytes_per_chip=(ms["param_bytes_per_dev"]
+                                     + ms["opt_bytes_per_dev"]
+                                     + ms["aux_bytes_per_dev"]))
         self._data_names = list(data_names)
         self._label_names = list(label_names)
         self._output_names = list(symbol.list_outputs())
@@ -586,6 +618,7 @@ class FusedSPMDGroup:
         self._ts = TrainStep(
             self._ts.symbol, self._fopt, mesh=self.mesh,
             data_axes=self._data_axes,
+            param_rules=self._param_rules,
             data_names=tuple(self._data_names),
             label_names=tuple(self._label_names),
             compute_dtype=None, normalize_grads=False, return_outputs=True,

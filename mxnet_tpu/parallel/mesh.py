@@ -4,7 +4,11 @@ Reference counterpart: none directly — this replaces the device-placement
 roles of KVStore/PlaceDevice with ``jax.sharding.Mesh`` axes. Convention:
 
 - ``dp``: data parallel (batch axis)      — gradients psum over it
-- ``tp``: tensor parallel (hidden axis)   — per-layer collectives
+- ``mp``: tensor/model parallel (hidden axis) — per-layer psums; the
+  megatron column/row sharding of models/transformer.py (ISSUE 20).
+  ``tp`` is the legacy alias some tests still build meshes with; new
+  code uses ``mp``, and the transformer resolves whichever the mesh has
+- ``tp``: tensor parallel (legacy alias of ``mp``)
 - ``pp``: pipeline stages                 — collective_permute between
 - ``sp``: sequence/context parallel       — ring attention axis
 
@@ -36,6 +40,43 @@ def make_mesh(axes=None, devices=None):
         raise ValueError("mesh axes %r need %d devices, have %d" % (axes, total, len(devices)))
     arr = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(arr, tuple(axes.keys()))
+
+
+def mp_size():
+    """The strictly-validated ``MXNET_MP_SIZE`` knob (>= 1 integer;
+    nonsense raises naming the knob)."""
+    from .. import config
+
+    return config.get_positive_int("MXNET_MP_SIZE")
+
+
+def train_mesh(devices=None, mp=None):
+    """The 2-D ``(dp, mp)`` training/serving mesh (ISSUE 20): the
+    devices split into ``dp = N // mp`` data-parallel groups of ``mp``
+    model shards each, with ``mp`` innermost so a model-parallel group
+    sits on adjacent devices (ICI-neighbors on a real slice).
+
+    ``mp=None`` consults ``MXNET_MP_SIZE``; ``mp=1`` yields the plain
+    ``{"dp": N}`` 1-axis mesh — bit-identical to the pre-ISSUE-20
+    data-parallel path (no second axis for pjit to partition over).
+    ``mp`` must divide the device count; anything else raises.
+    """
+    import jax
+
+    from ..base import MXNetError
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mp = mp_size() if mp is None else int(mp)
+    if mp < 1:
+        raise MXNetError("train_mesh: mp=%r must be >= 1" % (mp,))
+    if n % mp != 0:
+        raise MXNetError(
+            "train_mesh: MXNET_MP_SIZE=%d must divide the device "
+            "count %d" % (mp, n))
+    if mp == 1:
+        return make_mesh({"dp": n}, devices=devices)
+    return make_mesh({"dp": n // mp, "mp": mp}, devices=devices)
 
 
 _DEFAULT_MESH = None

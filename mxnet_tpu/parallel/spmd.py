@@ -43,7 +43,7 @@ from ..base import MXNetError
 __all__ = [
     "param_shardings", "data_sharding", "replicated", "make_train_step",
     "TrainStep", "functional_optimizer", "functional_from_optimizer",
-    "cross_entropy_loss",
+    "cross_entropy_loss", "parse_rules", "ShardingRuleError",
 ]
 
 # Primitives whose outputs the remat="conv" policy SAVES. The fused
@@ -77,45 +77,113 @@ def data_sharding(mesh, axes=("dp",), ndim=None):
     return NamedSharding(mesh, spec)
 
 
+class ShardingRuleError(MXNetError):
+    """A parameter-sharding rule matched but cannot apply: the spec
+    names a mesh axis the mesh does not have, or a sharded dim is not
+    divisible by the axis size. Raised instead of silently replicating
+    (ISSUE 20) — a silently replicated layer would defeat the 1/mp
+    per-chip memory claim while looking healthy."""
+
+
 def param_shardings(params, mesh, rules=None):
     """Map param name -> NamedSharding via ordered (regex, PartitionSpec)
     rules; first match wins, default replicated.
 
     Example rules for megatron-style tensor parallelism::
 
-        [(r".*ffn_up_weight",  P("tp", None)),   # (out, in): shard out dim
-         (r".*ffn_down_weight", P(None, "tp")),
+        [(r".*ffn_up_weight",  P("mp", None)),   # (out, in): shard out dim
+         (r".*ffn_down_weight", P(None, "mp")),
          (r".*", P())]
+
+    A matched rule that cannot apply — the spec names an axis the mesh
+    does not have, or the sharded dim is not divisible by the axis
+    size — raises :class:`ShardingRuleError` naming the parameter and
+    the rule.
     """
     rules = rules or []
     out = {}
     for name, v in params.items():
         spec = P()
+        rule_pat = None
         for pat, s in rules:
             if re.match(pat, name):
                 spec = s if isinstance(s, P) else P(*s)
+                rule_pat = pat
                 break
-        if spec != P() and not _spec_fits(spec, v.shape, mesh):
-            spec = P()  # unknown axis or indivisible dim: replicate
+        if spec != P():
+            problem = _spec_misfit(spec, v.shape, mesh)
+            if problem is not None:
+                raise ShardingRuleError(
+                    "param_shardings: rule (%r, %s) matched parameter "
+                    "%r with shape %s but cannot apply: %s"
+                    % (rule_pat, spec, name, tuple(v.shape), problem))
         out[name] = NamedSharding(mesh, spec)
     return out
 
 
-def _spec_fits(spec, shape, mesh):
-    """True iff every axis in spec exists on the mesh and divides its dim."""
+def _spec_misfit(spec, shape, mesh):
+    """None iff every axis in spec exists on the mesh and divides its
+    dim; otherwise a human-readable reason string."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+    spec_t = tuple(spec)
+    if len(spec_t) > len(shape):
+        return ("spec has %d entries for a %d-dim shape"
+                % (len(spec_t), len(shape)))
+    for dim, ax in zip(shape, spec_t + (None,) * (len(shape) - len(spec_t))):
         if ax is None:
             continue
         axs = (ax,) if isinstance(ax, str) else tuple(ax)
         n = 1
         for a in axs:
             if a not in sizes:
-                return False
+                return ("mesh has no axis %r (mesh axes: %s)"
+                        % (a, ", ".join(sizes) or "<none>"))
             n *= sizes[a]
         if dim % n != 0:
-            return False
-    return True
+            return ("dim %d is not divisible by the axis size %d"
+                    % (dim, n))
+    return None
+
+
+def parse_rules(text, knob="MXNET_MP_RULES"):
+    """Parse the ``MXNET_MP_RULES`` grammar ``'regex:spec;regex:spec'``
+    into the ordered ``[(regex, PartitionSpec)]`` list
+    :func:`param_shardings` consumes. ``spec`` is a comma list with one
+    entry per dim: ``*`` replicates that dim, anything else is a
+    mesh-axis name (existence/divisibility are checked at apply time by
+    :func:`param_shardings`, which raises :class:`ShardingRuleError`).
+    Malformed grammar raises :class:`MXNetError` naming the knob."""
+    rules = []
+    text = (text or "").strip()
+    if not text:
+        return rules
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        # rpartition: the regex may contain ':' (e.g. char classes),
+        # the spec never does
+        pat, sep, spec_s = part.rpartition(":")
+        pat = pat.strip()
+        if not sep or not pat:
+            raise MXNetError(
+                "%s: rule %r must be 'regex:spec' with spec a comma "
+                "list of '*' or mesh-axis names" % (knob, part))
+        try:
+            re.compile(pat)
+        except re.error as e:
+            raise MXNetError(
+                "%s: bad regex %r in rule %r: %s" % (knob, pat, part, e))
+        entries = []
+        for ent in spec_s.split(","):
+            ent = ent.strip()
+            if not ent:
+                raise MXNetError(
+                    "%s: empty spec entry in rule %r (use '*' to "
+                    "replicate a dim)" % (knob, part))
+            entries.append(None if ent == "*" else ent)
+        rules.append((pat, P(*entries)))
+    return rules
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,9 @@ def dump_profile():
     qos = qos_stats()
     if qos:
         payload["qosStats"] = qos
+    mp = mp_stats()
+    if mp:
+        payload["mpStats"] = mp
     with open(_STATE["filename"], "w") as f:
         json.dump(payload, f)
 
@@ -352,6 +355,51 @@ def memory_stats(reset=False):
 def memory_reset():
     with _MEM_LOCK:
         _MEM.clear()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel observability (ISSUE 20): a GAUGE like memoryStats —
+# the latest snapshot of the mp execution's memory/collective shape:
+# mesh split (dp x mp), serving group size, MEASURED per-chip parameter
+# and live (compiled peak) bytes, and the per-step collective bill
+# (psums per block is the megatron contract: exactly 2 — asserted exact
+# in tests/test_model_parallel.py via block_collective_counts). Rides
+# dump_profile as mpStats. Unknown counter names raise (the
+# fleet_record rule: a typo'd counter must not silently vanish from
+# the acceptance evidence).
+# ---------------------------------------------------------------------------
+_MP_LOCK = threading.Lock()
+_MP_KEYS = frozenset((
+    "mp_size", "dp_size", "group_size",
+    "param_bytes_per_chip", "live_bytes_per_chip",
+    "psum_per_block", "psum_outside", "all_gather_per_step",
+    "collectives_per_step",
+))
+_MP = {}
+
+
+def mp_record(**fields):
+    """Update the tensor-parallel gauge with the latest snapshot's
+    fields (partial updates merge). Unknown counter names raise."""
+    with _MP_LOCK:
+        for k, v in fields.items():
+            if k not in _MP_KEYS:
+                raise ValueError("mp_record: unknown counter %r" % k)
+            _MP[k] = int(v)
+
+
+def mp_stats(reset=False):
+    """Latest tensor-parallel snapshot ({} when mp never ran)."""
+    with _MP_LOCK:
+        snap = dict(_MP)
+        if reset:
+            _MP.clear()
+    return snap
+
+
+def mp_reset():
+    with _MP_LOCK:
+        _MP.clear()
 
 
 # ---------------------------------------------------------------------------

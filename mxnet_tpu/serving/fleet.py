@@ -220,11 +220,30 @@ class ReplicaServer:
 
     def __init__(self, server, tracker_uri=None, host="127.0.0.1", port=0,
                  advertise_host=None, rank=None, restart=0,
-                 publish_interval=None, drain_timeout=None, qos=None):
+                 publish_interval=None, drain_timeout=None, qos=None,
+                 group=None, group_size=1, group_rank=0):
         if not isinstance(server, ModelServer):
             raise FleetError("ReplicaServer wraps a ModelServer, got %r"
                              % type(server).__name__)
         self._server = server
+        # sharded replica group (ISSUE 20): ``group`` names the
+        # mesh-sharing member set; the router routes ONLY to the
+        # group's leader (group_rank 0) and only while all group_size
+        # members publish alive + serving — one member dying drains
+        # the whole group.
+        if group is not None:
+            group_size = int(group_size)
+            group_rank = int(group_rank)
+            if group_size < 1:
+                raise FleetError("ReplicaServer: group_size must be "
+                                 ">= 1, got %d" % group_size)
+            if not 0 <= group_rank < group_size:
+                raise FleetError(
+                    "ReplicaServer: group_rank %d outside the group "
+                    "of %d member(s)" % (group_rank, group_size))
+        self._group = group
+        self._group_size = int(group_size)
+        self._group_rank = int(group_rank)
         # QoS boundary (ISSUE 18): quotas enforced here too, so a
         # deployment with several routers (or none) still caps tenants.
         # None with an empty MXNET_QOS_TENANTS — zero per-request cost.
@@ -274,12 +293,17 @@ class ReplicaServer:
         p99 = max((s.get("p99_ms") or 0.0 for s in stats.values()),
                   default=0.0)
         gen = profiler.generate_stats()
-        return {"state": state, "models": self._server.models(),
-                "ladder": list(self._server._ladder),
-                "queued": self._server.pending(), "inflight": inflight,
-                "admitted": admitted, "p50_ms": p50, "p99_ms": p99,
-                "gen_occupancy": gen.get("slot_occupancy", 0.0),
-                "swap_gen": swap_gen, "pid": os.getpid()}
+        out = {"state": state, "models": self._server.models(),
+               "ladder": list(self._server._ladder),
+               "queued": self._server.pending(), "inflight": inflight,
+               "admitted": admitted, "p50_ms": p50, "p99_ms": p99,
+               "gen_occupancy": gen.get("slot_occupancy", 0.0),
+               "swap_gen": swap_gen, "pid": os.getpid()}
+        if self._group is not None:
+            out["group"] = self._group
+            out["group_size"] = self._group_size
+            out["group_rank"] = self._group_rank
+        return out
 
     def _publish(self):
         if self._client is None:
@@ -514,7 +538,7 @@ class _Handle:
 
     __slots__ = ("addr", "rank", "node_id", "alive", "state", "models",
                  "queued", "info", "inflight", "cooldown_until", "_pool",
-                 "_lock")
+                 "_lock", "group", "group_size", "group_rank", "group_ok")
 
     def __init__(self, addr, rank=0, node_id=None):
         self.addr = addr
@@ -525,6 +549,10 @@ class _Handle:
         self.models = None          # None = unknown: route anything
         self.queued = 0
         self.info = {}
+        self.group = None           # sharded replica group (ISSUE 20):
+        self.group_size = 1         # only the leader (group_rank 0) is
+        self.group_rank = 0         # routable, and only while ALL
+        self.group_ok = True        # members are alive + serving
         self.inflight = 0           # router-local, atomic under _lock
         self.cooldown_until = 0.0   # transport-failure penalty box: a
         # WEDGED replica still heartbeats and publishes healthy, so
@@ -726,9 +754,30 @@ class FleetRouter:
                 h.queued = int(info.get("queued") or 0)
                 h.info = info
                 h.rank = int(e.get("rank") or h.rank)
+                h.group = info.get("group")
+                h.group_size = int(info.get("group_size") or 1)
+                h.group_rank = int(info.get("group_rank") or 0)
             for addr in list(self._handles):
                 if addr not in seen:
                     self._handles.pop(addr).close()
+            # sharded-group gate (ISSUE 20): a group is one routable
+            # replica — its leader — and only while EVERY member is
+            # alive and serving. One dead/draining member drains the
+            # whole group (a partial group would hang or corrupt the
+            # collective), so no request is ever routed to a torn group.
+            members = {}
+            for h in self._handles.values():
+                if h.group is not None:
+                    members.setdefault(h.group, []).append(h)
+            for h in self._handles.values():
+                if h.group is None:
+                    h.group_ok = True
+                    continue
+                grp = members[h.group]
+                h.group_ok = (
+                    len(grp) >= h.group_size
+                    and all(m.alive and m.state == "serving"
+                            for m in grp))
             alive = sum(1 for h in self._handles.values()
                         if h.alive and h.state == "serving")
         profiler.fleet_record(replicas_alive=alive)
@@ -739,6 +788,8 @@ class FleetRouter:
             handles = list(self._handles.values())
         return [h for h in handles
                 if h.alive and h.state == "serving"
+                and (h.group is None
+                     or (h.group_rank == 0 and h.group_ok))
                 and (h.models is None or model in h.models)
                 and h.addr not in exclude
                 and (not honor_cooldown or h.cooldown_until <= now)]
@@ -1153,6 +1204,13 @@ def _replica_main(argv):
     ap.add_argument("--pin-core", type=int, default=None,
                     help="pin this process to one CPU core (bench "
                          "determinism on shared hosts)")
+    ap.add_argument("--group", default=None,
+                    help="sharded replica group name (ISSUE 20): all "
+                         "members of one mesh publish the same group; "
+                         "the router routes only to its rank-0 leader "
+                         "while every member is alive")
+    ap.add_argument("--group-size", type=int, default=1)
+    ap.add_argument("--group-rank", type=int, default=0)
     args = ap.parse_args(argv)
 
     if args.pin_core is not None and hasattr(os, "sched_setaffinity"):
@@ -1183,7 +1241,9 @@ def _replica_main(argv):
     replica = ReplicaServer(
         server, tracker_uri=_env_tracker_uri(args.tracker),
         host=args.host, port=args.port,
-        rank=int(rank) if rank is not None else None, restart=restart)
+        rank=int(rank) if rank is not None else None, restart=restart,
+        group=args.group, group_size=args.group_size,
+        group_rank=args.group_rank)
 
     exit_code = [0]
 

@@ -387,11 +387,19 @@ class GenerativePredictor:
     cache : ExecutableCache, optional
         Shared compiled-program LRU (the serving tier's); private
         unbounded cache by default.
+    mesh : jax.sharding.Mesh, optional
+        Bind the model SHARDED across a replica group (ISSUE 20):
+        weights placed per ``models.transformer.param_specs`` (megatron
+        column/row over the mesh's ``mp``/``tp`` axis) and the paged KV
+        cache sharded over its heads axis (``kv_cache_spec``) so every
+        chip holds 1/mp of every page. Mutually exclusive with
+        ``device``; the pure-jnp prefill/decode/extend programs are
+        GSPMD-partitioned automatically.
     """
 
     def __init__(self, config_, params, *, slots=None, page_size=None,
                  pool_bytes=None, max_ctx=None, block_k=None, device=None,
-                 cache=None, model_name=None):
+                 cache=None, model_name=None, mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -440,9 +448,19 @@ class GenerativePredictor:
 
         if device is not None and hasattr(device, "jax_device"):
             device = device.jax_device()
+        if mesh is not None and device is not None:
+            raise GenerateError(
+                "GenerativePredictor: pass mesh= OR device=, not both "
+                "(a sharded bind owns the whole group's placement)")
         self._device = device
-        platform = device.platform if device is not None \
-            else jax.default_backend()
+        self._mesh = mesh
+        self._group_size = int(mesh.devices.size) if mesh is not None else 1
+        if device is not None:
+            platform = device.platform
+        elif mesh is not None:
+            platform = mesh.devices.flat[0].platform
+        else:
+            platform = jax.default_backend()
         self._donate = platform != "cpu"
         self._exec_cache = cache if cache is not None \
             else ExecutableCache(None)
@@ -450,12 +468,28 @@ class GenerativePredictor:
             else "gen-%d" % id(self)
         self._dtype_name = str(cdt)
 
-        def put(a):
-            a = jnp.asarray(np.asarray(a))
-            return jax.device_put(a, device) if device is not None else a
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
 
-        self._params = {k: put(v) for k, v in params.items()}
-        self._kv = put(tfm.init_kv_cache(c, num_pages, self.page_size))
+            pspecs = tfm.param_specs(c, mesh)
+
+            def put(a, spec=None):
+                return jax.device_put(
+                    jnp.asarray(np.asarray(a)),
+                    NamedSharding(mesh, spec if spec is not None else P()))
+
+            self._params = {k: put(v, pspecs.get(k))
+                            for k, v in params.items()}
+            self._kv = put(tfm.init_kv_cache(c, num_pages, self.page_size),
+                           tfm.kv_cache_spec(mesh))
+        else:
+            def put(a):
+                a = jnp.asarray(np.asarray(a))
+                return jax.device_put(a, device) if device is not None else a
+
+            self._params = {k: put(v) for k, v in params.items()}
+            self._kv = put(tfm.init_kv_cache(c, num_pages, self.page_size))
         self.block_k = int(block_k) if block_k is not None \
             else tfm._decode_block_k(c, self.slots, self.max_ctx)
 
@@ -624,3 +658,35 @@ class GenerativePredictor:
 
     def pool_stats(self):
         return self.pool.stats()
+
+    def sharded_stats(self):
+        """Measured per-chip bytes of the sharded bind (ISSUE 20):
+        params and the paged KV cache, counting only shards resident on
+        the first mesh device — the KV pages split over heads, so each
+        chip holds ~1/mp of every page. Records into the profiler's
+        ``mpStats`` gauge group. Raises on a single-device bind."""
+        if self._mesh is None:
+            raise GenerateError(
+                "sharded_stats: predictor was not bound on a mesh "
+                "(pass mesh= to the constructor)")
+        dev0 = self._mesh.devices.flat[0]
+
+        def chip_bytes(arr):
+            return sum(int(s.data.nbytes) for s in arr.addressable_shards
+                       if s.device == dev0)
+
+        with self._lock:
+            kv = self._kv
+        param_chip = sum(chip_bytes(v) for v in self._params.values())
+        kv_chip = chip_bytes(kv)
+        mp = int(dict(self._mesh.shape).get(
+            "mp", dict(self._mesh.shape).get("tp", 1)))
+        from .. import profiler
+
+        profiler.mp_record(group_size=self._group_size, mp_size=mp,
+                           param_bytes_per_chip=param_chip,
+                           live_bytes_per_chip=param_chip + kv_chip)
+        return {"group_size": self._group_size, "mp_size": mp,
+                "param_bytes_per_chip": param_chip,
+                "kv_bytes_per_chip": kv_chip,
+                "kv_bytes_total": int(kv.nbytes)}

@@ -229,13 +229,29 @@ class AOTPredictor:
         Shared executable LRU; private unbounded cache by default.
     model_name : str, optional
         Cache-key namespace (the server passes its model name).
+    mesh : jax.sharding.Mesh, optional
+        Bind a SHARDED executable across a device group (ISSUE 20):
+        weights are frozen with the NamedShardings that
+        ``param_rules`` (regex → PartitionSpec, the
+        ``parallel.spmd.param_shardings`` grammar) assign, requests
+        enter replicated, and GSPMD partitions the per-request
+        program across the group — per-chip parameter bytes drop to
+        ~1/mp for the sharded layers. The group is ONE predictor in
+        one process (the offline host-device half; a multi-process
+        group is the on-chip follow-up). Mutually exclusive with
+        ``device``. A matched rule that cannot apply raises
+        (``ShardingRuleError``) — never a silent replication.
+    param_rules : list of (regex, PartitionSpec) or str, optional
+        Sharding rules for ``mesh``; a string is parsed with the
+        ``MXNET_MP_RULES`` grammar (``regex:spec;regex:spec``).
+        Default replicates everything.
     """
 
     def __init__(self, symbol, arg_params=None, aux_params=None,
                  data_shapes=None, ladder=DEFAULT_LADDER, dtype="float32",
                  device=None, output_names=None, cache=None,
                  model_name=None, rng_seed=0, quant=None, calib_data=None,
-                 quant_exclude=()):
+                 quant_exclude=(), mesh=None, param_rules=None):
         if not data_shapes:
             raise ServingError("AOTPredictor: data_shapes is required "
                                "({input_name: shape})")
@@ -255,7 +271,20 @@ class AOTPredictor:
         self._dtype_name = dtype_name(self._np_dtype)
         if isinstance(device, Context):
             device = device.jax_device()
+        if mesh is not None and device is not None:
+            raise ServingError(
+                "AOTPredictor: pass mesh= OR device=, not both (the "
+                "mesh decides placement for a sharded bind)")
         self._device = device
+        self._mesh = mesh
+        if isinstance(param_rules, str):
+            # accept the MXNET_MP_RULES string grammar directly
+            from ..parallel.spmd import parse_rules
+
+            param_rules = parse_rules(param_rules,
+                                      knob="AOTPredictor param_rules")
+        self._param_rules = list(param_rules or [])
+        self._group_size = int(mesh.devices.size) if mesh is not None else 1
         self._cache = cache if cache is not None else ExecutableCache(None)
         self._cache_key = model_name if model_name is not None \
             else "pred-%d" % id(self)
@@ -372,6 +401,15 @@ class AOTPredictor:
         if np.issubdtype(v.dtype, np.floating) \
                 and v.dtype != self._np_dtype:
             v = v.astype(self._np_dtype)
+        if self._mesh is not None:
+            # sharded bind (ISSUE 20): the rules decide this weight's
+            # placement across the group; an inapplicable matched rule
+            # raises ShardingRuleError (never silent replication)
+            from ..parallel.spmd import param_shardings
+
+            sh = param_shardings({name: v}, self._mesh,
+                                 self._param_rules)[name]
+            return jax.device_put(jnp.asarray(v), sh)
         arr = jnp.asarray(v)
         if self._device is not None:
             arr = jax.device_put(arr, self._device)
@@ -431,8 +469,12 @@ class AOTPredictor:
         # donation lets XLA reuse the request buffer's HBM for
         # activations/outputs; the CPU test backend can't honor it (and
         # warns per executable), so only ask where it means something
-        platform = self._device.platform if self._device is not None \
-            else jax.default_backend()
+        if self._device is not None:
+            platform = self._device.platform
+        elif self._mesh is not None:
+            platform = self._mesh.devices.flat[0].platform
+        else:
+            platform = jax.default_backend()
         donate = (0,) if platform != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
 
@@ -526,7 +568,17 @@ class AOTPredictor:
         fn = self._executable(bucket)
         with self._lock:
             consts = self._consts
-        outs = fn(dict(inputs), consts)
+        data = dict(inputs)
+        if self._mesh is not None:
+            # sharded bind: the request batch is replicated across the
+            # group so every chip sees the full batch and GSPMD only
+            # communicates over the weight shards (megatron-style)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            data = {k: jax.device_put(jnp.asarray(v), rep)
+                    for k, v in data.items()}
+        outs = fn(data, consts)
         return [np.asarray(o) for o in outs]
 
     def predict(self, inputs):
@@ -554,6 +606,39 @@ class AOTPredictor:
         outs = self.run_bucket(padded, bucket)
         return [o[:rows] if o.ndim and o.shape[0] == bucket else o
                 for o in outs]
+
+    def sharded_stats(self):
+        """Measured per-chip footprint of the frozen constants on a
+        mesh bind (ISSUE 20): for the first mesh device, sum the bytes
+        of each constant's shard actually resident there — a row- or
+        column-sharded weight contributes 1/mp of itself, a replicated
+        one contributes whole. Records the measurement into the
+        profiler's ``mpStats`` gauge group and returns it. Raises on a
+        single-device bind, where nothing is sharded."""
+        if self._mesh is None:
+            raise ServingError(
+                "sharded_stats: predictor was not bound on a mesh "
+                "(pass mesh= to the constructor)")
+        dev0 = self._mesh.devices.flat[0]
+        total = per_chip = 0
+        with self._lock:
+            consts = self._consts
+        for arr in consts:
+            if not hasattr(arr, "addressable_shards"):
+                continue
+            total += int(arr.nbytes)
+            for sh in arr.addressable_shards:
+                if sh.device == dev0:
+                    per_chip += int(sh.data.nbytes)
+        mp = int(dict(self._mesh.shape).get(
+            "mp", dict(self._mesh.shape).get("tp", 1)))
+        from .. import profiler
+
+        profiler.mp_record(group_size=self._group_size, mp_size=mp,
+                           param_bytes_per_chip=per_chip)
+        return {"group_size": self._group_size, "mp_size": mp,
+                "param_bytes_total": total,
+                "param_bytes_per_chip": per_chip}
 
     # -- hot swap ------------------------------------------------------------
     def swap_params(self, arg_params=None, aux_params=None,

@@ -723,14 +723,20 @@ class Tracker:
 
     def _op_members(self, p):
         """Live view of one role's nodes (default ``replica``) with
-        their published info — the FleetRouter's discovery surface."""
+        their published info — the FleetRouter's discovery surface.
+        Sharded serving groups (ISSUE 20) ride the published info
+        verbatim (``group``/``group_size``/``group_rank``); passing
+        ``group`` narrows the view to that group's members so a tool
+        can watch one mesh's health without filtering client-side."""
         role = p.get("role", "replica")
+        group = p.get("group")
         with self._cv:
             return [{"node_id": n.node_id, "rank": n.rank, "addr": n.addr,
                      "alive": n.alive, "done": n.done,
                      "restart": n.restart, "info": dict(n.info)}
                     for n in self._nodes.values()
-                    if n.role == role and not n.replaced]
+                    if n.role == role and not n.replaced
+                    and (group is None or n.info.get("group") == group)]
 
     def _op_nodes(self):
         """Topology snapshot (debugging / tests)."""

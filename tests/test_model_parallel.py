@@ -1,13 +1,23 @@
-"""group2ctx model parallelism (ref: tests/python/unittest/
-test_model_parallel.py and the PlaceDevice pass, graph_executor.cc:411).
+"""Model parallelism: legacy group2ctx placement + the mp mesh axis.
 
-Layers are stamped with ``ctx_group`` via AttrScope; ``bind(group2ctx=...)``
-pins each group onto a distinct device of the virtual CPU mesh and the
-executor's compiled program spans both, with XLA inserting the transfers
-the reference realized as _CrossDeviceCopy nodes. Forward AND backward
-must match the single-device run exactly.
+Part 1 (ref: tests/python/unittest/test_model_parallel.py and the
+PlaceDevice pass, graph_executor.cc:411): layers stamped with
+``ctx_group`` via AttrScope; ``bind(group2ctx=...)`` pins each group
+onto a distinct device of the virtual CPU mesh and the executor's
+compiled program spans both, with XLA inserting the transfers the
+reference realized as _CrossDeviceCopy nodes. Forward AND backward must
+match the single-device run exactly.
+
+Part 2 (ISSUE 20): megatron-style tensor parallelism over the ``mp``
+mesh axis — knob/rule validation, exact per-block collective counts,
+bit-parity (accumulation-order tolerance) of fwd/bwd/optimizer step
+with single-chip execution, per-chip bytes ~1/mp via XLA's compiled
+memory analysis, dp×mp composition through Module(kvstore='tpu'), the
+sharded serving bind, and the fleet group-drain semantics through the
+static-view FleetRouter seam.
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -113,3 +123,349 @@ def test_group2ctx_chained_transfer_roundtrip():
     for n in grads_ref:
         np.testing.assert_allclose(grads_mp[n], grads_ref[n],
                                    rtol=1e-6, atol=1e-6)
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: megatron tensor parallelism over the "mp" mesh axis
+# ---------------------------------------------------------------------------
+
+def _tiny_config(**kw):
+    from mxnet_tpu.models import transformer as tfm
+
+    base = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                max_len=16, dtype="float32")
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def test_mp_knob_validation(monkeypatch):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel.mesh import mp_size, train_mesh
+
+    for bad in ("0", "-3", "x", "1.5", ""):
+        monkeypatch.setenv("MXNET_MP_SIZE", bad)
+        with pytest.raises(MXNetError, match="MXNET_MP_SIZE"):
+            mp_size()
+    monkeypatch.delenv("MXNET_MP_SIZE", raising=False)
+    # mp must divide the device count (8 host devices in the suite)
+    with pytest.raises(MXNetError, match="divide"):
+        train_mesh(mp=3)
+    # knobs-off path: the exact pre-ISSUE-20 1-axis mesh
+    mesh = train_mesh(mp=1)
+    assert mesh.axis_names == ("dp",)
+    assert train_mesh(mp=2).axis_names == ("dp", "mp")
+
+
+def test_mp_rules_grammar_and_rule_errors():
+    import jax
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel.mesh import train_mesh
+    from mxnet_tpu.parallel.spmd import (
+        ShardingRuleError, param_shardings, parse_rules)
+
+    assert parse_rules("") == []
+    rules = parse_rules(".*_weight:*,mp;bias$:mp")
+    assert rules[0][1] == (None, "mp") and rules[1][1] == ("mp",)
+    # ":" no regex; "nospec" no separator; "x:" empty spec; bad regex
+    for bad in (":", "nospec", "x:", "(:mp"):
+        with pytest.raises(MXNetError, match="MXNET_MP_RULES"):
+            parse_rules(bad)
+
+    mesh = train_mesh(mp=2)
+    # a matched rule that cannot apply names BOTH the parameter and the
+    # rule — silent replication would defeat the memory claim
+    params = {"odd_weight": jax.numpy.zeros((7, 3))}
+    with pytest.raises(ShardingRuleError, match="odd_weight"):
+        param_shardings(params, mesh, [("odd_weight", (None, "mp"))])
+    with pytest.raises(ShardingRuleError, match="no axis"):
+        param_shardings({"w": jax.numpy.zeros((4, 4))}, mesh,
+                        [("w", ("nope", None))])
+
+
+def test_mp_collective_counts_exact_and_mpstats(tmp_path):
+    """The megatron contract, asserted structurally: exactly 2 psums
+    per transformer block (attn out-proj + FFN-down), counted in the
+    traced jaxpr (backend-independent); the counts ride dump_profile
+    as mpStats and unknown counter names raise."""
+    import json
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel.mesh import train_mesh
+
+    cfg = _tiny_config()
+    counts = tfm.block_collective_counts(cfg, train_mesh(mp=2))
+    assert counts["psum_per_block"] == 2, counts
+    assert counts["n_blocks"] == cfg.n_layers
+
+    profiler.mp_reset()
+    try:
+        profiler.mp_record(mp_size=2, dp_size=4, group_size=8,
+                           psum_per_block=counts["psum_per_block"],
+                           all_gather_per_step=counts["all_gather"])
+        with pytest.raises(ValueError, match="unknown counter"):
+            profiler.mp_record(bogus=1)
+        fname = str(tmp_path / "trace.json")
+        profiler.profiler_set_config(filename=fname)
+        profiler.dump_profile()
+        with open(fname) as f:
+            payload = json.load(f)
+        assert payload["mpStats"]["psum_per_block"] == 2
+        assert payload["mpStats"]["mp_size"] == 2
+    finally:
+        profiler.mp_reset()
+
+
+def test_mp_bit_parity_fwd_bwd_small_shape():
+    """Transformer loss AND grads on the 2x2 dp×mp mesh match the
+    single-device run at a small shape (accumulation-order tolerance)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel.mesh import make_mesh, train_mesh
+
+    cfg = _tiny_config()
+    params = tfm.init_params(cfg, seed=0)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab, (4, 9)).astype(np.int32)
+
+    def run(mesh):
+        loss, specs = tfm.make_loss_fn(cfg, mesh)
+        pp = {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
+              for k, v in params.items()}
+        tt = jax.device_put(jnp.asarray(tokens),
+                            NamedSharding(mesh, P("dp")))
+        val, grads = jax.jit(jax.value_and_grad(loss))(pp, tt)
+        return float(val), jax.tree_util.tree_map(np.asarray, grads)
+
+    v_mp, g_mp = run(train_mesh(mp=2))       # (dp=4, mp=2)
+    v_1, g_1 = run(make_mesh({"dp": 1}, devices=[jax.devices()[0]]))
+    np.testing.assert_allclose(v_mp, v_1, rtol=1e-6)
+    for k in g_1:
+        np.testing.assert_allclose(g_mp[k], g_1[k], rtol=2e-4, atol=1e-6,
+                                   err_msg="grad mismatch for %s" % k)
+
+
+def test_mp_per_chip_bytes_compiled_memory_analysis():
+    """Per-chip live parameter bytes ~1/mp, read from XLA's own
+    compiled memory analysis (argument_size is per-device for SPMD
+    programs) — the memory claim the sharding exists to deliver."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel.mesh import train_mesh
+
+    cfg = _tiny_config(vocab=128, d_model=64, d_ff=256)
+    params = tfm.init_params(cfg, seed=0)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab, (8, 9)).astype(np.int32)
+
+    def arg_bytes(mesh):
+        loss, specs = tfm.make_loss_fn(cfg, mesh)
+        pp = {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
+              for k, v in params.items()}
+        tt = jax.device_put(jnp.asarray(tokens),
+                            NamedSharding(mesh, P("dp")))
+        compiled = jax.jit(jax.value_and_grad(loss)).lower(pp, tt).compile()
+        return int(compiled.memory_analysis().argument_size_in_bytes)
+
+    b_mp = arg_bytes(train_mesh(mp=2))
+    b_dp = arg_bytes(train_mesh(mp=1))
+    # embeddings/projections halve; norms + tokens stay replicated
+    assert 0.40 < b_mp / b_dp < 0.65, (b_mp, b_dp)
+
+
+@pytest.mark.slow
+def test_mp_dp_composition_module_parity(monkeypatch):
+    """Module(kvstore='tpu') under MXNET_MP_SIZE=2 + MXNET_MP_RULES
+    trains to the same weights as the pure data-parallel path — the
+    dp×mp composition through the whole module/optimizer stack."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 16).astype(np.float32)
+    y = X.dot(rng.randn(16, 4)).argmax(axis=1).astype(np.float32)
+
+    def mlp():
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, num_hidden=32, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    sym = mlp()
+    shapes, _, _ = sym.infer_shape(data=(2, 16))
+    args0 = {n: nd.NDArray(rng.normal(0, 0.1, s).astype(np.float32))
+             for n, s in zip(sym.list_arguments(), shapes)
+             if n not in ("data", "softmax_label")}
+
+    def fit(env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        try:
+            it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False)
+            mod = mx.mod.Module(mlp(),
+                                context=[mx.cpu(i) for i in range(8)])
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.init_params(
+                arg_params={k: v.copy() for k, v in args0.items()})
+            mod.init_optimizer(
+                kvstore="tpu", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+            assert mod._fused is not None, "fused SPMD path not taken"
+            for _ in range(2):
+                it.reset()
+                for b in it:
+                    mod.forward_backward(b)
+                    mod.update()
+            return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        finally:
+            for k in env:
+                monkeypatch.delenv(k, raising=False)
+
+    from mxnet_tpu import profiler
+    profiler.mp_reset()
+    p_mp = fit({"MXNET_MP_SIZE": "2",
+                "MXNET_MP_RULES": "fc1_weight:mp,*;fc2_weight:*,mp"})
+    stats = profiler.mp_stats()
+    assert stats["mp_size"] == 2 and stats["dp_size"] == 4
+    assert 0 < stats["param_bytes_per_chip"] < stats["live_bytes_per_chip"]
+    p_dp = fit({})
+    for k in p_mp:
+        np.testing.assert_allclose(
+            p_mp[k], p_dp[k], rtol=2e-5, atol=2e-6,
+            err_msg="param %s diverged between dp x mp and dp" % k)
+    profiler.mp_reset()
+
+
+@pytest.mark.slow
+def test_mp_sharded_predictor_group():
+    """AOTPredictor bound on a (dp, mp) mesh: outputs match the
+    unsharded bind and the measured per-chip constant bytes drop for
+    the sharded weights (replicated biases stay whole)."""
+    from mxnet_tpu.serving import AOTPredictor
+    from mxnet_tpu.parallel.mesh import train_mesh
+
+    rng = np.random.RandomState(0)
+    DIM, HID = 8, 16
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data=d, num_hidden=HID, name="fc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=h, num_hidden=4, name="fc2"),
+        name="softmax")
+    arg_shapes, _, _ = out.infer_shape(data=(1, DIM))
+    args = {n: (rng.randn(*s) * 0.2).astype(np.float32)
+            for n, s in zip(out.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+
+    mesh = train_mesh(mp=2)
+    rules = [("fc1_weight", (None, "mp")), ("fc2_weight", ("mp", None))]
+    sharded = AOTPredictor(out, args, data_shapes={"data": (1, DIM)},
+                           mesh=mesh, param_rules=rules)
+    plain = AOTPredictor(out, args, data_shapes={"data": (1, DIM)})
+    x = rng.randn(3, DIM).astype(np.float32)
+    for a, b in zip(sharded.predict({"data": x}),
+                    plain.predict({"data": x})):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    st = sharded.sharded_stats()
+    assert st["group_size"] == 8 and st["mp_size"] == 2
+    assert st["param_bytes_per_chip"] < st["param_bytes_total"]
+
+
+@pytest.mark.slow
+def test_mp_sharded_generative_kv_pages():
+    """GenerativePredictor on an mp mesh: prefill/decode logits match
+    the single-device bind and each chip holds 1/mp of the paged KV
+    cache (the sharded-serving-group memory claim)."""
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel.mesh import train_mesh
+    from mxnet_tpu.serving.generate import GenerativePredictor
+
+    cfg = _tiny_config(max_len=32)
+    params = tfm.init_params(cfg, seed=0)
+    gp = GenerativePredictor(cfg, params, slots=2, page_size=4,
+                             mesh=train_mesh(mp=2))
+    gr = GenerativePredictor(cfg, params, slots=2, page_size=4)
+
+    prompt = np.array([5, 9, 3, 7, 1], np.int32)
+    pages = gp.pool.alloc(gp.pages_needed(len(prompt)))
+    pages_r = gr.pool.alloc(gr.pages_needed(len(prompt)))
+    l1 = gp.prefill(prompt, pages)
+    l2 = gr.prefill(prompt, pages_r)
+    np.testing.assert_allclose(l1, l2, rtol=1e-3, atol=1e-3)
+    assert int(l1.argmax()) == int(l2.argmax())
+
+    st = gp.sharded_stats()
+    assert st["kv_bytes_per_chip"] * 2 == st["kv_bytes_total"]
+
+
+def test_mp_group_drain_on_member_death():
+    """A sharded replica group is ONE routable replica (its leader),
+    and only while every member is alive and serving: a member death
+    drains the whole group with zero misrouted requests — the router
+    raises the typed no-replica error instead of ever picking the
+    leader of a torn group."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving.fleet import FleetRouter, NoLiveReplica
+
+    view = [
+        {"addr": "127.0.0.1:1", "alive": True, "done": False, "rank": 0,
+         "node_id": "n0",
+         "info": {"state": "serving", "models": ["m"], "queued": 0,
+                  "group": "g0", "group_size": 2, "group_rank": 0}},
+        {"addr": "127.0.0.1:2", "alive": True, "done": False, "rank": 1,
+         "node_id": "n1",
+         "info": {"state": "serving", "models": ["m"], "queued": 0,
+                  "group": "g0", "group_size": 2, "group_rank": 1}},
+    ]
+    profiler.fleet_reset()
+    router = FleetRouter(view_fn=lambda: view, retries=1)
+    try:
+        # healthy group: exactly the leader is routable
+        assert [h.addr for h in router._routable("m", set())] \
+            == ["127.0.0.1:1"]
+        # member death: the WHOLE group drains
+        view[1]["alive"] = False
+        router.refresh_view(force=True)
+        assert router._routable("m", set()) == []
+        with pytest.raises(NoLiveReplica):
+            router.request("m", np.zeros((1, 4), np.float32), timeout=2.0)
+        # zero misrouted: the router never attempted a send at all
+        stats = profiler.fleet_stats()
+        assert stats.get("failovers", 0) == 0
+        assert stats.get("inflight_lost", 0) == 0
+        # a draining member gates the group just like a dead one
+        view[1]["alive"] = True
+        view[1]["info"]["state"] = "draining"
+        router.refresh_view(force=True)
+        assert router._routable("m", set()) == []
+        # full recovery re-admits the leader
+        view[1]["info"]["state"] = "serving"
+        router.refresh_view(force=True)
+        assert [h.addr for h in router._routable("m", set())] \
+            == ["127.0.0.1:1"]
+    finally:
+        router.close()
+        profiler.fleet_reset()
+
+
+def test_mp_replica_server_group_validation():
+    from mxnet_tpu.serving.fleet import FleetError, ReplicaServer
+    from mxnet_tpu.serving import ModelServer
+
+    server = ModelServer(ladder=(1,))
+    try:
+        with pytest.raises(FleetError, match="group_size"):
+            ReplicaServer(server, group="g", group_size=0)
+        with pytest.raises(FleetError, match="group_rank"):
+            ReplicaServer(server, group="g", group_size=2, group_rank=2)
+        rep = ReplicaServer(server, group="g", group_size=2, group_rank=1)
+        info = rep._info()
+        assert info["group"] == "g" and info["group_size"] == 2 \
+            and info["group_rank"] == 1
+        rep.shutdown()
+    finally:
+        server.close()

@@ -80,16 +80,26 @@ def test_train_step_matches_single_device():
 def test_param_sharding_rules():
     from jax.sharding import PartitionSpec as P
 
+    import pytest
+
+    from mxnet_tpu.parallel.spmd import ShardingRuleError
+
     mesh = make_mesh({"dp": 4, "tp": 2})
     params = {
         "fc1_weight": np.zeros((64, 32)),
         "fc1_bias": np.zeros((64,)),
-        "odd_weight": np.zeros((7, 3)),  # not divisible by tp -> replicated
     }
     sh = param_shardings(params, mesh, [(r".*weight$", P("tp", None))])
     assert sh["fc1_weight"].spec == P("tp", None)
     assert sh["fc1_bias"].spec == P()
-    assert sh["odd_weight"].spec == P()  # indivisible shape falls back
+    # ISSUE 20: a matched-but-inapplicable rule RAISES (naming the
+    # param and rule) instead of silently replicating the layer
+    with pytest.raises(ShardingRuleError, match="odd_weight"):
+        param_shardings({"odd_weight": np.zeros((7, 3))}, mesh,
+                        [(r".*weight$", P("tp", None))])
+    with pytest.raises(ShardingRuleError, match="no axis"):
+        param_shardings({"fc1_weight": np.zeros((64, 32))}, mesh,
+                        [(r".*weight$", P("nope", None))])
 
 
 def test_tp_sharded_training_runs():

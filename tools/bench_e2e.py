@@ -25,6 +25,94 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def measure_mp(mp=2, d_model=256, n_layers=4, seq=64, batch_per_dp=2,
+               steps=8):
+    """Tensor-parallel measurement (ISSUE 20): the megatron-sharded
+    transformer train step on the ``(dp, mp)`` mesh vs the same model
+    replicated — step time, per-chip argument bytes from XLA's compiled
+    memory analysis, and the structural collective counts (psums per
+    block asserted exactly 2). Shared by ``bench.py``'s "mp" variant
+    and ``tpu_kernel_smoke.py --mp`` (the scripted on-chip half)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.parallel.mesh import train_mesh
+
+    n_dev = len(jax.devices())
+    mp = int(mp)
+    if mp < 2 or n_dev % mp != 0:
+        raise ValueError("measure_mp: mp=%d must be >= 2 and divide the "
+                         "%d visible devices" % (mp, n_dev))
+    cfg = tfm.TransformerConfig(
+        vocab=4096, d_model=d_model, n_heads=8, d_ff=4 * d_model,
+        n_layers=n_layers, max_len=seq,
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32")
+    params = tfm.init_params(cfg, seed=0)
+    rng = np.random.RandomState(0)
+    # One global batch (divisible by every dp size) so the mp and
+    # dp-only losses are directly comparable.
+    tokens = rng.randint(0, cfg.vocab,
+                         (batch_per_dp * n_dev, seq + 1)).astype(np.int32)
+
+    def step_time(mesh):
+        loss, specs = tfm.make_loss_fn(cfg, mesh)
+        pp = {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
+              for k, v in params.items()}
+        tt = jax.device_put(jnp.asarray(tokens),
+                            NamedSharding(mesh, P("dp")))
+        g = jax.jit(jax.value_and_grad(loss))
+        compiled = g.lower(pp, tt).compile()
+        val, grads = g(pp, tt)      # warm
+        jax.block_until_ready(grads)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            val, grads = g(pp, tt)
+        jax.block_until_ready(grads)
+        dt = (time.perf_counter() - t0) / steps
+        mem = compiled.memory_analysis()
+        return {
+            "step_ms": round(dt * 1e3, 3),
+            "tokens_s": round(tokens.shape[0] * seq / dt, 1),
+            "arg_bytes_per_chip": int(mem.argument_size_in_bytes),
+            "loss": float(val),
+        }
+
+    mesh_mp = train_mesh(mp=mp)
+    mesh_dp = train_mesh(mp=1)
+    counts = tfm.block_collective_counts(cfg, mesh_mp)
+    assert counts["psum_per_block"] == 2, counts  # the megatron contract
+    r_mp = step_time(mesh_mp)
+    r_dp = step_time(mesh_dp)
+    profiler.mp_record(
+        mp_size=mp, dp_size=n_dev // mp, group_size=n_dev,
+        psum_per_block=counts["psum_per_block"],
+        all_gather_per_step=counts["all_gather"],
+        collectives_per_step=(counts["psum_per_block"] * cfg.n_layers
+                              + counts["psum_outside"]
+                              + counts["all_gather"]),
+        param_bytes_per_chip=r_mp["arg_bytes_per_chip"])
+    return {
+        "mp": mp, "dp": n_dev // mp, "devices": n_dev,
+        "d_model": d_model, "n_layers": n_layers, "seq": seq,
+        "tokens_s": r_mp["tokens_s"],
+        "step_ms": r_mp["step_ms"],
+        "dp_only_step_ms": r_dp["step_ms"],
+        "arg_bytes_per_chip": r_mp["arg_bytes_per_chip"],
+        "dp_only_arg_bytes_per_chip": r_dp["arg_bytes_per_chip"],
+        "bytes_ratio": round(r_mp["arg_bytes_per_chip"]
+                             / max(r_dp["arg_bytes_per_chip"], 1), 4),
+        "psum_per_block": counts["psum_per_block"],
+        "psum_outside": counts["psum_outside"],
+        "all_gather_per_step": counts["all_gather"],
+        "loss_abs_diff": round(abs(r_mp["loss"] - r_dp["loss"]), 8),
+        "backend": jax.default_backend(),
+    }
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--num-images", type=int, default=512)
@@ -58,6 +146,12 @@ def main():
                         "pipeline (DeviceQueueIter + device metrics) and "
                         "report host-fed fit img/s next to the "
                         "device-resident rate (ISSUE 5)")
+    p.add_argument("--mp", type=int, default=0, metavar="N",
+                   help="also measure the megatron tensor-parallel "
+                        "transformer step on the (dp, mp=N) mesh "
+                        "(ISSUE 20) and report tokens/s, per-chip "
+                        "argument bytes vs the replicated step "
+                        "(~1/N expected), and the collective counts")
     p.add_argument("--workdir", default="/tmp/mxtpu_bench_e2e")
     args = p.parse_args()
 
@@ -321,6 +415,8 @@ def main():
         rec["zero"] = zero_rec
     if sentinel_rec is not None:
         rec["sentinel"] = sentinel_rec
+    if args.mp and args.mp > 1:
+        rec["mp"] = measure_mp(mp=args.mp)
     # kvstore data-plane counters (raw vs wire bytes, RPC latency) ride
     # along when this process did distributed push/pull — the ISSUE 4
     # observability surface, empty on the single-chip path

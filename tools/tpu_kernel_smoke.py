@@ -110,6 +110,14 @@ def main():
                          "candidate on the bench transformer, rank with "
                          "the learned cost model, and commit the winner "
                          "to the schedule table (ISSUE 19)")
+    ap.add_argument("--mp", type=int, default=0, metavar="N",
+                    help="after the smoke passes, run the megatron "
+                         "tensor-parallel measurement on the (dp, mp=N) "
+                         "mesh (tools/bench_e2e.measure_mp): tokens/s, "
+                         "per-chip argument bytes vs the replicated "
+                         "step (~1/N expected), exactly-2-psums-per-"
+                         "block structural check (ISSUE 20); the "
+                         "scripted on-chip half of the mp acceptance")
     ap.add_argument("--ranked", dest="ranked", action="store_true",
                     default=None,
                     help="with --tune: force learned-cost-model ranked "
@@ -310,6 +318,21 @@ def main():
         rc = subprocess.call(cmd)
         if rc != 0:
             return rc
+    if args.mp and args.mp > 1 and ok and not _LOWER_ONLY:
+        # parity first, sharding second: the mp measurement reuses the
+        # smoke-validated backend. Prints one JSON line (tokens/s,
+        # per-chip bytes ratio, collective counts) — the on-chip half
+        # of the ISSUE 20 acceptance; works on the --cpu host mesh too.
+        import json
+
+        from tools.bench_e2e import measure_mp
+        print("--- tensor-parallel (mp=%d) step ---" % args.mp,
+              flush=True)
+        try:
+            print(json.dumps(measure_mp(mp=args.mp)))
+        except Exception as e:
+            print("mp measurement failed: %s" % e)
+            return 5
     return 0 if ok else 1
 
 
